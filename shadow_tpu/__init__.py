@@ -1,0 +1,23 @@
+"""shadow_tpu — a TPU-native discrete-event network simulator.
+
+A ground-up re-design of the capabilities of beastsam/shadow (a fork of the
+Shadow discrete-event network simulator, see SURVEY.md) for TPU hardware:
+
+- CPU side owns control flow: config, hosts, event queues, (managed) processes,
+  syscall emulation, and the conservative round-based scheduler.
+- TPU side owns the per-round network data plane: token-bucket bandwidth
+  enforcement, (graph-node x graph-node) latency/loss lookup, packet-loss
+  sampling with counter-based RNG, and all-pairs shortest-path routing — all as
+  batched JAX kernels behind the ``scheduler_policy: tpu_batch`` config knob
+  (SURVEY.md §7, BASELINE.json north_star).
+
+Provenance note: the reference mount /root/reference was empty in every session
+so far; component citations refer to SURVEY.md sections (which reconstruct the
+upstream shadow/shadow architecture) rather than reference file:line.
+"""
+
+__version__ = "0.1.0"
+
+from shadow_tpu.core.time import SimTime, EmulatedTime  # noqa: F401
+
+__all__ = ["SimTime", "EmulatedTime", "__version__"]

@@ -1,0 +1,216 @@
+"""Shadow-compatible YAML configuration schema.
+
+Mirrors the reference's config layer (SURVEY.md §1 layer 2, §5.6): a single
+YAML file with ``general``, ``network``, ``experimental``, and ``hosts``
+sections; every option overridable from the CLI. The new backend slots in as
+``experimental.scheduler_policy: tpu_batch`` beside the reference's
+``thread_per_core`` / ``thread_per_host`` policies (BASELINE.json north_star).
+
+Extensions over the reference schema (documented, all optional):
+- ``hosts.<name>.quantity``: stamp out N numbered copies of a host template
+  (``client`` -> ``client0..clientN-1``), for large generated benchmarks.
+- process ``path`` may be ``pyapp:<module>:<Class>`` to run an in-process
+  Python workload plugin instead of a real managed executable (real
+  executables are the phase-4 native path, SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import yaml
+
+from shadow_tpu.core.time import SimTime, parse_time
+from shadow_tpu.utils.units import parse_bandwidth, parse_size
+
+SCHEDULER_POLICIES = ("thread_per_core", "thread_per_host", "tpu_batch")
+LOG_LEVELS = ("error", "warning", "info", "debug", "trace")
+
+
+@dataclass
+class ProcessOptions:
+    path: str
+    args: list[str] = field(default_factory=list)
+    environment: dict[str, str] = field(default_factory=dict)
+    start_time: SimTime = 0
+    shutdown_time: Optional[SimTime] = None
+    shutdown_signal: str = "SIGTERM"
+    expected_final_state: Any = None  # {"exited": 0} | "running" | None
+
+
+@dataclass
+class HostOptions:
+    name: str
+    network_node_id: int = 0
+    ip_addr: Optional[str] = None
+    bandwidth_up: Optional[int] = None  # bytes/sec; None -> graph node default
+    bandwidth_down: Optional[int] = None
+    log_level: Optional[str] = None
+    pcap_enabled: bool = False
+    pcap_capture_size: int = 65535
+    processes: list[ProcessOptions] = field(default_factory=list)
+
+
+@dataclass
+class GeneralOptions:
+    stop_time: SimTime = 0
+    seed: int = 1
+    parallelism: int = 0  # 0 = auto (ncores)
+    bootstrap_end_time: SimTime = 0
+    data_directory: str = "shadow.data"
+    log_level: str = "info"
+    heartbeat_interval: Optional[SimTime] = None
+    progress: bool = False
+    model_unblocked_syscall_latency: bool = False
+
+
+@dataclass
+class ExperimentalOptions:
+    scheduler_policy: str = "thread_per_core"
+    runahead: Optional[SimTime] = None  # explicit round width override
+    use_dynamic_runahead: bool = False
+    socket_send_buffer: int = 131072
+    socket_recv_buffer: int = 174760
+    strace_logging_mode: str = "off"  # off | standard | deterministic
+    interface_qdisc: str = "fifo"
+    max_unapplied_cpu_latency: SimTime = 0
+    # tpu_batch knobs (ours):
+    tpu_rounds_per_dispatch: int = 1
+    tpu_max_batch: int = 65536  # static padded packet-batch size per round
+    tpu_mesh_shards: int = 0  # 0 = all local devices
+
+
+@dataclass
+class ConfigOptions:
+    general: GeneralOptions = field(default_factory=GeneralOptions)
+    network: dict = field(default_factory=lambda: {"graph": {"type": "1_gbit_switch"}})
+    experimental: ExperimentalOptions = field(default_factory=ExperimentalOptions)
+    hosts: list[HostOptions] = field(default_factory=list)
+
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ValueError(f"config error: {msg}")
+
+
+def _parse_process(p: dict) -> ProcessOptions:
+    _require(isinstance(p, dict), f"process entry must be a mapping, got {p!r}")
+    _require("path" in p, f"process entry missing 'path': {p!r}")
+    args = p.get("args", [])
+    if isinstance(args, str):
+        args = args.split()
+    env = p.get("environment", {}) or {}
+    _require(isinstance(env, dict), "process environment must be a mapping")
+    return ProcessOptions(
+        path=str(p["path"]),
+        args=[str(a) for a in args],
+        environment={str(k): str(v) for k, v in env.items()},
+        start_time=parse_time(p.get("start_time", 0)),
+        shutdown_time=(parse_time(p["shutdown_time"]) if p.get("shutdown_time") is not None else None),
+        shutdown_signal=str(p.get("shutdown_signal", "SIGTERM")),
+        expected_final_state=p.get("expected_final_state"),
+    )
+
+
+def _parse_host(name: str, h: dict) -> HostOptions:
+    _require(isinstance(h, dict), f"host {name!r} must be a mapping")
+    opts = HostOptions(name=name)
+    opts.network_node_id = int(h.get("network_node_id", 0))
+    opts.ip_addr = h.get("ip_addr")
+    if h.get("bandwidth_up") is not None:
+        opts.bandwidth_up = parse_bandwidth(h["bandwidth_up"])
+    if h.get("bandwidth_down") is not None:
+        opts.bandwidth_down = parse_bandwidth(h["bandwidth_down"])
+    if h.get("log_level") is not None:
+        opts.log_level = str(h["log_level"]).lower()
+        _require(opts.log_level in LOG_LEVELS, f"bad log_level {opts.log_level!r}")
+    opts.pcap_enabled = bool(h.get("pcap_enabled", False))
+    opts.pcap_capture_size = parse_size(h.get("pcap_capture_size", 65535))
+    procs = h.get("processes", [])
+    _require(isinstance(procs, list), f"host {name!r} processes must be a list")
+    opts.processes = [_parse_process(p) for p in procs]
+    return opts
+
+
+def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
+    """Parse a loaded YAML document (plus dotted-key CLI overrides) into
+    validated ConfigOptions.
+
+    ``overrides`` maps dotted paths to raw values, e.g.
+    ``{"general.stop_time": "30s", "experimental.scheduler_policy": "tpu_batch"}``.
+    """
+    doc = copy.deepcopy(doc) if doc else {}
+    _require(isinstance(doc, dict), "top-level config must be a mapping")
+    for key, val in (overrides or {}).items():
+        parts = key.split(".")
+        cur = doc
+        for p in parts[:-1]:
+            cur = cur.setdefault(p, {})
+            _require(isinstance(cur, dict), f"cannot override {key!r}")
+        cur[parts[-1]] = val
+
+    cfg = ConfigOptions()
+
+    gen = doc.get("general", {}) or {}
+    _require("stop_time" in gen, "general.stop_time is required")
+    g = cfg.general
+    g.stop_time = parse_time(gen["stop_time"])
+    _require(g.stop_time > 0, "general.stop_time must be > 0")
+    g.seed = int(gen.get("seed", 1))
+    g.parallelism = int(gen.get("parallelism", 0))
+    g.bootstrap_end_time = parse_time(gen.get("bootstrap_end_time", 0))
+    g.data_directory = str(gen.get("data_directory", "shadow.data"))
+    g.log_level = str(gen.get("log_level", "info")).lower()
+    _require(g.log_level in LOG_LEVELS, f"bad general.log_level {g.log_level!r}")
+    if gen.get("heartbeat_interval") is not None:
+        g.heartbeat_interval = parse_time(gen["heartbeat_interval"])
+    g.progress = bool(gen.get("progress", False))
+    g.model_unblocked_syscall_latency = bool(gen.get("model_unblocked_syscall_latency", False))
+
+    if doc.get("network"):
+        cfg.network = doc["network"]
+    _require("graph" in cfg.network, "network.graph is required")
+
+    exp = doc.get("experimental", {}) or {}
+    e = cfg.experimental
+    e.scheduler_policy = str(exp.get("scheduler_policy", "thread_per_core"))
+    _require(
+        e.scheduler_policy in SCHEDULER_POLICIES,
+        f"scheduler_policy must be one of {SCHEDULER_POLICIES}, got {e.scheduler_policy!r}",
+    )
+    if exp.get("runahead") is not None:
+        e.runahead = parse_time(exp["runahead"])
+        _require(e.runahead > 0, "experimental.runahead must be > 0")
+    e.use_dynamic_runahead = bool(exp.get("use_dynamic_runahead", False))
+    e.socket_send_buffer = parse_size(exp.get("socket_send_buffer", e.socket_send_buffer))
+    e.socket_recv_buffer = parse_size(exp.get("socket_recv_buffer", e.socket_recv_buffer))
+    e.strace_logging_mode = str(exp.get("strace_logging_mode", "off"))
+    e.interface_qdisc = str(exp.get("interface_qdisc", "fifo"))
+    e.max_unapplied_cpu_latency = parse_time(exp.get("max_unapplied_cpu_latency", 0))
+    e.tpu_rounds_per_dispatch = int(exp.get("tpu_rounds_per_dispatch", 1))
+    e.tpu_max_batch = int(exp.get("tpu_max_batch", 65536))
+    e.tpu_mesh_shards = int(exp.get("tpu_mesh_shards", 0))
+
+    hosts_doc = doc.get("hosts", {}) or {}
+    _require(isinstance(hosts_doc, dict), "hosts must be a mapping of name -> options")
+    _require(len(hosts_doc) > 0, "at least one host is required")
+    for name in hosts_doc:  # dict preserves YAML order -> deterministic host ids
+        h = hosts_doc[name] or {}
+        qty = int(h.pop("quantity", 1)) if isinstance(h, dict) else 1
+        if qty == 1:
+            cfg.hosts.append(_parse_host(str(name), h))
+        else:
+            _require(qty > 1, f"host {name!r} quantity must be >= 1")
+            for i in range(qty):
+                cfg.hosts.append(_parse_host(f"{name}{i}", h))
+    names = [h.name for h in cfg.hosts]
+    _require(len(set(names)) == len(names), "duplicate host names after expansion")
+    return cfg
+
+
+def load_config(path: str, overrides: Optional[dict] = None) -> ConfigOptions:
+    with open(path, "r") as f:
+        doc = yaml.safe_load(f)
+    return parse_config(doc, overrides)

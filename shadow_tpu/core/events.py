@@ -1,0 +1,66 @@
+"""Per-host event queues.
+
+Mirrors the reference's ``src/main/core/work`` event machinery (SURVEY.md §2
+"Event queue / events"): an event is (time, task) on a specific host; each
+host owns a priority queue; determinism comes from a total order on
+(time, host_id, sequence-number-of-insertion).
+
+Events never move between hosts: cross-host interactions (packets) are always
+scheduled onto the destination host's queue at a time >= one round ahead, the
+conservative-PDES invariant (SURVEY.md §2 "Parallelism strategies" item 4).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Callable, Optional
+
+from shadow_tpu.core.time import SimTime, T_NEVER
+
+
+class EventQueue:
+    """Min-heap of (time, seq, task) for one host.
+
+    ``seq`` is a per-queue monotonically increasing insertion counter; it
+    breaks ties deterministically (FIFO among same-time events) and makes the
+    heap ordering total without comparing task callables.
+    """
+
+    __slots__ = ("_heap", "_seq", "_cancelled")
+
+    def __init__(self) -> None:
+        self._heap: list[tuple[SimTime, int, Callable[[], None]]] = []
+        self._seq = 0
+        self._cancelled: set[int] = set()
+
+    def push(self, time: SimTime, task: Callable[[], None]) -> int:
+        """Schedule ``task`` at ``time``; returns a handle usable with cancel()."""
+        seq = self._seq
+        self._seq += 1
+        heapq.heappush(self._heap, (time, seq, task))
+        return seq
+
+    def cancel(self, handle: int) -> None:
+        """Lazily cancel a scheduled event (e.g. a disarmed timer)."""
+        self._cancelled.add(handle)
+
+    def next_time(self) -> SimTime:
+        """Time of the earliest pending event, or T_NEVER if empty."""
+        self._drop_cancelled_head()
+        return self._heap[0][0] if self._heap else T_NEVER
+
+    def pop_until(self, end: SimTime) -> Optional[tuple[SimTime, Callable[[], None]]]:
+        """Pop the earliest event with time < end, else None."""
+        self._drop_cancelled_head()
+        if self._heap and self._heap[0][0] < end:
+            time, _, task = heapq.heappop(self._heap)
+            return time, task
+        return None
+
+    def _drop_cancelled_head(self) -> None:
+        while self._heap and self._heap[0][1] in self._cancelled:
+            _, seq, _ = heapq.heappop(self._heap)
+            self._cancelled.discard(seq)
+
+    def __len__(self) -> int:
+        return len(self._heap) - len(self._cancelled)

@@ -1,0 +1,8 @@
+"""Network model: topology graph, routing, packets, token-bucket routers.
+
+Mirrors the reference's ``src/main/network`` + ``src/main/routing`` layers
+(SURVEY.md §1 layers 7-8). The hot paths (token buckets, latency lookup,
+loss sampling) have twin implementations: a numpy reference
+(shadow_tpu/network/fluid.py) and JAX device kernels (shadow_tpu/ops/*),
+which must agree bit-for-bit (SURVEY.md §7 phase 2 exit criteria).
+"""

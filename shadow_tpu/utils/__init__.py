@@ -1,0 +1,1 @@
+"""Utilities: unit parsing, logging, counters, heartbeat, pcap, status."""

@@ -1,0 +1,18 @@
+"""Test configuration.
+
+Tests always run on the CPU backend with 8 virtual devices so that the
+multi-chip sharding path (scheduler_policy: tpu_batch over a mesh) is
+exercised without TPU hardware — the stand-in for a pod recommended by
+SURVEY.md §4 ("multi-node without a cluster").
+
+These env vars must be set before jax is first imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,78 @@
+import pytest
+import yaml
+
+from shadow_tpu.config import parse_config
+from shadow_tpu.core.time import NS_PER_SEC
+
+MINIMAL = """
+general:
+  stop_time: 10s
+network:
+  graph:
+    type: 1_gbit_switch
+hosts:
+  client:
+    network_node_id: 0
+    processes:
+      - path: pyapp:shadow_tpu.models.echo:EchoClient
+        args: [server, "9000"]
+        start_time: 1s
+  server:
+    network_node_id: 0
+    processes:
+      - path: pyapp:shadow_tpu.models.echo:EchoServer
+        args: ["9000"]
+"""
+
+
+def test_minimal_config():
+    cfg = parse_config(yaml.safe_load(MINIMAL))
+    assert cfg.general.stop_time == 10 * NS_PER_SEC
+    assert cfg.general.seed == 1
+    assert [h.name for h in cfg.hosts] == ["client", "server"]
+    assert cfg.hosts[0].processes[0].start_time == NS_PER_SEC
+    assert cfg.hosts[0].processes[0].args == ["server", "9000"]
+    assert cfg.experimental.scheduler_policy == "thread_per_core"
+
+
+def test_overrides():
+    cfg = parse_config(
+        yaml.safe_load(MINIMAL),
+        overrides={
+            "general.stop_time": "30s",
+            "general.seed": 7,
+            "experimental.scheduler_policy": "tpu_batch",
+        },
+    )
+    assert cfg.general.stop_time == 30 * NS_PER_SEC
+    assert cfg.general.seed == 7
+    assert cfg.experimental.scheduler_policy == "tpu_batch"
+
+
+def test_quantity_expansion():
+    doc = yaml.safe_load(MINIMAL)
+    doc["hosts"]["peer"] = {"network_node_id": 0, "quantity": 3, "processes": []}
+    cfg = parse_config(doc)
+    names = [h.name for h in cfg.hosts]
+    assert names == ["client", "server", "peer0", "peer1", "peer2"]
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError, match="stop_time"):
+        parse_config({"hosts": {"a": {}}})
+    with pytest.raises(ValueError, match="scheduler_policy"):
+        parse_config(
+            yaml.safe_load(MINIMAL),
+            overrides={"experimental.scheduler_policy": "gpu_batch"},
+        )
+    with pytest.raises(ValueError, match="at least one host"):
+        parse_config({"general": {"stop_time": "1s"}, "hosts": {}})
+
+
+def test_bandwidth_override_parsing():
+    doc = yaml.safe_load(MINIMAL)
+    doc["hosts"]["client"]["bandwidth_up"] = "10 Mbit"
+    doc["hosts"]["client"]["bandwidth_down"] = "100 Mbit"
+    cfg = parse_config(doc)
+    assert cfg.hosts[0].bandwidth_up == 1_250_000
+    assert cfg.hosts[0].bandwidth_down == 12_500_000

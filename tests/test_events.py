@@ -1,0 +1,37 @@
+from shadow_tpu.core.events import EventQueue
+from shadow_tpu.core.time import T_NEVER
+
+
+def test_fifo_among_equal_times():
+    q = EventQueue()
+    order = []
+    q.push(10, lambda: order.append("a"))
+    q.push(10, lambda: order.append("b"))
+    q.push(5, lambda: order.append("c"))
+    while (ev := q.pop_until(100)) is not None:
+        ev[1]()
+    assert order == ["c", "a", "b"]
+
+
+def test_pop_until_respects_bound():
+    q = EventQueue()
+    q.push(10, lambda: None)
+    q.push(20, lambda: None)
+    assert q.pop_until(10) is None  # strictly-less-than semantics
+    assert q.pop_until(11)[0] == 10
+    assert q.next_time() == 20
+
+
+def test_cancel():
+    q = EventQueue()
+    h = q.push(10, lambda: None)
+    q.push(20, lambda: None)
+    q.cancel(h)
+    assert q.next_time() == 20
+    assert len(q) == 1
+
+
+def test_empty_queue():
+    q = EventQueue()
+    assert q.next_time() == T_NEVER
+    assert q.pop_until(T_NEVER) is None

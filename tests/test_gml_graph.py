@@ -1,0 +1,89 @@
+import numpy as np
+import pytest
+
+from shadow_tpu.core.time import NS_PER_MS
+from shadow_tpu.network.gml import parse_gml
+from shadow_tpu.network.graph import INF_I64, from_gml, load_graph, one_gbit_switch
+
+TRIANGLE = """
+graph [
+  directed 0
+  node [ id 0 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+  node [ id 1 ]
+  node [ id 2 ]
+  edge [ source 0 target 1 latency "10 ms" packet_loss 0.01 ]
+  edge [ source 1 target 2 latency "20 ms" packet_loss 0.02 ]
+  edge [ source 0 target 2 latency "50 ms" packet_loss 0.0 ]
+]
+"""
+
+
+def test_parse_gml_basics():
+    g = parse_gml(TRIANGLE)
+    assert not g.directed
+    assert len(g.nodes) == 3
+    assert len(g.edges) == 3
+    assert g.nodes[0]["host_bandwidth_up"] == "1 Gbit"
+
+
+def test_apsp_prefers_shorter_path():
+    ng = from_gml(parse_gml(TRIANGLE))
+    # 0 -> 2 direct is 50ms; via 1 it's 30ms: APSP must pick 30ms
+    assert ng.latency(0, 2) == 30 * NS_PER_MS
+    assert ng.latency(2, 0) == 30 * NS_PER_MS
+    # reliability along chosen path: (1-.01)*(1-.02)
+    assert ng.reliability_of(0, 2) == pytest.approx(0.99 * 0.98, rel=1e-6)
+    assert ng.latency(0, 1) == 10 * NS_PER_MS
+    # node defaults
+    assert ng.node_defaults[0].bandwidth_up == 125_000_000
+    assert ng.node_defaults[1].bandwidth_up is None
+
+
+def test_self_latency_defaults_to_min_adjacent():
+    ng = from_gml(parse_gml(TRIANGLE))
+    assert ng.latency(0, 0) == 10 * NS_PER_MS
+    assert ng.latency(1, 1) == 10 * NS_PER_MS
+
+
+def test_min_latency_lookahead():
+    ng = from_gml(parse_gml(TRIANGLE))
+    assert ng.min_latency_ns == 10 * NS_PER_MS
+
+
+def test_directed_graph_unreachable():
+    g = parse_gml(
+        """
+        graph [ directed 1
+          node [ id 0 ] node [ id 1 ]
+          edge [ source 0 target 1 latency "5 ms" ]
+        ]
+        """
+    )
+    ng = from_gml(g)
+    assert ng.latency(0, 1) == 5 * NS_PER_MS
+    assert not ng.reachable(1, 0)
+    assert ng.latency_ns[1, 0] == INF_I64
+
+
+def test_switch_shorthand():
+    ng = one_gbit_switch()
+    assert ng.n_nodes == 1
+    assert ng.latency(0, 0) == NS_PER_MS
+    assert ng.node_defaults[0].bandwidth_up == 125_000_000
+
+
+def test_load_graph_inline():
+    ng = load_graph({"type": "gml", "inline": TRIANGLE})
+    assert ng.n_nodes == 3
+
+
+def test_long_chain_apsp():
+    # chain of 12 nodes, 1ms per hop: tests repeated-squaring depth
+    n = 12
+    nodes = "\n".join(f"node [ id {i} ]" for i in range(n))
+    edges = "\n".join(
+        f'edge [ source {i} target {i+1} latency "1 ms" ]' for i in range(n - 1)
+    )
+    ng = from_gml(parse_gml(f"graph [ directed 0\n{nodes}\n{edges}\n]"))
+    assert ng.latency(0, n - 1) == (n - 1) * NS_PER_MS
+    assert np.all(ng.latency_ns < INF_I64)

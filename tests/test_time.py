@@ -1,0 +1,39 @@
+from shadow_tpu.core.time import (
+    EMULATED_EPOCH,
+    NS_PER_MS,
+    NS_PER_SEC,
+    NS_PER_US,
+    emulated,
+    format_time,
+    parse_time,
+)
+
+
+def test_parse_bare_numbers_are_seconds():
+    assert parse_time(10) == 10 * NS_PER_SEC
+    assert parse_time(0.5) == NS_PER_SEC // 2
+    assert parse_time("2") == 2 * NS_PER_SEC
+
+
+def test_parse_units():
+    assert parse_time("10 ms") == 10 * NS_PER_MS
+    assert parse_time("10ms") == 10 * NS_PER_MS
+    assert parse_time("500 us") == 500 * NS_PER_US
+    assert parse_time("100 ns") == 100
+    assert parse_time("3 s") == 3 * NS_PER_SEC
+    assert parse_time("10 seconds") == 10 * NS_PER_SEC
+    assert parse_time("1 min") == 60 * NS_PER_SEC
+    assert parse_time("2 hours") == 7200 * NS_PER_SEC
+    assert parse_time("1.5s") == NS_PER_SEC * 3 // 2
+
+
+def test_emulated_clock_offset():
+    assert emulated(0) == EMULATED_EPOCH
+    assert emulated(5 * NS_PER_SEC) - EMULATED_EPOCH == 5 * NS_PER_SEC
+
+
+def test_format_roundtrippish():
+    assert format_time(999) == "999ns"
+    assert "us" in format_time(1500)
+    assert "ms" in format_time(2 * NS_PER_MS)
+    assert "s" in format_time(3 * NS_PER_SEC)

@@ -1,0 +1,31 @@
+import pytest
+
+from shadow_tpu.utils.units import parse_bandwidth, parse_size
+
+
+def test_bandwidth_bits():
+    assert parse_bandwidth("1 Gbit") == 125_000_000
+    assert parse_bandwidth("10 Mbit") == 1_250_000
+    assert parse_bandwidth("100 kbit") == 12_500
+    assert parse_bandwidth("1 Gbit/s") == 125_000_000
+    assert parse_bandwidth("100 Mbps") == 12_500_000
+
+
+def test_bandwidth_bytes():
+    assert parse_bandwidth("125 MB") == 125_000_000
+    assert parse_bandwidth("1 MiB") == 2**20
+    assert parse_bandwidth(1000) == 1000
+
+
+def test_sizes():
+    assert parse_size("16 MiB") == 16 * 2**20
+    assert parse_size("64 kB") == 64_000
+    assert parse_size(512) == 512
+    assert parse_size("131072") == 131072
+
+
+def test_bad_units():
+    with pytest.raises(ValueError):
+        parse_bandwidth("10 parsecs")
+    with pytest.raises(ValueError):
+        parse_size("1 lightyear")
