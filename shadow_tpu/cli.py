@@ -1,0 +1,119 @@
+"""Command-line entry point.
+
+Reference analog: the ``shadow [options] config.yaml`` binary (SURVEY.md §1
+layer 1). Common options get first-class flags; every config option is
+reachable via ``--set dotted.path=value`` (the CLI-overrides-YAML contract
+of SURVEY.md §5.6).
+
+Usage:
+    python -m shadow_tpu [flags] config.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="shadow_tpu",
+        description="TPU-native discrete-event network simulator",
+    )
+    p.add_argument("config", help="simulation YAML config file")
+    p.add_argument("--stop-time", help="override general.stop_time")
+    p.add_argument("--seed", type=int, help="override general.seed")
+    p.add_argument("--parallelism", type=int, help="override general.parallelism")
+    p.add_argument("--log-level", help="override general.log_level")
+    p.add_argument("--data-directory", help="override general.data_directory")
+    p.add_argument(
+        "--scheduler-policy",
+        choices=["thread_per_core", "thread_per_host", "tpu_batch"],
+        help="override experimental.scheduler_policy",
+    )
+    p.add_argument(
+        "--set",
+        action="append",
+        default=[],
+        metavar="KEY=VALUE",
+        help="override any config option by dotted path "
+        "(e.g. --set experimental.runahead=5ms); repeatable",
+    )
+    p.add_argument(
+        "--show-config", action="store_true",
+        help="print the resolved configuration and exit",
+    )
+    p.add_argument(
+        "--json-summary", action="store_true",
+        help="print the end-of-run summary as one JSON line on stdout",
+    )
+    p.add_argument("--quiet", action="store_true", help="no log mirroring to stderr")
+    return p
+
+
+def overrides_from_args(args: argparse.Namespace) -> dict:
+    ov: dict = {}
+    flag_map = {
+        "stop_time": "general.stop_time",
+        "seed": "general.seed",
+        "parallelism": "general.parallelism",
+        "log_level": "general.log_level",
+        "data_directory": "general.data_directory",
+        "scheduler_policy": "experimental.scheduler_policy",
+    }
+    for attr, key in flag_map.items():
+        val = getattr(args, attr)
+        if val is not None:
+            ov[key] = val
+    for item in args.set:
+        if "=" not in item:
+            raise SystemExit(f"--set expects KEY=VALUE, got {item!r}")
+        k, v = item.split("=", 1)
+        import yaml as _yaml
+
+        ov[k] = _yaml.safe_load(v)
+    return ov
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from shadow_tpu.config import load_config
+    from shadow_tpu.core.controller import Controller
+
+    try:
+        cfg = load_config(args.config, overrides_from_args(args))
+    except FileNotFoundError:
+        print(f"shadow_tpu: config file not found: {args.config}", file=sys.stderr)
+        return 2
+    except ValueError as exc:
+        print(f"shadow_tpu: {exc}", file=sys.stderr)
+        return 2
+    if args.show_config:
+        import dataclasses
+
+        def enc(o):
+            if dataclasses.is_dataclass(o):
+                return dataclasses.asdict(o)
+            return str(o)
+
+        print(json.dumps(
+            {
+                "general": dataclasses.asdict(cfg.general),
+                "network": cfg.network,
+                "experimental": dataclasses.asdict(cfg.experimental),
+                "hosts": [dataclasses.asdict(h) for h in cfg.hosts],
+            },
+            indent=2, default=enc,
+        ))
+        return 0
+
+    controller = Controller(cfg, mirror_log=not args.quiet)
+    result = controller.run()
+    if args.json_summary:
+        print(json.dumps(result))
+    return 1 if result["process_errors"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,7 +103,7 @@ def _parse_process(p: dict) -> ProcessOptions:
         args = args.split()
     env = p.get("environment", {}) or {}
     _require(isinstance(env, dict), "process environment must be a mapping")
-    return ProcessOptions(
+    opts = ProcessOptions(
         path=str(p["path"]),
         args=[str(a) for a in args],
         environment={str(k): str(v) for k, v in env.items()},
@@ -112,6 +112,12 @@ def _parse_process(p: dict) -> ProcessOptions:
         shutdown_signal=str(p.get("shutdown_signal", "SIGTERM")),
         expected_final_state=p.get("expected_final_state"),
     )
+    _require(opts.start_time >= 0, f"process start_time must be >= 0: {p!r}")
+    _require(
+        opts.shutdown_time is None or opts.shutdown_time > opts.start_time,
+        f"process shutdown_time must be after start_time: {p!r}",
+    )
+    return opts
 
 
 def _parse_host(name: str, h: dict) -> HostOptions:
@@ -121,8 +127,10 @@ def _parse_host(name: str, h: dict) -> HostOptions:
     opts.ip_addr = h.get("ip_addr")
     if h.get("bandwidth_up") is not None:
         opts.bandwidth_up = parse_bandwidth(h["bandwidth_up"])
+        _require(opts.bandwidth_up > 0, f"host {name!r} bandwidth_up must be > 0")
     if h.get("bandwidth_down") is not None:
         opts.bandwidth_down = parse_bandwidth(h["bandwidth_down"])
+        _require(opts.bandwidth_down > 0, f"host {name!r} bandwidth_down must be > 0")
     if h.get("log_level") is not None:
         opts.log_level = str(h["log_level"]).lower()
         _require(opts.log_level in LOG_LEVELS, f"bad log_level {opts.log_level!r}")
@@ -159,13 +167,17 @@ def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
     g.stop_time = parse_time(gen["stop_time"])
     _require(g.stop_time > 0, "general.stop_time must be > 0")
     g.seed = int(gen.get("seed", 1))
+    _require(0 <= g.seed < (1 << 63), "general.seed must be in [0, 2**63)")
     g.parallelism = int(gen.get("parallelism", 0))
+    _require(g.parallelism >= 0, "general.parallelism must be >= 0")
     g.bootstrap_end_time = parse_time(gen.get("bootstrap_end_time", 0))
+    _require(g.bootstrap_end_time >= 0, "general.bootstrap_end_time must be >= 0")
     g.data_directory = str(gen.get("data_directory", "shadow.data"))
     g.log_level = str(gen.get("log_level", "info")).lower()
     _require(g.log_level in LOG_LEVELS, f"bad general.log_level {g.log_level!r}")
     if gen.get("heartbeat_interval") is not None:
         g.heartbeat_interval = parse_time(gen["heartbeat_interval"])
+        _require(g.heartbeat_interval > 0, "general.heartbeat_interval must be > 0")
     g.progress = bool(gen.get("progress", False))
     g.model_unblocked_syscall_latency = bool(gen.get("model_unblocked_syscall_latency", False))
 

@@ -1,0 +1,237 @@
+"""The Controller: owns and drives one simulation.
+
+Reference analog: ``Controller::run()`` -> ``Manager::run()`` -> round loop
+(SURVEY.md §3.1). Responsibilities: load the topology, compute the
+conservative lookahead (round width = min edge latency, overridable with
+``experimental.runahead``), build hosts and their processes, drive the
+round loop through the configured scheduler policy, and produce the output
+tree + end-of-run summary.
+
+Round-loop structure (the conservative PDES core):
+
+    while now < stop:
+        engine.start_of_round(now)        # token refills, deferred ingress
+        scheduler.run_round(round_end)    # per-host events, parallel-safe
+        engine.end_of_round(now, end)     # the barrier: batched data plane
+        now = round_end (or skip ahead through provably idle time)
+
+Skip-ahead: when a round executed zero events and the engine holds no
+pending units, the controller jumps the clock to the next scheduled event —
+idle sim time costs nothing (the token buckets refill by elapsed time, so
+results are identical to grinding through empty rounds).
+"""
+
+from __future__ import annotations
+
+import time as _walltime
+from pathlib import Path
+
+import numpy as np
+
+from shadow_tpu.config.schema import ConfigOptions
+from shadow_tpu.core.scheduler import make_scheduler
+from shadow_tpu.core.time import NS_PER_SEC, NS_PER_US, SimTime, T_NEVER, format_time
+from shadow_tpu.host.host import Host
+from shadow_tpu.host.process import PluginProcess
+from shadow_tpu.network.engine import NetworkEngine
+from shadow_tpu.network.fluid import NetParams
+from shadow_tpu.network.graph import load_graph
+from shadow_tpu.utils.counters import Counters
+from shadow_tpu.utils.logging import SimLogger
+from shadow_tpu.utils.units import parse_bandwidth
+
+DEFAULT_BANDWIDTH = parse_bandwidth("1 Gbit")
+
+
+class Controller:
+    def __init__(self, cfg: ConfigOptions, mirror_log: bool = True) -> None:
+        self.cfg = cfg
+        self.data_dir = Path(cfg.general.data_directory)
+        self.log = SimLogger(cfg.general.log_level, self.data_dir / "shadow.log",
+                             mirror_stderr=mirror_log)
+        self.graph = load_graph(cfg.network["graph"])
+
+        # conservative lookahead: round width <= min latency keeps every
+        # cross-host arrival at least one round in the future (SURVEY.md §2
+        # parallelism item 4). An explicit runahead overrides (arrivals then
+        # clamp to the next round boundary — coarser, faster, still causal).
+        w = self.graph.min_latency_ns
+        if cfg.experimental.runahead is not None:
+            w = cfg.experimental.runahead
+        self.round_ns: SimTime = max(int(w), NS_PER_US)
+
+        self.hosts: list[Host] = []
+        self._by_name: dict[str, int] = {}
+        self._by_ip: dict[str, int] = {}
+        rate_up = np.zeros(len(cfg.hosts), dtype=np.int64)
+        rate_down = np.zeros(len(cfg.hosts), dtype=np.int64)
+        host_node = np.zeros(len(cfg.hosts), dtype=np.int32)
+        for hid, hopts in enumerate(cfg.hosts):
+            node_gml_id = hopts.network_node_id
+            if node_gml_id not in self.graph.node_id_map:
+                raise ValueError(
+                    f"host {hopts.name!r}: network_node_id {node_gml_id} not in graph"
+                )
+            node = self.graph.node_id_map[node_gml_id]
+            defaults = self.graph.node_defaults[node]
+            up = hopts.bandwidth_up or defaults.bandwidth_up
+            down = hopts.bandwidth_down or defaults.bandwidth_down
+            if up is None or down is None:
+                self.log.warning(
+                    f"host {hopts.name!r}: no bandwidth configured on host or "
+                    f"graph node; defaulting to 1 Gbit"
+                )
+                up = up or DEFAULT_BANDWIDTH
+                down = down or DEFAULT_BANDWIDTH
+            ip = hopts.ip_addr or _default_ip(hid)
+            host = Host(hid, hopts.name, ip, node, cfg.general.seed, self)
+            self.hosts.append(host)
+            self._by_name[hopts.name] = hid
+            self._by_ip[ip] = hid
+            rate_up[hid] = up
+            rate_down[hid] = down
+            host_node[hid] = node
+
+        params = NetParams.build(
+            host_node=host_node,
+            rate_up=rate_up,
+            rate_down=rate_down,
+            latency_ns=self.graph.latency_ns,
+            reliability=self.graph.reliability,
+            seed=cfg.general.seed,
+            round_ns=self.round_ns,
+        )
+        policy = cfg.experimental.scheduler_policy
+        backend = "tpu" if policy == "tpu_batch" else "numpy"
+        self.engine = NetworkEngine(
+            self.graph, params, self.hosts, self.round_ns, backend=backend,
+            tpu_options=cfg.experimental,
+        )
+        for h in self.hosts:
+            h.engine = self.engine
+        self.scheduler = make_scheduler(policy, self.hosts, cfg.general.parallelism)
+
+        # processes
+        self.processes: list[PluginProcess] = []
+        for host, hopts in zip(self.hosts, cfg.hosts):
+            for i, popts in enumerate(hopts.processes):
+                if not PluginProcess.is_plugin_path(popts.path):
+                    raise NotImplementedError(
+                        f"host {hopts.name!r}: real managed executables "
+                        f"({popts.path!r}) require the native shim (phase 4, "
+                        f"SURVEY.md §7); use a pyapp: plugin path"
+                    )
+                proc = PluginProcess(host, popts, i)
+                host.processes.append(proc)
+                self.processes.append(proc)
+                host.schedule(popts.start_time, proc.spawn)
+                if popts.shutdown_time is not None:
+                    host.schedule(popts.shutdown_time, proc.shutdown)
+
+        self.counters = Counters()
+        self.rounds = 0
+        self.events = 0
+        self.wall_seconds = 0.0
+
+    # -- naming -----------------------------------------------------------
+    def resolve(self, name_or_ip) -> int:
+        if isinstance(name_or_ip, int):
+            return name_or_ip
+        hid = self._by_name.get(name_or_ip)
+        if hid is None:
+            hid = self._by_ip.get(name_or_ip)
+        if hid is None:
+            raise KeyError(f"unknown host {name_or_ip!r}")
+        return hid
+
+    # -- main loop --------------------------------------------------------
+    def run(self) -> dict:
+        cfg = self.cfg
+        stop = cfg.general.stop_time
+        w = self.round_ns
+        self.log.info(
+            f"simulation starting: {len(self.hosts)} hosts, "
+            f"{self.graph.n_nodes} graph nodes, round width {format_time(w)}, "
+            f"policy {cfg.experimental.scheduler_policy}, stop {format_time(stop)}"
+        )
+        hb_interval = cfg.general.heartbeat_interval
+        next_hb = hb_interval if hb_interval else T_NEVER
+        t0 = _walltime.perf_counter()
+        now: SimTime = 0
+        while now < stop:
+            round_end = min(now + w, stop)
+            self.engine.start_of_round(now)
+            executed = self.scheduler.run_round(round_end)
+            self.engine.end_of_round(now, round_end)
+            self.rounds += 1
+            self.events += executed
+            if round_end >= next_hb:
+                self._heartbeat(round_end, t0)
+                next_hb += hb_interval
+            if executed == 0 and not self.engine.has_pending():
+                nt = min((h.equeue.next_time() for h in self.hosts), default=T_NEVER)
+                if nt >= T_NEVER:
+                    self.log.info(
+                        f"no further events at {format_time(round_end)}; ending early"
+                    )
+                    now = stop
+                    break
+                now = max(round_end, nt)
+            else:
+                now = round_end
+        self.wall_seconds = _walltime.perf_counter() - t0
+        self.scheduler.shutdown()
+        return self._finalize(min(now, stop))
+
+    def _heartbeat(self, sim_now: SimTime, t0: float) -> None:
+        wall = _walltime.perf_counter() - t0
+        rate = (sim_now / NS_PER_SEC) / wall if wall > 0 else 0.0
+        self.log.info(
+            f"heartbeat: sim {format_time(sim_now)} wall {wall:.1f}s "
+            f"({rate:.2f} sim-sec/wall-sec) rounds {self.rounds} "
+            f"events {self.events} units sent {self.engine.units_sent} "
+            f"dropped {self.engine.units_dropped}"
+        )
+
+    def _finalize(self, end_time: SimTime) -> dict:
+        for h in self.hosts:
+            self.counters.merge(h.counters)
+        errors = []
+        for p in self.processes:
+            err = p.check_final_state()
+            if err is not None:
+                errors.append(err)
+                self.log.error(err)
+        sim_sec = end_time / NS_PER_SEC
+        rate = sim_sec / self.wall_seconds if self.wall_seconds > 0 else float("inf")
+        self.log.info(
+            f"simulation finished: sim {format_time(end_time)} in "
+            f"{self.wall_seconds:.2f}s wall ({rate:.2f} sim-sec/wall-sec), "
+            f"{self.rounds} rounds, {self.events} events, "
+            f"{self.engine.units_sent} units delivered, "
+            f"{self.engine.units_dropped} dropped"
+        )
+        self.log.info(self.counters.summary())
+        self.data_dir.mkdir(parents=True, exist_ok=True)
+        for h in self.hosts:
+            h.flush_logs(self.data_dir)
+        self.log.flush()
+        return {
+            "sim_seconds": sim_sec,
+            "wall_seconds": self.wall_seconds,
+            "sim_sec_per_wall_sec": rate,
+            "rounds": self.rounds,
+            "events": self.events,
+            "units_sent": self.engine.units_sent,
+            "units_dropped": self.engine.units_dropped,
+            "bytes_sent": self.engine.bytes_sent,
+            "counters": self.counters.as_dict(),
+            "process_errors": errors,
+        }
+
+
+def _default_ip(host_id: int) -> str:
+    # 11.0.0.0/8, sequential, skipping .0 and .255 host-octet edge cases
+    n = host_id + 1
+    a = 11 + (n >> 24)
+    return f"{a}.{(n >> 16) & 0xFF}.{(n >> 8) & 0xFF}.{n & 0xFF}"

@@ -26,11 +26,12 @@ class EventQueue:
     heap ordering total without comparing task callables.
     """
 
-    __slots__ = ("_heap", "_seq", "_cancelled")
+    __slots__ = ("_heap", "_seq", "_live", "_cancelled")
 
     def __init__(self) -> None:
         self._heap: list[tuple[SimTime, int, Callable[[], None]]] = []
         self._seq = 0
+        self._live: set[int] = set()  # seqs pushed and not yet popped
         self._cancelled: set[int] = set()
 
     def push(self, time: SimTime, task: Callable[[], None]) -> int:
@@ -38,11 +39,15 @@ class EventQueue:
         seq = self._seq
         self._seq += 1
         heapq.heappush(self._heap, (time, seq, task))
+        self._live.add(seq)
         return seq
 
     def cancel(self, handle: int) -> None:
-        """Lazily cancel a scheduled event (e.g. a disarmed timer)."""
-        self._cancelled.add(handle)
+        """Lazily cancel a scheduled event (e.g. a disarmed timer). A no-op
+        if the event already ran — cancelling a fired timer is the normal
+        disarm pattern and must not corrupt the queue."""
+        if handle in self._live:
+            self._cancelled.add(handle)
 
     def next_time(self) -> SimTime:
         """Time of the earliest pending event, or T_NEVER if empty."""
@@ -53,7 +58,8 @@ class EventQueue:
         """Pop the earliest event with time < end, else None."""
         self._drop_cancelled_head()
         if self._heap and self._heap[0][0] < end:
-            time, _, task = heapq.heappop(self._heap)
+            time, seq, task = heapq.heappop(self._heap)
+            self._live.discard(seq)
             return time, task
         return None
 
@@ -61,6 +67,7 @@ class EventQueue:
         while self._heap and self._heap[0][1] in self._cancelled:
             _, seq, _ = heapq.heappop(self._heap)
             self._cancelled.discard(seq)
+            self._live.discard(seq)
 
     def __len__(self) -> int:
         return len(self._heap) - len(self._cancelled)

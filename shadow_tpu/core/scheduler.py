@@ -1,0 +1,150 @@
+"""Scheduler policies: parallel host execution within rounds.
+
+Mirrors the reference's scheduler crate (SURVEY.md §1 layer 4, §2
+"Scheduler (policies)") with three policies behind one interface:
+
+- ``thread_per_core``: a fixed pool of worker threads; hosts are sharded
+  across them each round (the reference's CPU baseline policy).
+- ``thread_per_host``: one persistent thread per host, parked between
+  rounds (cache-locality policy for small host counts).
+- ``tpu_batch``: hosts run on the main thread; the per-round network data
+  plane runs as JAX kernels on the device (this package's reason to exist;
+  see shadow_tpu/parallel/).
+
+Correctness note: within a round, a host's events touch only that host's
+state; cross-host effects flow exclusively through the engine at the round
+barrier. So any assignment of hosts to threads yields identical results —
+the determinism tests (tests/test_determinism.py) assert this across
+policies.
+
+CPython's GIL means thread policies don't add real CPU parallelism for pure-
+Python workloads; they exist for structural parity with the reference and
+become genuinely parallel in phase 4 when hosts block on native managed-
+process IPC (GIL released in ctypes/syscall waits, SURVEY.md §7 phase 4).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Sequence
+
+from shadow_tpu.core.time import SimTime
+
+
+class SerialScheduler:
+    """Hosts executed in host-id order on the calling thread."""
+
+    name = "serial"
+
+    def __init__(self, hosts: Sequence) -> None:
+        self.hosts = hosts
+
+    def run_round(self, round_end: SimTime) -> int:
+        n = 0
+        for h in self.hosts:
+            n += h.run_events(round_end)
+        return n
+
+    def shutdown(self) -> None:
+        pass
+
+
+class ThreadPerCoreScheduler:
+    """Fixed worker pool; hosts chunked across it each round."""
+
+    name = "thread_per_core"
+
+    def __init__(self, hosts: Sequence, nthreads: int) -> None:
+        self.hosts = hosts
+        self.nthreads = max(1, nthreads)
+        self.pool = ThreadPoolExecutor(
+            max_workers=self.nthreads, thread_name_prefix="shadow-worker"
+        )
+        # static host -> shard assignment (reference: fixed sharding keeps
+        # determinism trivially; work stealing is unnecessary because the
+        # engine barrier dominates imbalance at realistic host counts)
+        self.shards = [list(hosts[i :: self.nthreads]) for i in range(self.nthreads)]
+
+    def _run_shard(self, shard, round_end: SimTime) -> int:
+        n = 0
+        for h in shard:
+            n += h.run_events(round_end)
+        return n
+
+    def run_round(self, round_end: SimTime) -> int:
+        futs = [
+            self.pool.submit(self._run_shard, shard, round_end)
+            for shard in self.shards
+            if shard
+        ]
+        return sum(f.result() for f in futs)
+
+    def shutdown(self) -> None:
+        self.pool.shutdown(wait=False)
+
+
+class ThreadPerHostScheduler:
+    """One persistent parked thread per host, woken each round."""
+
+    name = "thread_per_host"
+
+    def __init__(self, hosts: Sequence) -> None:
+        self.hosts = hosts
+        self._round_end: SimTime = 0
+        self._go = [threading.Event() for _ in hosts]
+        self._done = [threading.Event() for _ in hosts]
+        self._stop = False
+        self._counts = [0] * len(hosts)
+        self._threads = [
+            threading.Thread(
+                target=self._loop, args=(i,), name=f"shadow-host-{h.name}", daemon=True
+            )
+            for i, h in enumerate(hosts)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _loop(self, i: int) -> None:
+        while True:
+            self._go[i].wait()
+            self._go[i].clear()
+            if self._stop:
+                return
+            self._counts[i] = self.hosts[i].run_events(self._round_end)
+            self._done[i].set()
+
+    def run_round(self, round_end: SimTime) -> int:
+        self._round_end = round_end
+        for ev in self._go:
+            ev.set()
+        for ev in self._done:
+            ev.wait()
+            ev.clear()
+        return sum(self._counts)
+
+    def shutdown(self) -> None:
+        self._stop = True
+        for ev in self._go:
+            ev.set()
+
+
+def make_scheduler(policy: str, hosts: Sequence, parallelism: int):
+    if policy == "thread_per_core":
+        import os
+
+        n = parallelism if parallelism > 0 else (os.cpu_count() or 1)
+        return ThreadPerCoreScheduler(hosts, n)
+    if policy == "thread_per_host":
+        if len(hosts) > 2048:
+            raise ValueError(
+                f"thread_per_host with {len(hosts)} hosts would create too many "
+                "OS threads; use thread_per_core or tpu_batch"
+            )
+        return ThreadPerHostScheduler(hosts)
+    if policy == "tpu_batch":
+        # host events run serially on the main thread; the data plane is on
+        # the device. (Event execution overlap with device steps comes from
+        # dispatch asynchrony, not Python threads.)
+        return SerialScheduler(hosts)
+    raise ValueError(f"unknown scheduler policy {policy!r}")

@@ -1,0 +1,156 @@
+"""Simulated hosts.
+
+A Host mirrors the reference's ``Host`` (SURVEY.md §2 "Host"): a simulated
+machine with its own clock view, RNG stream, NIC token-bucket state (held in
+the engine's arrays, indexed by host id), socket namespace, event queue, and
+processes. All Host state is host-local: scheduler policies may execute
+different hosts' events on different threads within a round; cross-host
+interaction happens only through the network engine at round boundaries.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from shadow_tpu.core.events import EventQueue
+from shadow_tpu.core.rng import host_rng
+from shadow_tpu.core.time import SimTime
+from shadow_tpu.network import unit as U
+from shadow_tpu.network.transport import DatagramSocket, StreamEndpoint, ESTABLISHED
+from shadow_tpu.network.unit import Unit
+from shadow_tpu.utils.counters import Counters
+
+EPHEMERAL_BASE = 49152
+
+
+class Host:
+    def __init__(self, host_id: int, name: str, ip: str, node_id: int,
+                 seed: int, controller) -> None:
+        self.id = host_id
+        self.name = name
+        self.ip = ip
+        self.node_id = node_id
+        self.controller = controller
+        self.engine = None  # set by controller after engine construction
+        self.rng = host_rng(seed, host_id)
+        self.equeue = EventQueue()
+        self.counters = Counters()
+        self._now: SimTime = 0
+        self._uid_counter = 0
+        self.egress: list[Unit] = []  # units emitted this round (FIFO)
+        self.ingress_deferred: list[Unit] = []  # ingress-bucket backlog
+        self.processes: list = []
+        # sockets
+        self._listeners: dict[int, Callable] = {}  # port -> on_accept
+        self._udp: dict[int, DatagramSocket] = {}
+        self._conns: dict[tuple[int, int, int], StreamEndpoint] = {}
+        self._next_ephemeral = EPHEMERAL_BASE
+        self._log_lines: list[str] = []
+
+    # -- time & events ----------------------------------------------------
+    @property
+    def now(self) -> SimTime:
+        return self._now
+
+    def schedule(self, time: SimTime, fn: Callable[[], None]) -> int:
+        return self.equeue.push(time, fn)
+
+    def schedule_in(self, delay: SimTime, fn: Callable[[], None]) -> int:
+        return self.equeue.push(self._now + delay, fn)
+
+    def cancel(self, handle: int) -> None:
+        self.equeue.cancel(handle)
+
+    def run_events(self, end: SimTime) -> int:
+        """Execute all pending events with time < end (one round's worth)."""
+        n = 0
+        while (ev := self.equeue.pop_until(end)) is not None:
+            self._now, task = ev
+            task()
+            n += 1
+        self.counters.add("events", n)
+        return n
+
+    # -- units ------------------------------------------------------------
+    def next_uid(self) -> int:
+        uid = (self.id << 40) | self._uid_counter
+        self._uid_counter += 1
+        return uid
+
+    def emit_unit(self, u: Unit) -> None:
+        self.egress.append(u)
+        self.counters.add("units_emitted", 1)
+
+    def deliver(self, u: Unit, now: SimTime) -> None:
+        """A unit cleared the ingress token bucket: dispatch to a socket."""
+        self._now = max(self._now, now)
+        self.counters.add("units_delivered", 1)
+        if u.kind == U.DGRAM:
+            sock = self._udp.get(u.dst_port)
+            if sock is not None:
+                sock.handle(u, now)
+            else:
+                self.counters.add("units_unroutable", 1)
+            return
+        key = (u.dst_port, u.src, u.src_port)
+        ep = self._conns.get(key)
+        if ep is None and u.kind == U.SYN:
+            on_accept = self._listeners.get(u.dst_port)
+            if on_accept is None:
+                self.counters.add("units_unroutable", 1)
+                return
+            ep = StreamEndpoint(self, u.dst_port, u.src, u.src_port, initiator=False)
+            ep.state = ESTABLISHED
+            self._conns[key] = ep
+            ep.emit(U.SYNACK)
+            on_accept(ep, now)
+            return
+        if ep is None:
+            self.counters.add("units_unroutable", 1)
+            return
+        ep.handle(u, now)
+
+    # -- sockets ----------------------------------------------------------
+    def ephemeral_port(self) -> int:
+        p = self._next_ephemeral
+        self._next_ephemeral += 1
+        return p
+
+    def listen(self, port: int, on_accept: Callable) -> None:
+        if port in self._listeners:
+            raise ValueError(f"{self.name}: port {port} already listening")
+        self._listeners[port] = on_accept
+
+    def connect(self, remote_host: int, remote_port: int) -> StreamEndpoint:
+        ep = StreamEndpoint(self, self.ephemeral_port(), remote_host,
+                            remote_port, initiator=True)
+        self._conns[(ep.local_port, remote_host, remote_port)] = ep
+        return ep  # caller sets callbacks, then calls ep.connect()
+
+    def udp_socket(self, port: Optional[int] = None) -> DatagramSocket:
+        if port is None:
+            port = self.ephemeral_port()
+        if port in self._udp:
+            raise ValueError(f"{self.name}: UDP port {port} already bound")
+        sock = DatagramSocket(self, port)
+        self._udp[port] = sock
+        return sock
+
+    def find_endpoint(self, local_port: int, remote_host: int,
+                      remote_port: int) -> Optional[StreamEndpoint]:
+        return self._conns.get((local_port, remote_host, remote_port))
+
+    def drop_endpoint(self, ep: StreamEndpoint) -> None:
+        self._conns.pop((ep.local_port, ep.remote_host, ep.remote_port), None)
+
+    # -- logging ----------------------------------------------------------
+    def log(self, msg: str) -> None:
+        self._log_lines.append(msg)
+
+    def flush_logs(self, data_dir) -> None:
+        if not self._log_lines:
+            return
+        d = data_dir / "hosts" / self.name
+        d.mkdir(parents=True, exist_ok=True)
+        with open(d / f"{self.name}.log", "w") as f:
+            f.write("\n".join(self._log_lines) + "\n")

@@ -1,0 +1,14 @@
+"""Workload applications ("model families").
+
+The reference's workloads are external real programs run as managed
+processes — tgen (traffic generator), tor, bitcoind (SURVEY.md §1 bottom
+note). Phase-1 ships plugin re-implementations of the workload *behaviors*
+the benchmark configs need (BASELINE.md configs 1, 2, 4):
+
+- echo:   minimal UDP request/response pair (smoke tests)
+- tgen:   stream transfer client/server in tgen's shape (connect, request
+          N bytes, stream back, record completion)
+- gossip: bitcoin-like inv/getdata/tx flood over datagrams
+
+Real tgen/tor binaries become runnable in phase 4 via the native shim.
+"""

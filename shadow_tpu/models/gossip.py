@@ -1,0 +1,84 @@
+"""Bitcoin-like inv/getdata/tx gossip flood (BASELINE.md config 4).
+
+Each node picks k peers (deterministically from its host RNG), originates
+transactions on a timer, and floods them: announce (INV, 64B) -> request
+(GETDATA, 64B) -> payload (TX, ~400B), all over datagrams. Stresses event
+fan-out: one tx triggers O(k) messages per hop across the network.
+"""
+
+from __future__ import annotations
+
+from shadow_tpu.core.time import NS_PER_SEC
+
+INV, GETDATA, TX = b"I", b"G", b"T"
+TX_SIZE = 400
+
+
+class GossipNode:
+    """args: [port, n_hosts, k_peers, txs_to_originate, interval_sec]
+
+    Peers are chosen as deterministic random host ids != self. Host names
+    must be resolvable as ``node{i}`` (use quantity expansion with a host
+    template named ``node``).
+    """
+
+    def __init__(self, api, args, env):
+        self.api = api
+        self.port = int(args[0]) if args else 7000
+        self.n_hosts = int(args[1]) if len(args) > 1 else 10
+        self.k = int(args[2]) if len(args) > 2 else 4
+        self.originate = int(args[3]) if len(args) > 3 else 1
+        self.interval = float(args[4]) if len(args) > 4 else 1.0
+        self.seen: set[bytes] = set()
+        self.received_tx = 0
+        self.originated = 0
+
+    def start(self):
+        self.sock = self.api.udp_socket(self.port)
+        self.sock.on_datagram = self._on_msg
+        rng = self.api.rng
+        me = self.api.host_id
+        peers = set()
+        while len(peers) < min(self.k, self.n_hosts - 1):
+            p = int(rng.integers(0, self.n_hosts))
+            if p != me:
+                peers.add(p)
+        self.peers = sorted(peers)
+        if self.originate > 0:
+            delay = int((0.25 + 0.5 * float(rng.random())) * self.interval * NS_PER_SEC)
+            self.api.after(delay, self._originate)
+
+    def _originate(self):
+        self.originated += 1
+        txid = f"{self.api.host_id}:{self.originated}".encode()
+        self.seen.add(txid)
+        self._announce(txid)
+        if self.originated < self.originate:
+            self.api.after(int(self.interval * NS_PER_SEC), self._originate)
+
+    def _announce(self, txid: bytes, exclude: int = -1):
+        for p in self.peers:
+            if p != exclude:
+                self.sock.sendto(p, self.port, payload=INV + txid, nbytes=64)
+
+    def _on_msg(self, nbytes, payload, src_addr, now):
+        if payload is None:
+            return
+        kind, txid = payload[:1], payload[1:]
+        src_host, src_port = src_addr
+        if kind == INV:
+            if txid not in self.seen:
+                self.sock.sendto(src_host, self.port, payload=GETDATA + txid, nbytes=64)
+        elif kind == GETDATA:
+            self.sock.sendto(src_host, self.port, payload=TX + txid, nbytes=TX_SIZE)
+        elif kind == TX:
+            if txid not in self.seen:
+                self.seen.add(txid)
+                self.received_tx += 1
+                self._announce(txid, exclude=src_host)
+
+    def stop(self):
+        self.api.log(
+            f"gossip done: originated={self.originated} received={self.received_tx} "
+            f"known={len(self.seen)}"
+        )

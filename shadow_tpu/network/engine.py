@@ -1,0 +1,164 @@
+"""The network engine: per-round batched data plane orchestration.
+
+This replaces the reference's per-packet Router/Relay push model (SURVEY.md
+§3.4) with a batched design: hosts emit units into host-local egress lists
+during a round; at the round barrier the engine assembles one flat batch,
+runs the depart kernel (numpy or TPU backend — same integer semantics), and
+scatters results back as arrival events on destination hosts' queues. The
+conservative-PDES invariant (every latency >= round width) guarantees all
+arrivals land in future rounds, so this single synchronization point per
+round is the only cross-host communication in the simulator — exactly the
+structure that maps onto an ICI mesh in the tpu_batch policy
+(shadow_tpu/parallel/).
+
+Ingress (down-link) token buckets are enforced at arrival time: an arrival
+event that finds insufficient ingress tokens parks the unit in the host's
+deferred queue, which the engine re-drains after each round's refill. This
+logic is shared verbatim by all backends, preserving cross-backend
+bit-equality.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from shadow_tpu.core.time import SimTime
+from shadow_tpu.network.fluid import NetParams, refill_amount, depart_round
+from shadow_tpu.network.graph import NetworkGraph
+from shadow_tpu.network.unit import Unit
+
+
+class NetworkEngine:
+    def __init__(self, graph: NetworkGraph, params: NetParams, hosts,
+                 round_ns: SimTime, backend: str = "numpy",
+                 tpu_options=None) -> None:
+        self.graph = graph
+        self.params = params
+        self.hosts = hosts
+        self.round_ns = round_ns
+        self.backend = backend
+        h = len(hosts)
+        self.tokens_up = params.cap_up.copy()
+        self.tokens_down = params.cap_down.copy()
+        self._last_refill: SimTime = 0
+        self.pending: list[list[Unit]] = [[] for _ in range(h)]
+        self.n_pending = 0
+        self.units_sent = 0
+        self.units_dropped = 0
+        self.bytes_sent = 0
+        self._kernel = None
+        if backend == "tpu":
+            from shadow_tpu.ops.propagate import DeviceDataPlane
+
+            self._kernel = DeviceDataPlane(params, tpu_options)
+
+    # latency helpers ------------------------------------------------------
+    def latency_between(self, src_host: int, dst_host: int) -> SimTime:
+        p = self.params
+        return int(self.graph.latency_ns[p.host_node[src_host], p.host_node[dst_host]])
+
+    def rtt_extra_ns(self, src_host: int, dst_host: int) -> SimTime:
+        """Extra delay beyond one-way latency for loss notifications: the
+        return-path latency (so the sender learns of a loss one RTT after
+        departure, like a fast-retransmit signal)."""
+        return self.latency_between(dst_host, src_host)
+
+    def has_pending(self) -> bool:
+        return self.n_pending > 0 or any(h.ingress_deferred for h in self.hosts)
+
+    # round hooks ----------------------------------------------------------
+    def start_of_round(self, round_start: SimTime) -> None:
+        """Refill both token buckets for the elapsed window and re-drain any
+        ingress-deferred units at the new round's start time."""
+        dt = round_start - self._last_refill
+        self._last_refill = round_start
+        if dt > 0:
+            p = self.params
+            self.tokens_up += refill_amount(p.rate_up, p.cap_up, self.tokens_up, dt)
+            self.tokens_down += refill_amount(p.rate_down, p.cap_down, self.tokens_down, dt)
+        for host in self.hosts:
+            if host.ingress_deferred:
+                backlog, host.ingress_deferred = host.ingress_deferred, []
+                for u in backlog:
+                    self.ingress_arrival(u, round_start)
+
+    def ingress_arrival(self, u: Unit, now: SimTime) -> None:
+        """Down-link token bucket at the destination (runs on the dst host's
+        thread via its arrival event, or single-threaded from round start)."""
+        if self.tokens_down[u.dst] >= u.size:
+            self.tokens_down[u.dst] -= u.size
+            self.hosts[u.dst].deliver(u, now)
+        else:
+            self.hosts[u.dst].ingress_deferred.append(u)
+
+    def end_of_round(self, round_start: SimTime, round_end: SimTime) -> None:
+        """The round barrier: batch all pending egress and run the kernel."""
+        # collect this round's emissions behind earlier leftovers (FIFO)
+        for h in self.hosts:
+            if h.egress:
+                self.pending[h.id].extend(h.egress)
+                self.n_pending += len(h.egress)
+                h.egress = []
+        if self.n_pending == 0:
+            return
+
+        units: list[Unit] = []
+        for lst in self.pending:
+            units.extend(lst)
+        n = len(units)
+        src = np.fromiter((u.src for u in units), dtype=np.int32, count=n)
+        dst = np.fromiter((u.dst for u in units), dtype=np.int32, count=n)
+        size = np.fromiter((u.size for u in units), dtype=np.int32, count=n)
+        t_emit = np.fromiter((u.t_emit for u in units), dtype=np.int64, count=n)
+        npkts = np.fromiter((u.npkts for u in units), dtype=np.int32, count=n)
+        uid = np.fromiter((u.uid for u in units), dtype=np.uint64, count=n)
+        uid_lo = (uid & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        uid_hi = (uid >> np.uint64(32)).astype(np.uint32)
+
+        if self._kernel is not None:
+            res = self._kernel.depart_round(
+                self.tokens_up, src, dst, size, t_emit, npkts, uid_lo, uid_hi,
+                round_start,
+            )
+        else:
+            res = depart_round(
+                self.params, self.tokens_up, src, dst, size, t_emit, npkts,
+                uid_lo, uid_hi, round_start,
+            )
+        self.tokens_up = res.tokens_after
+
+        sent = res.sent
+        dropped = res.dropped
+        arrival = res.arrival_ns
+        new_pending: list[list[Unit]] = [[] for _ in self.hosts]
+        n_left = 0
+        for i, u in enumerate(units):
+            if not sent[i]:
+                new_pending[u.src].append(u)
+                n_left += 1
+            elif dropped[i]:
+                self.units_dropped += 1
+                if u.on_loss is not None:
+                    t_notify = max(u.t_emit, round_start) + self.latency_between(
+                        u.src, u.dst) + u.loss_extra_ns
+                    who = u.loss_host if u.loss_host is not None else u.src
+                    cb = u.on_loss
+                    self.hosts[who].schedule(max(t_notify, round_end), cb)
+            else:
+                self.units_sent += 1
+                self.bytes_sent += u.size
+                # clamp keeps causality when experimental.runahead widens the
+                # round beyond the graph's min latency
+                t_arr = max(int(arrival[i]), round_end)
+                self.hosts[u.dst].schedule(
+                    t_arr, _make_arrival(self, u, t_arr)
+                )
+        self.pending = new_pending
+        self.n_pending = n_left
+
+
+def _make_arrival(engine: NetworkEngine, u: Unit, t_arr: SimTime):
+    def arrive() -> None:
+        engine.ingress_arrival(u, t_arr)
+
+    return arrive

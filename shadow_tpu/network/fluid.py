@@ -1,0 +1,165 @@
+"""The per-round network data plane: numpy reference implementation.
+
+This is the re-design of the reference's Router/Relay token-bucket hot path
+(SURVEY.md §2 "Router + Relay", §3.4) as a *batched tensor program*: per
+round, every pending transmission unit from every host is processed in one
+vectorized step — token-bucket drain (FIFO with head-of-line blocking per
+source), shortest-path latency lookup, and counter-based loss sampling.
+
+The exact same integer math runs as JAX kernels on TPU
+(shadow_tpu/ops/propagate.py); tests/test_bitmatch.py asserts bit-equality.
+
+Key invariants (conservative PDES, SURVEY.md §2 parallelism item 4):
+- every edge latency >= round width W, so every computed arrival time lands
+  at or after the next round boundary — cross-host effects never need
+  rollback.
+- all quantities are integers (bytes, ns); the only floats anywhere are the
+  float64 loss-threshold precompute at startup (quantize_loss).
+
+Unit sizes are bounded by MAX_UNIT (a handful of MTUs): streams are chunked
+by the transport (shadow_tpu/network/transport.py), datagrams are fragmented
+by the socket layer. Loss is sampled per MTU-sized packet *within* a unit
+(up to MAX_PKTS draws, any hit drops the unit) so that loss probability
+scales with unit size exactly the same way on both backends with pure
+integer compares.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from shadow_tpu.core.time import SimTime
+from shadow_tpu.ops.prng import draw_24bit, quantize_loss
+
+MTU = 1500  # bytes on the wire per packet
+HEADER = 40  # modeled header overhead per unit and per ack
+MAX_UNIT = 10 * MTU  # max wire bytes per transmission unit
+MAX_PKTS = 10  # = MAX_UNIT / MTU, loss draws per unit
+MIN_CAP = 16384  # token bucket capacity floor: one MAX_UNIT + headroom
+
+
+@dataclass
+class NetParams:
+    """Static per-simulation network parameters (CPU-resident canonical copy;
+    the device backend keeps int32 replicas)."""
+
+    host_node: np.ndarray  # (H,) int32: host -> graph node index
+    rate_up: np.ndarray  # (H,) int64 bytes/sec
+    rate_down: np.ndarray  # (H,) int64 bytes/sec
+    cap_up: np.ndarray  # (H,) int64 bucket capacity, < 2**31
+    cap_down: np.ndarray  # (H,) int64
+    latency_ns: np.ndarray  # (G, G) int64
+    drop_thresh: np.ndarray  # (G, G) uint32 q24 drop probability
+    seed: int
+
+    @classmethod
+    def build(
+        cls,
+        host_node: np.ndarray,
+        rate_up: np.ndarray,
+        rate_down: np.ndarray,
+        latency_ns: np.ndarray,
+        reliability: np.ndarray,
+        seed: int,
+        round_ns: SimTime,
+    ) -> "NetParams":
+        rate_up = np.asarray(rate_up, dtype=np.int64)
+        rate_down = np.asarray(rate_down, dtype=np.int64)
+        cap_up = np.maximum(rate_up * round_ns // 1_000_000_000, MIN_CAP)
+        cap_down = np.maximum(rate_down * round_ns // 1_000_000_000, MIN_CAP)
+        limit = (np.int64(1) << np.int64(31)) - 1
+        if (cap_up >= limit).any() or (cap_down >= limit).any():
+            # device tokens are int32; clamp (only hit for absurd rate*W)
+            cap_up = np.minimum(cap_up, limit - 1)
+            cap_down = np.minimum(cap_down, limit - 1)
+        return cls(
+            host_node=np.asarray(host_node, dtype=np.int32),
+            rate_up=rate_up,
+            rate_down=rate_down,
+            cap_up=cap_up,
+            cap_down=cap_down,
+            latency_ns=np.asarray(latency_ns, dtype=np.int64),
+            drop_thresh=quantize_loss(reliability),
+            seed=int(seed),
+        )
+
+
+def refill_amount(rate: np.ndarray, cap: np.ndarray, tokens: np.ndarray,
+                  dt_ns: int) -> np.ndarray:
+    """Integer token refill for an elapsed window of dt_ns, computed CPU-side
+    (int64) so both backends see the identical int32-safe result."""
+    add = rate * np.int64(dt_ns) // np.int64(1_000_000_000)
+    return np.minimum(tokens + add, cap) - tokens
+
+
+@dataclass
+class DepartResult:
+    sent: np.ndarray  # (N,) bool — left the source this round
+    dropped: np.ndarray  # (N,) bool — sent but lost in the network
+    arrival_ns: np.ndarray  # (N,) int64 — valid where sent & ~dropped
+    tokens_after: np.ndarray  # (H,) int64
+
+
+def depart_round(
+    params: NetParams,
+    tokens_up: np.ndarray,
+    src: np.ndarray,
+    dst: np.ndarray,
+    size: np.ndarray,
+    t_emit: np.ndarray,
+    npkts: np.ndarray,
+    uid_lo: np.ndarray,
+    uid_hi: np.ndarray,
+    round_start: SimTime,
+) -> DepartResult:
+    """One round of the egress hot path (numpy reference).
+
+    Arrays must be ordered by (src ascending, per-source FIFO order); the
+    caller (NetworkEngine) guarantees this. All arrays length N.
+
+    Semantics, matched exactly by the JAX kernel:
+    1. per-source FIFO token drain: unit i departs iff the cumulative wire
+       bytes of its source's queue up to and including i fit in tokens_up.
+    2. departure time = max(t_emit, round_start); arrival = departure +
+       APSP latency between the endpoints' graph nodes.
+    3. loss: for each MTU packet p < npkts, draw threefry(seed, uid, p);
+       the unit is dropped iff any draw < drop_thresh[src_node, dst_node].
+    """
+    n = src.shape[0]
+    tokens_after = tokens_up.copy()
+    if n == 0:
+        empty = np.zeros(0, dtype=bool)
+        return DepartResult(empty, empty.copy(), np.zeros(0, dtype=np.int64), tokens_after)
+
+    size64 = size.astype(np.int64)
+    csum = np.cumsum(size64)
+    # cumulative bytes before each source segment (src-sorted input)
+    seg_first = np.ones(n, dtype=bool)
+    seg_first[1:] = src[1:] != src[:-1]
+    base = np.where(seg_first, csum - size64, 0)
+    base = np.maximum.accumulate(base)
+    cum_in_seg = csum - base
+    sent = cum_in_seg <= tokens_up[src]
+
+    sent_bytes = np.zeros_like(tokens_after)
+    np.add.at(sent_bytes, src[sent], size64[sent])
+    tokens_after -= sent_bytes
+
+    src_node = params.host_node[src]
+    dst_node = params.host_node[dst]
+    lat = params.latency_ns[src_node, dst_node]
+    thresh = params.drop_thresh[src_node, dst_node]
+
+    # per-packet loss draws: counter = (uid_lo, uid_hi | pkt << 28)
+    pkt = np.arange(MAX_PKTS, dtype=np.uint32)[None, :]
+    c0 = np.broadcast_to(uid_lo.astype(np.uint32)[:, None], (n, MAX_PKTS))
+    c1 = uid_hi.astype(np.uint32)[:, None] | (pkt << np.uint32(28))
+    draws = draw_24bit(params.seed, c0, c1)
+    hit = (draws < thresh[:, None]) & (pkt < npkts.astype(np.uint32)[:, None])
+    dropped = sent & hit.any(axis=1)
+
+    depart_t = np.maximum(t_emit, np.int64(round_start))
+    arrival = depart_t + lat
+    return DepartResult(sent, dropped, arrival, tokens_after)

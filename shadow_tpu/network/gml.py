@@ -32,17 +32,20 @@ class GmlGraph:
 
 
 def _tokenize(text: str):
-    for m in _TOKEN.finditer(text):
-        if m.group(1) is not None:
-            yield ("str", m.group(1))
-        else:
+    # line-based so '#' comments swallow the rest of their line (quoted
+    # strings are single-line in GML)
+    for line in text.splitlines():
+        for m in _TOKEN.finditer(line):
+            if m.group(1) is not None:
+                yield ("str", m.group(1))
+                continue
             tok = m.group(0)
             if tok == "[":
                 yield ("open", tok)
             elif tok == "]":
                 yield ("close", tok)
             elif tok.startswith("#"):
-                continue
+                break  # comment: skip rest of line
             else:
                 yield ("atom", tok)
 
@@ -86,7 +89,10 @@ def _parse_list(tokens) -> dict:
         if kind not in ("atom", "str"):
             raise ValueError(f"unexpected token {tok!r} (expected key)")
         key = tok
-        kind2, tok2 = next(tokens)
+        try:
+            kind2, tok2 = next(tokens)
+        except StopIteration:
+            raise ValueError(f"GML input truncated after key {key!r}") from None
         if kind2 == "open":
             put(key, _parse_list(tokens))
         else:

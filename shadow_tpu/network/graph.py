@@ -101,8 +101,11 @@ def _apsp_minplus(lat: np.ndarray, rel: np.ndarray) -> tuple[np.ndarray, np.ndar
             cand = np.minimum(cand, INF_I64)  # saturate (2*INF fits int64)
             k_star = np.argmin(cand, axis=1)  # (b, G=j), first minimum
             new_lat[i0:i1] = np.take_along_axis(cand, k_star[:, None, :], axis=1)[:, 0, :]
-            rel_cand = rel[i0:i1, :, None] * rel[None, :, :]
-            new_rel[i0:i1] = np.take_along_axis(rel_cand, k_star[:, None, :], axis=1)[:, 0, :]
+            # gather reliability along the chosen decomposition only (no G^3
+            # float product): rel[i, k*] * rel[k*, j]
+            rel_ik = np.take_along_axis(rel[i0:i1], k_star, axis=1)
+            rel_kj = rel[k_star, np.arange(g)[None, :]]
+            new_rel[i0:i1] = rel_ik * rel_kj
         lat, rel = new_lat, new_rel
     return lat, rel
 

@@ -1,0 +1,362 @@
+"""Transports for plugin workloads: fluid streams (TCP-like) and datagrams.
+
+Re-designs the reference's userspace TCP + UDP socket layer (SURVEY.md §1
+layer 9, §2 "TCP stack") as a *fluid* model suited to batched per-round
+simulation:
+
+- A stream connection is two half-objects, one per endpoint host, that
+  interact ONLY by exchanging units through the network engine. This makes
+  every object host-local, so scheduler policies can run hosts on different
+  threads with no shared mutable state (SURVEY.md §2 parallelism item 5).
+- Congestion control is standard slow-start + AIMD (RFC 5681 shaped) in
+  integer bytes: loss halves cwnd, acks grow it. Loss events come from the
+  network engine's oracle (the engine knows a unit was dropped and notifies
+  the sender one RTT after departure) instead of duplicate-ack machinery —
+  a deliberate fluid-model simplification; the phase-4/5 managed-process
+  path will carry the full per-packet TCP state machine (SURVEY.md §7
+  phase 5).
+- Reliability: lost DATA is re-queued at the front of the send buffer
+  (go-back-on-loss at unit granularity); byte counts delivered are exact.
+
+Datagram sockets fragment payloads into units and reassemble at the
+receiver; losing any fragment loses the datagram (IP semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from shadow_tpu.core.time import NS_PER_SEC, SimTime
+from shadow_tpu.network.fluid import HEADER, MAX_UNIT
+from shadow_tpu.network import unit as U
+from shadow_tpu.network.unit import Unit
+
+MSS = 1460  # cwnd growth quantum (classic ethernet MSS)
+CHUNK = MAX_UNIT - HEADER  # max stream payload bytes per unit
+INIT_CWND = 10 * MSS  # RFC 6928
+MIN_CWND = 2 * MSS
+SYN_RTO_NS = NS_PER_SEC  # handshake retransmit timeout
+SYN_RETRIES = 5
+
+
+class StreamSender:
+    """The sending half of one direction of a stream connection.
+
+    Each endpoint host owns a StreamSender for the data it transmits and a
+    StreamReceiver for the data it receives. (Both directions of a duplex
+    connection get their own sender/receiver pair.)
+    """
+
+    def __init__(self, endpoint: "StreamEndpoint"):
+        self.ep = endpoint
+        self.cwnd = INIT_CWND
+        self.ssthresh = 1 << 62
+        self.inflight = 0  # payload bytes sent but not acked/lost
+        self.sendbuf: list[tuple[int, Optional[bytes]]] = []  # (nbytes, payload)
+        self.buffered = 0
+        self.next_seq = 0
+        self.bytes_acked = 0
+        self.loss_events = 0
+
+    def queue(self, nbytes: int, payload: Optional[bytes]) -> None:
+        self.sendbuf.append((nbytes, payload))
+        self.buffered += nbytes
+        self.pump()
+
+    def pump(self) -> None:
+        ep = self.ep
+        if ep.state not in (ESTABLISHED, CLOSING):
+            return  # not yet connected (or fully closed); connect() re-pumps
+        while self.buffered > 0 and self.inflight < self.cwnd:
+            budget = min(self.cwnd - self.inflight, CHUNK)
+            nbytes, payload = self.sendbuf[0]
+            if nbytes <= budget:
+                self.sendbuf.pop(0)
+                chunk_p = payload
+            else:
+                chunk_p = payload[:budget] if payload is not None else None
+                rest_p = payload[budget:] if payload is not None else None
+                self.sendbuf[0] = (nbytes - budget, rest_p)
+                nbytes = budget
+            self.buffered -= nbytes
+            self.inflight += nbytes
+            seq = self.next_seq
+            self.next_seq += nbytes
+            ep.emit(
+                U.DATA,
+                nbytes=nbytes,
+                payload=chunk_p,
+                seq=seq,
+                on_loss=self._make_on_loss(nbytes, chunk_p, seq),
+                loss_extra="rtt",
+            )
+        if self.buffered == 0 and self.inflight == 0:
+            self.ep._maybe_fin()
+
+    def _make_on_loss(self, nbytes: int, payload: Optional[bytes], seq: int):
+        def on_loss() -> None:
+            self.loss_events += 1
+            self.ssthresh = max(self.cwnd // 2, MIN_CWND)
+            self.cwnd = self.ssthresh
+            self.inflight -= nbytes
+            # retransmit: back to the front of the send buffer
+            self.sendbuf.insert(0, (nbytes, payload))
+            self.buffered += nbytes
+            self.pump()
+
+        return on_loss
+
+    def on_ack(self, nbytes: int, grow: bool = True) -> None:
+        self.inflight -= nbytes
+        self.bytes_acked += nbytes
+        if grow:
+            if self.cwnd < self.ssthresh:
+                self.cwnd += min(nbytes, self.cwnd)  # slow start (doubles/RTT)
+            else:
+                self.cwnd += max(1, MSS * nbytes // self.cwnd)  # AIMD
+        self.pump()
+
+
+class StreamReceiver:
+    """Receiving half: counts/collects delivered bytes, acks each unit."""
+
+    def __init__(self, endpoint: "StreamEndpoint"):
+        self.ep = endpoint
+        self.bytes_received = 0
+
+    def on_data(self, unit: Unit, now: SimTime) -> None:
+        self.bytes_received += unit.nbytes
+        ep = self.ep
+        # ack the unit; if the ACK is lost the sender still frees the window
+        # (grow=False) one RTT later — data did arrive, only feedback was lost.
+        ack_nbytes = unit.nbytes
+
+        def ack_lost() -> None:
+            peer = ep._peer_sender()
+            if peer is not None:
+                peer.on_ack(ack_nbytes, grow=False)
+
+        ep.emit(U.ACK, acked=ack_nbytes, on_loss=ack_lost, loss_at_peer=True)
+        if ep.on_data is not None:
+            ep.on_data(unit.nbytes, unit.payload, now)
+
+
+# endpoint states
+CLOSED, LISTEN, SYN_SENT, ESTABLISHED, FIN_WAIT, CLOSING = range(6)
+
+
+class StreamEndpoint:
+    """One host's view of a stream connection (half of the four-tuple).
+
+    Host-local by construction: the only cross-host interaction is emitting
+    units into the owning host's egress queue. (The one apparent exception,
+    _peer_sender, runs inside a loss-notification event that the engine
+    schedules on the peer's own host queue.)
+    """
+
+    def __init__(self, host, local_port: int, remote_host: int, remote_port: int,
+                 initiator: bool):
+        self.host = host
+        self.local_port = local_port
+        self.remote_host = remote_host
+        self.remote_port = remote_port
+        self.initiator = initiator
+        self.state = CLOSED
+        self.sender = StreamSender(self)
+        self.receiver = StreamReceiver(self)
+        self.syn_tries = 0
+        self.syn_timer = None
+        self.fin_sent = False
+        # app callbacks
+        self.on_connected: Optional[Callable[[SimTime], None]] = None
+        self.on_data: Optional[Callable[[int, Optional[bytes], SimTime], None]] = None
+        self.on_close: Optional[Callable[[SimTime], None]] = None
+        self.on_error: Optional[Callable[[str], None]] = None
+
+    # -- API used by ProcessAPI ------------------------------------------
+    def send(self, nbytes: int = 0, payload: Optional[bytes] = None) -> None:
+        if payload is not None:
+            nbytes = len(payload)
+        if nbytes <= 0:
+            return
+        self.host.counters.add("stream_bytes_queued", nbytes)
+        self.sender.queue(nbytes, payload)
+
+    def close(self) -> None:
+        if self.state in (CLOSED, FIN_WAIT, CLOSING):
+            return
+        self.state = CLOSING
+        self.sender.pump()
+        self._maybe_fin()
+
+    # -- internals --------------------------------------------------------
+    def _maybe_fin(self) -> None:
+        if (
+            self.state == CLOSING
+            and not self.fin_sent
+            and self.sender.buffered == 0
+            and self.sender.inflight == 0
+        ):
+            self.fin_sent = True
+            self.emit(U.FIN, on_loss=self._refin)
+
+    def _refin(self) -> None:
+        self.fin_sent = False
+        self._maybe_fin()
+
+    def connect(self) -> None:
+        self.state = SYN_SENT
+        self._send_syn()
+
+    def _send_syn(self) -> None:
+        self.syn_tries += 1
+        if self.syn_tries > SYN_RETRIES:
+            self.state = CLOSED
+            if self.on_error is not None:
+                self.on_error("connection timed out (SYN retries exhausted)")
+            return
+        self.emit(U.SYN, on_loss=lambda: None)  # rely on the RTO timer
+        self.syn_timer = self.host.schedule_in(SYN_RTO_NS, self._syn_timeout)
+
+    def _syn_timeout(self) -> None:
+        if self.state == SYN_SENT:
+            self._send_syn()
+
+    def emit(self, kind: int, nbytes: int = 0, payload: Optional[bytes] = None,
+             seq: int = 0, acked: int = 0, on_loss=None, loss_extra=None,
+             loss_at_peer: bool = False) -> None:
+        size = nbytes + HEADER
+        u = Unit(
+            uid=self.host.next_uid(),
+            src=self.host.id,
+            dst=self.remote_host,
+            size=size,
+            t_emit=self.host.now,
+            kind=kind,
+            src_port=self.local_port,
+            dst_port=self.remote_port,
+            nbytes=nbytes if kind == U.DATA else acked,
+            payload=payload,
+            seq=seq,
+        )
+        u.on_loss = on_loss
+        if loss_at_peer:
+            u.loss_host = self.remote_host
+        if loss_extra == "rtt":
+            u.loss_extra_ns = self.host.engine.rtt_extra_ns(self.host.id, self.remote_host)
+        self.host.emit_unit(u)
+
+    def _peer_sender(self) -> Optional[StreamSender]:
+        """Resolve the remote endpoint's sender half. Only ever called from a
+        loss-notification event scheduled ON the remote host's queue, so the
+        lookup and the returned state are touched on that host's thread."""
+        peer_host = self.host.controller.hosts[self.remote_host]
+        peer = peer_host.find_endpoint(self.remote_port, self.host.id, self.local_port)
+        return peer.sender if peer is not None else None
+
+    # -- unit arrivals (dispatched by the host) ---------------------------
+    def handle(self, unit: Unit, now: SimTime) -> None:
+        k = unit.kind
+        if k == U.SYN:
+            # (server side) duplicate SYN: re-ack
+            if self.state == ESTABLISHED:
+                self.emit(U.SYNACK)
+            return
+        if k == U.SYNACK:
+            if self.state == SYN_SENT:
+                self.state = ESTABLISHED
+                if self.syn_timer is not None:
+                    self.host.cancel(self.syn_timer)
+                    self.syn_timer = None
+                if self.on_connected is not None:
+                    self.on_connected(now)
+                self.sender.pump()
+            return
+        if k == U.DATA:
+            self.host.counters.add("stream_bytes_received", unit.nbytes)
+            self.receiver.on_data(unit, now)
+            return
+        if k == U.ACK:
+            self.sender.on_ack(unit.nbytes, grow=True)
+            return
+        if k == U.FIN:
+            self.emit(U.FINACK)
+            if self.state != CLOSED:
+                self.state = CLOSED
+                if self.on_close is not None:
+                    self.on_close(now)
+            self.host.drop_endpoint(self)
+            return
+        if k == U.FINACK:
+            self.state = CLOSED
+            self.host.drop_endpoint(self)
+            return
+
+
+class DatagramSocket:
+    """UDP-like socket with fragmentation/reassembly."""
+
+    def __init__(self, host, local_port: int):
+        self.host = host
+        self.local_port = local_port
+        self.on_datagram: Optional[
+            Callable[[int, Optional[bytes], tuple, SimTime], None]
+        ] = None
+        self._next_dgram = 0
+        self._partial: dict[tuple, list] = {}  # (src, sport, dgram) -> frags
+
+    def sendto(self, dst_host: int, dst_port: int, nbytes: int = 0,
+               payload: Optional[bytes] = None) -> None:
+        # nbytes may exceed len(payload): wire size is nbytes, with the real
+        # payload bytes riding along (lets workloads model fixed-size
+        # messages without materializing padding)
+        if payload is not None:
+            nbytes = max(nbytes, len(payload))
+        dgram = self._next_dgram
+        self._next_dgram += 1
+        nfrags = max(1, -(-nbytes // CHUNK))
+        self.host.counters.add("dgrams_sent", 1)
+        for i in range(nfrags):
+            lo = i * CHUNK
+            hi = min(nbytes, lo + CHUNK)
+            u = Unit(
+                uid=self.host.next_uid(),
+                src=self.host.id,
+                dst=dst_host,
+                size=(hi - lo) + HEADER,
+                t_emit=self.host.now,
+                kind=U.DGRAM,
+                src_port=self.local_port,
+                dst_port=dst_port,
+                nbytes=hi - lo,
+                payload=payload[lo:hi] if payload is not None else None,
+                seq=dgram,
+                frag_idx=i,
+                nfrags=nfrags,
+            )
+            self.host.emit_unit(u)
+
+    def handle(self, unit: Unit, now: SimTime) -> None:
+        src_addr = (unit.src, unit.src_port)
+        if unit.nfrags == 1:
+            self._deliver(unit.nbytes, unit.payload, src_addr, now)
+            return
+        key = (unit.src, unit.src_port, unit.seq)
+        frags = self._partial.setdefault(key, [None] * unit.nfrags)
+        frags[unit.frag_idx] = unit
+        if all(f is not None for f in frags):
+            del self._partial[key]
+            nbytes = sum(f.nbytes for f in frags)
+            payload = (
+                b"".join(f.payload for f in frags)
+                if all(f.payload is not None for f in frags)
+                else None
+            )
+            self._deliver(nbytes, payload, src_addr, now)
+        elif len(self._partial) > 4096:  # bound memory: drop oldest partial
+            self._partial.pop(next(iter(self._partial)))
+
+    def _deliver(self, nbytes, payload, src_addr, now) -> None:
+        self.host.counters.add("dgrams_received", 1)
+        if self.on_datagram is not None:
+            self.on_datagram(nbytes, payload, src_addr, now)

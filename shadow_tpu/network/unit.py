@@ -1,0 +1,57 @@
+"""Transmission units — the simulator's wire-level quantum.
+
+The reference models individual packets (SURVEY.md §2 "Packet"); we batch at
+a slightly coarser quantum called a *unit*: up to MAX_PKTS MTU-sized packets
+that travel together (loss is still sampled per MTU packet inside the unit,
+see shadow_tpu/network/fluid.py). Streams are chunked into units by the
+transport; datagrams are fragmented into units by the socket layer. This
+keeps per-round batches small enough for Python assembly while the math
+stays per-packet-faithful.
+
+uid layout: (host_id << 40) | per-host counter — globally unique and
+assignable without cross-thread coordination, so unit creation is
+deterministic under every scheduler policy.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from shadow_tpu.core.time import SimTime
+from shadow_tpu.network.fluid import HEADER, MAX_PKTS, MTU
+
+# unit kinds
+SYN, SYNACK, DATA, ACK, FIN, FINACK, DGRAM = range(7)
+KIND_NAMES = ("SYN", "SYNACK", "DATA", "ACK", "FIN", "FINACK", "DGRAM")
+
+
+@dataclass
+class Unit:
+    uid: int
+    src: int  # source host id
+    dst: int  # destination host id
+    size: int  # wire bytes (payload + HEADER)
+    t_emit: SimTime
+    kind: int
+    src_port: int
+    dst_port: int
+    nbytes: int = 0  # application payload byte count
+    payload: Optional[bytes] = None
+    seq: int = 0  # stream byte offset / datagram id
+    frag_idx: int = 0
+    nfrags: int = 1
+    #: called (on loss_host's thread) if the unit is lost in the network
+    on_loss: Optional[Callable[[SimTime], None]] = None
+    #: host whose event queue runs on_loss (defaults to src)
+    loss_host: Optional[int] = None
+    #: extra loss-notification delay beyond one-way latency (e.g. RTT)
+    loss_extra_ns: SimTime = 0
+
+    @property
+    def npkts(self) -> int:
+        return min(max(1, -(-self.size // MTU)), MAX_PKTS)
+
+
+def wire_size(payload_bytes: int) -> int:
+    return payload_bytes + HEADER

@@ -1,0 +1,7 @@
+"""Device kernels for the per-round network data plane.
+
+These are the TPU-native replacements for the reference's Router/Relay token
+bucket and routing-lookup hot path (SURVEY.md §3.4, BASELINE.json
+north_star). Every kernel has a numpy twin used by the CPU scheduler
+policies; the two must agree bit-for-bit (tested in tests/test_bitmatch.py).
+"""

@@ -1,0 +1,80 @@
+"""Counter-based RNG: Threefry-2x32 (20 rounds).
+
+Packet-loss sampling must be a pure function of (seed, unit id) so that the
+numpy and TPU network backends — and any sharding layout — produce identical
+drops (SURVEY.md §7 "Determinism across backends"). Python/numpy RNG state
+would make results depend on execution order; a counter-based generator keyed
+on stable unit ids does not.
+
+This is the Threefry-2x32-20 function of Salmon et al., "Parallel Random
+Numbers: As Easy as 1, 2, 3" (SC'11) — the same generator family JAX uses —
+implemented once, parameterized over the array namespace (numpy or
+jax.numpy) so both backends execute the exact same integer arithmetic.
+
+Loss decisions avoid floats entirely: a unit is dropped iff
+``draw_24bit < floor(loss * 2**24)`` with the threshold precomputed host-side
+(see quantize_loss); integer compares are bit-identical everywhere.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+_ROT_A = (13, 15, 26, 6)
+_ROT_B = (17, 29, 16, 24)
+_PARITY = 0x1BD11BDA
+
+
+def threefry2x32(k0, k1, c0, c1, xp=np):
+    """Threefry-2x32, 20 rounds. All args uint32 arrays (or scalars); returns
+    (x0, x1) uint32. ``xp`` is numpy or jax.numpy."""
+    u32 = xp.uint32
+
+    def as_u32(v):
+        return xp.asarray(v, dtype=xp.uint32)
+
+    k0, k1, c0, c1 = as_u32(k0), as_u32(k1), as_u32(c0), as_u32(c1)
+    ks = (k0, k1, xp.bitwise_xor(xp.bitwise_xor(k0, k1), u32(_PARITY)))
+
+    def rotl(x, r):
+        return xp.bitwise_or(
+            (x << u32(r)) & u32(0xFFFFFFFF), x >> u32(32 - r)
+        ).astype(xp.uint32)
+
+    # uint32 wraparound is intended; numpy warns on scalar overflow only.
+    ctx = np.errstate(over="ignore") if xp is np else contextlib.nullcontext()
+    with ctx:
+        x0 = (c0 + ks[0]).astype(xp.uint32)
+        x1 = (c1 + ks[1]).astype(xp.uint32)
+        for group in range(5):
+            rots = _ROT_A if group % 2 == 0 else _ROT_B
+            for r in rots:
+                x0 = (x0 + x1).astype(xp.uint32)
+                x1 = rotl(x1, r)
+                x1 = xp.bitwise_xor(x0, x1)
+            j = group + 1
+            x0 = (x0 + ks[j % 3]).astype(xp.uint32)
+            x1 = (x1 + ks[(j + 1) % 3] + u32(j)).astype(xp.uint32)
+    return x0, x1
+
+
+def draw_24bit(seed: int, uid_lo, uid_hi, xp=np):
+    """A 24-bit uniform integer per unit, keyed on (seed, uid). uid is the
+    globally unique 64-bit unit id split into two uint32 halves."""
+    k0 = np.uint32(seed & 0xFFFFFFFF)
+    k1 = np.uint32((seed >> 32) & 0xFFFFFFFF)
+    x0, _ = threefry2x32(k0, k1, uid_lo, uid_hi, xp=xp)
+    return (x0 >> xp.uint32(8)).astype(xp.uint32)  # top 24 bits
+
+
+def quantize_loss(reliability: np.ndarray) -> np.ndarray:
+    """Precompute integer drop thresholds from a float32 reliability matrix:
+    drop iff draw_24bit < threshold, threshold = round((1-rel) * 2**24).
+
+    Computed once, host-side, in float64 for exactness; the per-unit compare
+    is pure integer on both backends."""
+    loss = 1.0 - reliability.astype(np.float64)
+    thresh = np.rint(loss * float(1 << 24)).astype(np.int64)
+    return np.clip(thresh, 0, 1 << 24).astype(np.uint32)

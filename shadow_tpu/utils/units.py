@@ -30,7 +30,8 @@ _BYTE_UNITS = {
 }
 
 
-def _split_num_unit(s: str) -> tuple[float, str]:
+def _split_num_unit(s: str) -> tuple[float, str, str]:
+    """Returns (number, lowercased unit, raw-case unit)."""
     s = s.strip()
     i = 0
     while i < len(s) and (s[i].isdigit() or s[i] in ".+-eE"):
@@ -40,10 +41,10 @@ def _split_num_unit(s: str) -> tuple[float, str]:
             break
         i += 1
     num = s[:i].strip()
-    unit = s[i:].strip().lower().replace(" ", "")
+    raw = s[i:].strip().replace(" ", "")
     if not num:
         raise ValueError(f"no numeric part in {s!r}")
-    return float(num), unit
+    return float(num), raw.lower(), raw
 
 
 def parse_bandwidth(value) -> int:
@@ -55,7 +56,11 @@ def parse_bandwidth(value) -> int:
     """
     if isinstance(value, (int, float)) and not isinstance(value, bool):
         return int(value)
-    num, unit = _split_num_unit(str(value))
+    num, unit, raw = _split_num_unit(str(value))
+    if raw.endswith("Bps"):  # capital B: bytes/sec (MBps = megabytes/s)
+        base = unit[:-3]
+        if base in _BIT_PREFIX:
+            return int(num * _BIT_PREFIX[base])
     if unit.endswith("bps"):  # Mbps/Gbps/kbps are bit units
         base = unit[:-3]
         if base in _BIT_PREFIX:
@@ -78,7 +83,7 @@ def parse_size(value) -> int:
     """Parse a size config value into bytes. Bare numbers are bytes."""
     if isinstance(value, (int, float)) and not isinstance(value, bool):
         return int(value)
-    num, unit = _split_num_unit(str(value))
+    num, unit, _raw = _split_num_unit(str(value))
     if unit in _BYTE_UNITS:
         return int(num * _BYTE_UNITS[unit])
     if unit == "":

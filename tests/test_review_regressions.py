@@ -1,0 +1,80 @@
+"""Regression tests for code-review findings (round 1)."""
+
+import pytest
+
+from shadow_tpu.config import parse_config
+from shadow_tpu.core.events import EventQueue
+from shadow_tpu.network.gml import parse_gml
+from shadow_tpu.utils.units import parse_bandwidth
+
+
+def test_gml_comment_lines():
+    g = parse_gml(
+        """
+        graph [
+          # two nodes below
+          node [ id 0 ]  # trailing comment with odd word count here
+          node [ id 1 ]
+          edge [ source 0 target 1 latency "5 ms" ]
+        ]
+        """
+    )
+    assert len(g.nodes) == 2
+    assert len(g.edges) == 1
+    assert "note" not in g.attrs and "two" not in g.attrs
+
+
+def test_gml_truncated_raises_valueerror():
+    with pytest.raises(ValueError, match="truncated"):
+        parse_gml("graph [ node")
+
+
+def test_cancel_after_fire_is_noop():
+    q = EventQueue()
+    h = q.push(10, lambda: None)
+    assert q.pop_until(100) is not None
+    q.cancel(h)  # timer already fired; disarm must not corrupt the queue
+    assert len(q) == 0
+    q.push(20, lambda: None)
+    assert len(q) == 1
+    assert q.next_time() == 20
+
+
+BASE = {
+    "general": {"stop_time": "1s"},
+    "hosts": {"a": {"processes": []}},
+}
+
+
+def _cfg(**over):
+    return parse_config(BASE, over)
+
+
+def test_negative_seed_rejected():
+    with pytest.raises(ValueError, match="seed"):
+        _cfg(**{"general.seed": -1})
+
+
+def test_negative_start_time_rejected():
+    doc = {
+        "general": {"stop_time": "1s"},
+        "hosts": {"a": {"processes": [
+            {"path": "pyapp:x:Y", "start_time": "-5s"}]}},
+    }
+    with pytest.raises(ValueError, match="start_time"):
+        parse_config(doc)
+
+
+def test_negative_bandwidth_rejected():
+    doc = {
+        "general": {"stop_time": "1s"},
+        "hosts": {"a": {"bandwidth_up": "-1 Gbit"}},
+    }
+    with pytest.raises(ValueError, match="bandwidth_up"):
+        parse_config(doc)
+
+
+def test_mbps_capital_b_is_bytes():
+    assert parse_bandwidth("1 MBps") == 1_000_000  # megaBYTES/s
+    assert parse_bandwidth("1 Mbps") == 125_000  # megabits/s
+    assert parse_bandwidth("2 GBps") == 2_000_000_000
