@@ -59,6 +59,10 @@ class Controller:
         if cfg.experimental.runahead is not None:
             w = cfg.experimental.runahead
         self.round_ns: SimTime = max(int(w), NS_PER_US)
+        if self.round_ns >= (1 << 30):
+            # the data plane carries times as int32 offsets from round start
+            self.round_ns = (1 << 30) - 1
+            self.log.warning("round width clamped to ~1.07s (int32 data plane)")
 
         self.hosts: list[Host] = []
         self._by_name: dict[str, int] = {}

@@ -11,11 +11,21 @@ round is the only cross-host communication in the simulator — exactly the
 structure that maps onto an ICI mesh in the tpu_batch policy
 (shadow_tpu/parallel/).
 
+Batches are split into chunks of at most ``chunk_units`` units AND 2**30
+wire bytes; chunk boundaries are computed by this engine, identically for
+every backend, so int32 cumulative sums on the device are exact and
+bit-equality with the numpy backend survives chunking. (Head-of-line
+blocking is per-chunk: a source whose queue is split across chunks re-bases
+its cumulative drain against the tokens remaining after the earlier chunk —
+the same sequential semantics on both backends.)
+
 Ingress (down-link) token buckets are enforced at arrival time: an arrival
 event that finds insufficient ingress tokens parks the unit in the host's
 deferred queue, which the engine re-drains after each round's refill. This
-logic is shared verbatim by all backends, preserving cross-backend
-bit-equality.
+logic is shared by all backends, preserving cross-backend bit-equality.
+
+Units whose route is unreachable (APSP latency >= INF) are "blackholed":
+counted, then silently discarded — matching IP semantics for no-route.
 """
 
 from __future__ import annotations
@@ -23,9 +33,11 @@ from __future__ import annotations
 import numpy as np
 
 from shadow_tpu.core.time import SimTime
-from shadow_tpu.network.fluid import NetParams, refill_amount, depart_round
-from shadow_tpu.network.graph import NetworkGraph
+from shadow_tpu.network.fluid import CPUDataPlane, NetParams, clamped_refill
+from shadow_tpu.network.graph import INF_I32, NetworkGraph
 from shadow_tpu.network.unit import Unit
+
+CHUNK_BYTES_CAP = 1 << 30
 
 
 class NetworkEngine:
@@ -37,20 +49,22 @@ class NetworkEngine:
         self.hosts = hosts
         self.round_ns = round_ns
         self.backend = backend
-        h = len(hosts)
-        self.tokens_up = params.cap_up.copy()
+        self.chunk_units = int(getattr(tpu_options, "tpu_max_batch", 65536) or 65536)
         self.tokens_down = params.cap_down.copy()
         self._last_refill: SimTime = 0
-        self.pending: list[list[Unit]] = [[] for _ in range(h)]
+        self.pending: list[list[Unit]] = [[] for _ in hosts]
         self.n_pending = 0
         self.units_sent = 0
         self.units_dropped = 0
+        self.units_blackholed = 0
         self.bytes_sent = 0
-        self._kernel = None
+        self._up_refill_dt = 0  # accumulated elapsed ns awaiting up-link refill
         if backend == "tpu":
             from shadow_tpu.ops.propagate import DeviceDataPlane
 
-            self._kernel = DeviceDataPlane(params, tpu_options)
+            self.plane = DeviceDataPlane(params, round_ns, tpu_options)
+        else:
+            self.plane = CPUDataPlane(params, round_ns)
 
     # latency helpers ------------------------------------------------------
     def latency_between(self, src_host: int, dst_host: int) -> SimTime:
@@ -74,8 +88,12 @@ class NetworkEngine:
         self._last_refill = round_start
         if dt > 0:
             p = self.params
-            self.tokens_up += refill_amount(p.rate_up, p.cap_up, self.tokens_up, dt)
-            self.tokens_down += refill_amount(p.rate_down, p.cap_down, self.tokens_down, dt)
+            # up-link refill is deferred to the round's first depart chunk
+            # (saves a device dispatch; tokens can only saturate while idle,
+            # and both backends defer identically)
+            self._up_refill_dt += dt
+            add_down = clamped_refill(p.rate_down, p.cap_down, dt)
+            self.tokens_down += np.minimum(add_down, p.cap_down - self.tokens_down)
         for host in self.hosts:
             if host.ingress_deferred:
                 backlog, host.ingress_deferred = host.ingress_deferred, []
@@ -93,7 +111,6 @@ class NetworkEngine:
 
     def end_of_round(self, round_start: SimTime, round_end: SimTime) -> None:
         """The round barrier: batch all pending egress and run the kernel."""
-        # collect this round's emissions behind earlier leftovers (FIFO)
         for h in self.hosts:
             if h.egress:
                 self.pending[h.id].extend(h.egress)
@@ -105,56 +122,71 @@ class NetworkEngine:
         units: list[Unit] = []
         for lst in self.pending:
             units.extend(lst)
+        new_pending: list[list[Unit]] = [[] for _ in self.hosts]
+        n_left = 0
+
+        # chunk boundaries: identical for every backend (see module doc)
+        i = 0
+        n = len(units)
+        while i < n:
+            j = i
+            nbytes = 0
+            while j < n and j - i < self.chunk_units:
+                nbytes += units[j].size
+                if nbytes > CHUNK_BYTES_CAP and j > i:
+                    break
+                j += 1
+            n_left += self._run_chunk(units[i:j], round_start, round_end, new_pending)
+            i = j
+
+        self.pending = new_pending
+        self.n_pending = n_left
+
+    def _run_chunk(self, units: list[Unit], round_start: SimTime,
+                   round_end: SimTime, new_pending: list[list[Unit]]) -> int:
         n = len(units)
         src = np.fromiter((u.src for u in units), dtype=np.int32, count=n)
         dst = np.fromiter((u.dst for u in units), dtype=np.int32, count=n)
         size = np.fromiter((u.size for u in units), dtype=np.int32, count=n)
-        t_emit = np.fromiter((u.t_emit for u in units), dtype=np.int64, count=n)
+        dep_off = np.fromiter(
+            (max(u.t_emit - round_start, 0) for u in units), dtype=np.int32, count=n
+        )
         npkts = np.fromiter((u.npkts for u in units), dtype=np.int32, count=n)
         uid = np.fromiter((u.uid for u in units), dtype=np.uint64, count=n)
         uid_lo = (uid & np.uint64(0xFFFFFFFF)).astype(np.uint32)
         uid_hi = (uid >> np.uint64(32)).astype(np.uint32)
 
-        if self._kernel is not None:
-            res = self._kernel.depart_round(
-                self.tokens_up, src, dst, size, t_emit, npkts, uid_lo, uid_hi,
-                round_start,
-            )
-        else:
-            res = depart_round(
-                self.params, self.tokens_up, src, dst, size, t_emit, npkts,
-                uid_lo, uid_hi, round_start,
-            )
-        self.tokens_up = res.tokens_after
+        refill_dt, self._up_refill_dt = self._up_refill_dt, 0
+        sent, dropped, arrival_off = self.plane.depart_chunk(
+            src, dst, size, dep_off, npkts, uid_lo, uid_hi, self.chunk_units,
+            refill_dt=refill_dt,
+        )
 
-        sent = res.sent
-        dropped = res.dropped
-        arrival = res.arrival_ns
-        new_pending: list[list[Unit]] = [[] for _ in self.hosts]
         n_left = 0
+        inf = int(INF_I32)
         for i, u in enumerate(units):
             if not sent[i]:
                 new_pending[u.src].append(u)
                 n_left += 1
+            elif arrival_off[i] >= inf:
+                # no route (also reads as 100% loss): discard silently, like
+                # IP with no route — must precede the drop check
+                self.units_blackholed += 1
             elif dropped[i]:
                 self.units_dropped += 1
                 if u.on_loss is not None:
                     t_notify = max(u.t_emit, round_start) + self.latency_between(
                         u.src, u.dst) + u.loss_extra_ns
                     who = u.loss_host if u.loss_host is not None else u.src
-                    cb = u.on_loss
-                    self.hosts[who].schedule(max(t_notify, round_end), cb)
+                    self.hosts[who].schedule(max(t_notify, round_end), u.on_loss)
             else:
                 self.units_sent += 1
                 self.bytes_sent += u.size
                 # clamp keeps causality when experimental.runahead widens the
                 # round beyond the graph's min latency
-                t_arr = max(int(arrival[i]), round_end)
-                self.hosts[u.dst].schedule(
-                    t_arr, _make_arrival(self, u, t_arr)
-                )
-        self.pending = new_pending
-        self.n_pending = n_left
+                t_arr = max(round_start + int(arrival_off[i]), round_end)
+                self.hosts[u.dst].schedule(t_arr, _make_arrival(self, u, t_arr))
+        return n_left
 
 
 def _make_arrival(engine: NetworkEngine, u: Unit, t_arr: SimTime):

@@ -86,12 +86,13 @@ class NetParams:
         )
 
 
-def refill_amount(rate: np.ndarray, cap: np.ndarray, tokens: np.ndarray,
-                  dt_ns: int) -> np.ndarray:
-    """Integer token refill for an elapsed window of dt_ns, computed CPU-side
-    (int64) so both backends see the identical int32-safe result."""
+def clamped_refill(rate: np.ndarray, cap: np.ndarray, dt_ns: int) -> np.ndarray:
+    """Token refill for an elapsed window of dt_ns, pre-clamped to capacity
+    (so it fits int32 and the device can apply it overflow-free as
+    ``tokens += min(add, cap - tokens)``, which equals
+    ``min(tokens + true_add, cap)`` exactly)."""
     add = rate * np.int64(dt_ns) // np.int64(1_000_000_000)
-    return np.minimum(tokens + add, cap) - tokens
+    return np.minimum(add, cap).astype(np.int64)
 
 
 @dataclass
@@ -163,3 +164,35 @@ def depart_round(
     depart_t = np.maximum(t_emit, np.int64(round_start))
     arrival = depart_t + lat
     return DepartResult(sent, dropped, arrival, tokens_after)
+
+
+class CPUDataPlane:
+    """numpy twin of shadow_tpu/ops/propagate.py::DeviceDataPlane — the same
+    chunked interface, so the engine treats both backends identically and
+    results match bit-for-bit."""
+
+    name = "numpy"
+
+    def __init__(self, params: NetParams, round_ns: int = 0) -> None:
+        self.params = params
+        self.round_ns = int(round_ns)
+        self.tokens = params.cap_up.copy()  # int64 (values int32-safe)
+
+    def tokens_host(self) -> np.ndarray:
+        return self.tokens
+
+    def _refill(self, dt_ns: int) -> None:
+        p = self.params
+        add = clamped_refill(p.rate_up, p.cap_up, dt_ns)
+        self.tokens += np.minimum(add, p.cap_up - self.tokens)
+
+    def depart_chunk(self, src, dst, size, dep_off, npkts, uid_lo, uid_hi,
+                     chunk_cap: int, refill_dt: int = 0):
+        if refill_dt:
+            self._refill(refill_dt)
+        res = depart_round(
+            self.params, self.tokens, src, dst, size,
+            dep_off.astype(np.int64), npkts, uid_lo, uid_hi, round_start=0,
+        )
+        self.tokens = res.tokens_after
+        return res.sent, res.dropped, res.arrival_ns

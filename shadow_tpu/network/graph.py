@@ -33,7 +33,8 @@ from shadow_tpu.utils.units import parse_bandwidth
 #: INF + INF still fits in int64 (min-plus sums saturate back to INF).
 INF_I64 = np.int64(1) << np.int64(61)
 #: Device kernels use int32 ns with this saturating infinity (~1.07 s).
-INF_I32 = np.int32(1) << np.int32(30)
+#: Chosen so INF + INF still fits in int32 (min-plus sums saturate back).
+INF_I32 = (np.int32(1) << np.int32(30)) - np.int32(1)
 
 
 @dataclass

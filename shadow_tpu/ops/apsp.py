@@ -1,0 +1,85 @@
+"""All-pairs shortest path on the device: blocked min-plus repeated squaring.
+
+The reference computes shortest-path latency/loss tables CPU-side with
+Dijkstra over petgraph (SURVEY.md §2 "Network graph + routing"). For TPU we
+re-cast APSP as ceil(log2(G)) min-plus matrix squarings — dense (G, G)
+work that XLA tiles well — carrying path reliability along the argmin
+decomposition exactly like the numpy canonical implementation
+(shadow_tpu/network/graph.py::_apsp_minplus), with the same first-minimum
+tie-breaking. For reachable pairs the two implementations agree exactly
+(int32 saturation only ever affects candidates that lose the argmin; see
+tests/test_apsp_device.py).
+
+Memory: the (B, K, J) candidate tensor is blocked over rows via lax.map so
+peak usage stays ~B * G^2 * 8 bytes regardless of G.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu.network.graph import INF_I32, INF_I64
+
+
+@functools.partial(jax.jit, static_argnames=("steps", "block"))
+def _apsp_kernel(lat, rel, steps: int, block: int):
+    g = lat.shape[0]
+    nb = g // block
+    j_idx = jnp.arange(g, dtype=jnp.int32)[None, :]
+
+    def one_squaring(carry, _):
+        lat, rel = carry
+
+        def do_block(blk):
+            lat_b, rel_b = blk  # (B, G)
+            cand = lat_b[:, :, None] + lat[None, :, :]  # (B, K, J)
+            cand = jnp.minimum(cand, INF_I32)
+            k_star = jnp.argmin(cand, axis=1).astype(jnp.int32)  # first min
+            new_lat = jnp.take_along_axis(cand, k_star[:, None, :], axis=1)[:, 0, :]
+            rel_ik = jnp.take_along_axis(rel_b, k_star, axis=1)
+            rel_kj = rel[k_star, j_idx]
+            return new_lat, rel_ik * rel_kj
+
+        blocks_lat = lat.reshape(nb, block, g)
+        blocks_rel = rel.reshape(nb, block, g)
+        new_lat, new_rel = jax.lax.map(do_block, (blocks_lat, blocks_rel))
+        return (new_lat.reshape(g, g), new_rel.reshape(g, g)), None
+
+    (lat, rel), _ = jax.lax.scan(one_squaring, (lat, rel), None, length=steps)
+    return lat, rel
+
+
+def apsp_device(latency_ns: np.ndarray, reliability: np.ndarray,
+                block: int = 16) -> tuple[np.ndarray, np.ndarray]:
+    """Device APSP. Input: (G, G) int64 one-hop latency with INF_I64
+    sentinels and 0 diagonal, float32 one-hop reliability with 1.0 diagonal.
+    Output in the same convention (int64 with INF_I64 where unreachable).
+
+    Requires every finite edge latency < INF_I32 (~1.07 s) — validated.
+    """
+    g = latency_ns.shape[0]
+    finite = latency_ns[latency_ns < INF_I64]
+    if finite.size and finite.max() >= int(INF_I32):
+        raise ValueError("edge latency >= ~1.07s: device APSP unsupported")
+    # pad to a multiple of block with unreachable rows/cols
+    gp = max(block, ((g + block - 1) // block) * block)
+    lat32 = np.full((gp, gp), INF_I32, dtype=np.int32)
+    rel32 = np.zeros((gp, gp), dtype=np.float32)
+    lat32[:g, :g] = np.minimum(latency_ns, np.int64(INF_I32)).astype(np.int32)
+    rel32[:g, :g] = reliability
+    idx = np.arange(g, gp)
+    lat32[idx, idx] = 0
+    rel32[idx, idx] = 1.0
+
+    steps = max(1, int(np.ceil(np.log2(max(g, 2)))))
+    out_lat, out_rel = _apsp_kernel(jnp.asarray(lat32), jnp.asarray(rel32),
+                                    steps=steps, block=block)
+    out_lat = np.asarray(out_lat)[:g, :g].astype(np.int64)
+    out_rel = np.asarray(out_rel)[:g, :g]
+    out_lat[out_lat >= int(INF_I32)] = INF_I64
+    return out_lat, out_rel

@@ -1,0 +1,69 @@
+"""Device APSP vs the numpy canonical solver: exact agreement on reachable
+pairs (latency and reliability), across graph shapes."""
+
+import numpy as np
+
+from shadow_tpu.network.gml import parse_gml
+from shadow_tpu.network.graph import INF_I64, _apsp_minplus
+from shadow_tpu.ops.apsp import apsp_device
+
+
+def random_graph(g, rng, p_edge=0.3, max_lat_ms=80):
+    lat = np.full((g, g), INF_I64, dtype=np.int64)
+    rel = np.zeros((g, g), dtype=np.float32)
+    np.fill_diagonal(lat, 0)
+    np.fill_diagonal(rel, 1.0)
+    for i in range(g):
+        for j in range(i + 1, g):
+            if rng.random() < p_edge:
+                # unique-ish latencies avoid argmin ties mattering
+                l = int(rng.integers(1_000_000, max_lat_ms * 1_000_000))
+                loss = float(rng.random() * 0.05)
+                lat[i, j] = lat[j, i] = l
+                rel[i, j] = rel[j, i] = np.float32(1.0 - loss)
+    return lat, rel
+
+
+def check_graph(lat, rel):
+    ref_lat, ref_rel = _apsp_minplus(lat.copy(), rel.copy())
+    dev_lat, dev_rel = apsp_device(lat, rel)
+    reach = ref_lat < INF_I64
+    np.testing.assert_array_equal(dev_lat < INF_I64, reach)
+    np.testing.assert_array_equal(dev_lat[reach], ref_lat[reach])
+    np.testing.assert_array_equal(dev_rel[reach], ref_rel[reach])
+
+
+def test_random_graphs_match():
+    rng = np.random.default_rng(5)
+    for g in (3, 7, 17, 40):
+        check_graph(*random_graph(g, rng))
+
+
+def test_disconnected_components():
+    rng = np.random.default_rng(9)
+    lat, rel = random_graph(10, rng, p_edge=0.6)
+    # sever node 9 entirely
+    lat[9, :] = INF_I64
+    lat[:, 9] = INF_I64
+    lat[9, 9] = 0
+    rel[9, :] = 0.0
+    rel[:, 9] = 0.0
+    rel[9, 9] = 1.0
+    check_graph(lat, rel)
+
+
+def test_chain_exact_lengths():
+    g = 24
+    lat = np.full((g, g), INF_I64, dtype=np.int64)
+    rel = np.zeros((g, g), dtype=np.float32)
+    np.fill_diagonal(lat, 0)
+    np.fill_diagonal(rel, 1.0)
+    for i in range(g - 1):
+        lat[i, i + 1] = lat[i + 1, i] = 1_000_000 * (i + 1)
+        rel[i, i + 1] = rel[i + 1, i] = np.float32(0.99)
+    ref_lat, _ = _apsp_minplus(lat.copy(), rel.copy())
+    dev_lat, dev_rel = apsp_device(lat, rel)
+    assert dev_lat[0, g - 1] == sum(1_000_000 * (i + 1) for i in range(g - 1))
+    np.testing.assert_array_equal(dev_lat, ref_lat)
+    # path reliability: product of 23 hops of 0.99 (float32 exact chain)
+    assert abs(float(dev_rel[0, g - 1]) - 0.99 ** (g - 1)) < 1e-5
