@@ -77,8 +77,8 @@ class ExperimentalOptions:
     interface_qdisc: str = "fifo"
     max_unapplied_cpu_latency: SimTime = 0
     # tpu_batch knobs (ours):
-    tpu_rounds_per_dispatch: int = 1
-    tpu_max_batch: int = 65536  # static padded packet-batch size per round
+    tpu_max_batch: int = 65536  # max units per device draw dispatch
+    tpu_device_floor: int = 0  # min batch to engage the device; 0 = calibrate
     tpu_mesh_shards: int = 0  # 0 = all local devices
 
 
@@ -201,8 +201,8 @@ def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
     e.strace_logging_mode = str(exp.get("strace_logging_mode", "off"))
     e.interface_qdisc = str(exp.get("interface_qdisc", "fifo"))
     e.max_unapplied_cpu_latency = parse_time(exp.get("max_unapplied_cpu_latency", 0))
-    e.tpu_rounds_per_dispatch = int(exp.get("tpu_rounds_per_dispatch", 1))
     e.tpu_max_batch = int(exp.get("tpu_max_batch", 65536))
+    e.tpu_device_floor = int(exp.get("tpu_device_floor", 0))
     e.tpu_mesh_shards = int(exp.get("tpu_mesh_shards", 0))
 
     hosts_doc = doc.get("hosts", {}) or {}

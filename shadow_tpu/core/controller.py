@@ -10,15 +10,16 @@ tree + end-of-run summary.
 Round-loop structure (the conservative PDES core):
 
     while now < stop:
-        engine.start_of_round(now)        # token refills, deferred ingress
+        engine.start_of_round(now, end)   # flush due draws, ingress refills
         scheduler.run_round(round_end)    # per-host events, parallel-safe
         engine.end_of_round(now, end)     # the barrier: batched data plane
         now = round_end (or skip ahead through provably idle time)
 
 Skip-ahead: when a round executed zero events and the engine holds no
-pending units, the controller jumps the clock to the next scheduled event —
-idle sim time costs nothing (the token buckets refill by elapsed time, so
-results are identical to grinding through empty rounds).
+deferred ingress, the controller jumps the clock to the next scheduled
+event (or the earliest event an in-flight draw batch can produce) — idle
+sim time costs nothing (the closed-form token buckets account elapsed time
+exactly, so results are identical to grinding through empty rounds).
 """
 
 from __future__ import annotations
@@ -59,10 +60,6 @@ class Controller:
         if cfg.experimental.runahead is not None:
             w = cfg.experimental.runahead
         self.round_ns: SimTime = max(int(w), NS_PER_US)
-        if self.round_ns >= (1 << 30):
-            # the data plane carries times as int32 offsets from round start
-            self.round_ns = (1 << 30) - 1
-            self.log.warning("round width clamped to ~1.07s (int32 data plane)")
 
         self.hosts: list[Host] = []
         self._by_name: dict[str, int] = {}
@@ -164,7 +161,7 @@ class Controller:
         now: SimTime = 0
         while now < stop:
             round_end = min(now + w, stop)
-            self.engine.start_of_round(now)
+            self.engine.start_of_round(now, round_end)
             executed = self.scheduler.run_round(round_end)
             self.engine.end_of_round(now, round_end)
             self.rounds += 1
@@ -172,8 +169,19 @@ class Controller:
             if round_end >= next_hb:
                 self._heartbeat(round_end, t0)
                 next_hb += hb_interval
-            if executed == 0 and not self.engine.has_pending():
+            if executed == 0 and not self.engine.has_immediate_work():
+                # provably idle: materialize any in-flight draw batch that
+                # could produce an event before the next queued one, then
+                # skip to the next event. Flushing here (instead of waking a
+                # round at the batch deadline) keeps the round grid — and
+                # hence 'rounds' and bucket rebase instants — identical to a
+                # run whose flags were computed inline (test_bitmatch.py::
+                # test_device_floor_cannot_change_results).
                 nt = min((h.equeue.next_time() for h in self.hosts), default=T_NEVER)
+                while self.engine.earliest_outstanding() < nt:
+                    self.engine.flush_due(nt)
+                    nt = min((h.equeue.next_time() for h in self.hosts),
+                             default=T_NEVER)
                 if nt >= T_NEVER:
                     self.log.info(
                         f"no further events at {format_time(round_end)}; ending early"
@@ -183,6 +191,7 @@ class Controller:
                 now = max(round_end, nt)
             else:
                 now = round_end
+        self.engine.flush_all()  # finalize counters for in-flight batches
         self.wall_seconds = _walltime.perf_counter() - t0
         self.scheduler.shutdown()
         return self._finalize(min(now, stop))

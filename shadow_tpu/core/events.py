@@ -18,27 +18,41 @@ from typing import Callable, Optional
 from shadow_tpu.core.time import SimTime, T_NEVER
 
 
+#: heap ordering bands for same-time ties: network events (arrivals, loss
+#: notifications) execute before application events at the same instant.
+#: Network events carry an explicit ``key`` assigned at the emission barrier
+#: in canonical batch order, which makes the total event order independent
+#: of WHEN the engine physically inserts them — the deferred device-readback
+#: path (shadow_tpu/network/engine.py) inserts arrivals rounds later than
+#: the inline numpy path, yet both yield the same execution order.
+BAND_NET = 0
+BAND_APP = 1
+
+
 class EventQueue:
-    """Min-heap of (time, seq, task) for one host.
+    """Min-heap of (time, band, key, seq, task) for one host.
 
     ``seq`` is a per-queue monotonically increasing insertion counter; it
     breaks ties deterministically (FIFO among same-time events) and makes the
-    heap ordering total without comparing task callables.
+    heap ordering total without comparing task callables. ``band``/``key``
+    impose a canonical order on same-time ties that is stable across
+    scheduler policies and data-plane backends (see BAND_NET above).
     """
 
     __slots__ = ("_heap", "_seq", "_live", "_cancelled")
 
     def __init__(self) -> None:
-        self._heap: list[tuple[SimTime, int, Callable[[], None]]] = []
+        self._heap: list[tuple[SimTime, int, int, int, Callable[[], None]]] = []
         self._seq = 0
         self._live: set[int] = set()  # seqs pushed and not yet popped
         self._cancelled: set[int] = set()
 
-    def push(self, time: SimTime, task: Callable[[], None]) -> int:
+    def push(self, time: SimTime, task: Callable[[], None],
+             band: int = BAND_APP, key: int = -1) -> int:
         """Schedule ``task`` at ``time``; returns a handle usable with cancel()."""
         seq = self._seq
         self._seq += 1
-        heapq.heappush(self._heap, (time, seq, task))
+        heapq.heappush(self._heap, (time, band, key if key >= 0 else seq, seq, task))
         self._live.add(seq)
         return seq
 
@@ -58,14 +72,14 @@ class EventQueue:
         """Pop the earliest event with time < end, else None."""
         self._drop_cancelled_head()
         if self._heap and self._heap[0][0] < end:
-            time, seq, task = heapq.heappop(self._heap)
+            time, _band, _key, seq, task = heapq.heappop(self._heap)
             self._live.discard(seq)
             return time, task
         return None
 
     def _drop_cancelled_head(self) -> None:
-        while self._heap and self._heap[0][1] in self._cancelled:
-            _, seq, _ = heapq.heappop(self._heap)
+        while self._heap and self._heap[0][3] in self._cancelled:
+            seq = heapq.heappop(self._heap)[3]
             self._cancelled.discard(seq)
             self._live.discard(seq)
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
-from shadow_tpu.core.events import EventQueue
+from shadow_tpu.core.events import BAND_APP, EventQueue
 from shadow_tpu.core.rng import host_rng
 from shadow_tpu.core.time import SimTime
 from shadow_tpu.network import unit as U
@@ -52,8 +52,9 @@ class Host:
     def now(self) -> SimTime:
         return self._now
 
-    def schedule(self, time: SimTime, fn: Callable[[], None]) -> int:
-        return self.equeue.push(time, fn)
+    def schedule(self, time: SimTime, fn: Callable[[], None],
+                 band: int = BAND_APP, key: int = -1) -> int:
+        return self.equeue.push(time, fn, band=band, key=key)
 
     def schedule_in(self, delay: SimTime, fn: Callable[[], None]) -> int:
         return self.equeue.push(self._now + delay, fn)

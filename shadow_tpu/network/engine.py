@@ -2,42 +2,64 @@
 
 This replaces the reference's per-packet Router/Relay push model (SURVEY.md
 §3.4) with a batched design: hosts emit units into host-local egress lists
-during a round; at the round barrier the engine assembles one flat batch,
-runs the depart kernel (numpy or TPU backend — same integer semantics), and
-scatters results back as arrival events on destination hosts' queues. The
-conservative-PDES invariant (every latency >= round width) guarantees all
-arrivals land in future rounds, so this single synchronization point per
-round is the only cross-host communication in the simulator — exactly the
-structure that maps onto an ICI mesh in the tpu_batch policy
-(shadow_tpu/parallel/).
+during a round; at the round barrier the engine assembles one flat batch and
+resolves EVERY unit in closed form — departure time from the fluid token
+buckets (shadow_tpu/network/fluid.py::TokenBuckets, O(1)/unit), arrival time
+from the APSP latency gather, and loss from counter-based draws. There is no
+retry queue: a unit that must wait for tokens gets its exact future departure
+time immediately, so backlog costs nothing per round (round 1 re-dispatched
+the whole backlog every round — VERDICT.md weak #1's ~105 ms × rounds).
 
-Batches are split into chunks of at most ``chunk_units`` units AND 2**30
-wire bytes; chunk boundaries are computed by this engine, identically for
-every backend, so int32 cumulative sums on the device are exact and
-bit-equality with the numpy backend survives chunking. (Head-of-line
-blocking is per-chunk: a source whose queue is split across chunks re-bases
-its cumulative drain against the tokens remaining after the earlier chunk —
-the same sequential semantics on both backends.)
+Loss draws are the one heavy computation (20-round threefry × MAX_PKTS per
+unit). They route either to the numpy twin (fluid.loss_flags) or to the
+device kernel (ops/propagate.py) — bit-identical by construction — based on
+batch size vs a calibrated floor. Device batches are read back
+*asynchronously with a causal deadline*: the flags are not needed until the
+earliest time any unit of the batch can arrive (or notify a loss), which is
+computable host-side; until then the readback streams in the background and
+subsequent rounds proceed. Event ordering is canonicalized with per-unit
+keys assigned at the emission barrier (core/events.py BAND_NET), so the
+inline and deferred paths produce byte-identical simulations.
 
 Ingress (down-link) token buckets are enforced at arrival time: an arrival
-event that finds insufficient ingress tokens parks the unit in the host's
-deferred queue, which the engine re-drains after each round's refill. This
-logic is shared by all backends, preserving cross-backend bit-equality.
+that finds insufficient ingress tokens parks the unit in the host's deferred
+queue, which the engine re-drains after each round's refill.
 
-Units whose route is unreachable (APSP latency >= INF) are "blackholed":
+Units whose route is unreachable (APSP latency == INF) are "blackholed":
 counted, then silently discarded — matching IP semantics for no-route.
 """
 
 from __future__ import annotations
 
+from collections import deque
+from dataclasses import dataclass
+from functools import partial
+
 import numpy as np
 
-from shadow_tpu.core.time import SimTime
-from shadow_tpu.network.fluid import CPUDataPlane, NetParams, clamped_refill
-from shadow_tpu.network.graph import INF_I32, NetworkGraph
+from shadow_tpu.core.events import BAND_NET
+from shadow_tpu.core.time import SimTime, T_NEVER
+from shadow_tpu.network.fluid import (
+    NetParams,
+    TokenBuckets,
+    clamped_refill,
+    loss_flags,
+)
+from shadow_tpu.network.graph import INF_I64, NetworkGraph
 from shadow_tpu.network.unit import Unit
 
-CHUNK_BYTES_CAP = 1 << 30
+
+@dataclass
+class _Outstanding:
+    """One dispatched draw batch awaiting its causal deadline."""
+
+    units: list  # list[Unit], batch order
+    arrival: np.ndarray  # (N,) int64 — depart + latency
+    notify: np.ndarray  # (N,) int64 — arrival + per-unit loss-notify extra
+    keys: np.ndarray  # (N,) int64 canonical event keys
+    round_end: SimTime  # barrier that emitted the batch
+    deadline: SimTime  # earliest event time any unit can produce
+    handle: object  # DrawHandle
 
 
 class NetworkEngine:
@@ -49,22 +71,34 @@ class NetworkEngine:
         self.hosts = hosts
         self.round_ns = round_ns
         self.backend = backend
-        self.chunk_units = int(getattr(tpu_options, "tpu_max_batch", 65536) or 65536)
+        self.buckets = TokenBuckets(params)
         self.tokens_down = params.cap_down.copy()
         self._last_refill: SimTime = 0
-        self.pending: list[list[Unit]] = [[] for _ in hosts]
-        self.n_pending = 0
+        self._ev_key = 0  # canonical per-unit event key counter
+        self.outstanding: deque[_Outstanding] = deque()
         self.units_sent = 0
         self.units_dropped = 0
         self.units_blackholed = 0
         self.bytes_sent = 0
-        self._up_refill_dt = 0  # accumulated elapsed ns awaiting up-link refill
-        if backend == "tpu":
-            from shadow_tpu.ops.propagate import DeviceDataPlane
 
-            self.plane = DeviceDataPlane(params, round_ns, tpu_options)
-        else:
-            self.plane = CPUDataPlane(params, round_ns)
+        self.max_batch = int(getattr(tpu_options, "tpu_max_batch", 65536) or 65536)
+        self.device = None
+        self.device_floor = float("inf")
+        self._auto_floor = False
+        if backend == "tpu":
+            from shadow_tpu.ops.propagate import DeviceDrawPlane
+
+            self.device = DeviceDrawPlane(params.seed, self.max_batch)
+            floor = int(getattr(tpu_options, "tpu_device_floor", 0) or 0)
+            if floor > 0:
+                self.device_floor = floor
+            else:
+                # auto: route to the device when it beats the numpy twin.
+                # Calibration (a probe dispatch + compile) is deferred until
+                # a batch first reaches the provisional floor, so runs whose
+                # batches never get that large pay nothing.
+                self._auto_floor = True
+                self.device_floor = 512
 
     # latency helpers ------------------------------------------------------
     def latency_between(self, src_host: int, dst_host: int) -> SimTime:
@@ -77,21 +111,25 @@ class NetworkEngine:
         departure, like a fast-retransmit signal)."""
         return self.latency_between(dst_host, src_host)
 
-    def has_pending(self) -> bool:
-        return self.n_pending > 0 or any(h.ingress_deferred for h in self.hosts)
+    # state queries (controller) -------------------------------------------
+    def has_immediate_work(self) -> bool:
+        """True if the next round must run even with empty event queues
+        (deferred ingress backlog waiting on token refill)."""
+        return any(h.ingress_deferred for h in self.hosts)
+
+    def earliest_outstanding(self) -> SimTime:
+        """Earliest event time any in-flight draw batch can produce."""
+        return min((b.deadline for b in self.outstanding), default=T_NEVER)
 
     # round hooks ----------------------------------------------------------
-    def start_of_round(self, round_start: SimTime) -> None:
-        """Refill both token buckets for the elapsed window and re-drain any
-        ingress-deferred units at the new round's start time."""
+    def start_of_round(self, round_start: SimTime, round_end: SimTime) -> None:
+        """Flush due draw results, refill the ingress buckets for the elapsed
+        window, and re-drain any ingress-deferred units."""
+        self.flush_due(round_end)
         dt = round_start - self._last_refill
         self._last_refill = round_start
         if dt > 0:
             p = self.params
-            # up-link refill is deferred to the round's first depart chunk
-            # (saves a device dispatch; tokens can only saturate while idle,
-            # and both backends defer identically)
-            self._up_refill_dt += dt
             add_down = clamped_refill(p.rate_down, p.cap_down, dt)
             self.tokens_down += np.minimum(add_down, p.cap_down - self.tokens_down)
         for host in self.hosts:
@@ -110,87 +148,116 @@ class NetworkEngine:
             self.hosts[u.dst].ingress_deferred.append(u)
 
     def end_of_round(self, round_start: SimTime, round_end: SimTime) -> None:
-        """The round barrier: batch all pending egress and run the kernel."""
-        for h in self.hosts:
+        """The round barrier: resolve all units emitted this round."""
+        units: list[Unit] = []
+        for h in self.hosts:  # host-id order == src-sorted FIFO, no sort
             if h.egress:
-                self.pending[h.id].extend(h.egress)
-                self.n_pending += len(h.egress)
+                units.extend(h.egress)
                 h.egress = []
-        if self.n_pending == 0:
+        n = len(units)
+        if n == 0:
             return
 
-        units: list[Unit] = []
-        for lst in self.pending:
-            units.extend(lst)
-        new_pending: list[list[Unit]] = [[] for _ in self.hosts]
-        n_left = 0
-
-        # chunk boundaries: identical for every backend (see module doc)
-        i = 0
-        n = len(units)
-        while i < n:
-            j = i
-            nbytes = 0
-            while j < n and j - i < self.chunk_units:
-                nbytes += units[j].size
-                if nbytes > CHUNK_BYTES_CAP and j > i:
-                    break
-                j += 1
-            n_left += self._run_chunk(units[i:j], round_start, round_end, new_pending)
-            i = j
-
-        self.pending = new_pending
-        self.n_pending = n_left
-
-    def _run_chunk(self, units: list[Unit], round_start: SimTime,
-                   round_end: SimTime, new_pending: list[list[Unit]]) -> int:
-        n = len(units)
         src = np.fromiter((u.src for u in units), dtype=np.int32, count=n)
-        dst = np.fromiter((u.dst for u in units), dtype=np.int32, count=n)
         size = np.fromiter((u.size for u in units), dtype=np.int32, count=n)
-        dep_off = np.fromiter(
-            (max(u.t_emit - round_start, 0) for u in units), dtype=np.int32, count=n
-        )
-        npkts = np.fromiter((u.npkts for u in units), dtype=np.int32, count=n)
-        uid = np.fromiter((u.uid for u in units), dtype=np.uint64, count=n)
-        uid_lo = (uid & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-        uid_hi = (uid >> np.uint64(32)).astype(np.uint32)
+        t_emit = np.fromiter((u.t_emit for u in units), dtype=np.int64, count=n)
+        depart = self.buckets.depart_times(src, size, t_emit, round_start)
 
-        refill_dt, self._up_refill_dt = self._up_refill_dt, 0
-        sent, dropped, arrival_off = self.plane.depart_chunk(
-            src, dst, size, dep_off, npkts, uid_lo, uid_hi, self.chunk_units,
-            refill_dt=refill_dt,
-        )
+        dst = np.fromiter((u.dst for u in units), dtype=np.int32, count=n)
+        sn = self.params.host_node[src]
+        dn = self.params.host_node[dst]
+        lat = self.graph.latency_ns[sn, dn]
 
-        n_left = 0
-        inf = int(INF_I32)
+        reach = lat < INF_I64
+        n_bh = n - int(reach.sum())
+        if n_bh:
+            self.units_blackholed += n_bh
+            units = [u for u, ok in zip(units, reach) if ok]
+            if not units:
+                return
+            src, dst, sn, dn = src[reach], dst[reach], sn[reach], dn[reach]
+            depart, lat = depart[reach], lat[reach]
+            n = len(units)
+
+        arrival = depart + lat
+        thresh = self.params.drop_thresh[sn, dn]
+        extra = np.fromiter((u.loss_extra_ns for u in units), dtype=np.int64, count=n)
+        notify = arrival + extra
+        keys = np.arange(self._ev_key, self._ev_key + n, dtype=np.int64)
+        self._ev_key += n
+
+        use_device = (
+            self.device is not None
+            and n >= self.device_floor
+            and bool((thresh > 0).any())
+        )
+        if use_device and self._auto_floor:
+            self._auto_floor = False
+            dev_s, np_per_unit = self.device.calibrate()
+            if np_per_unit > 0:
+                self.device_floor = max(512, min(
+                    int(dev_s / np_per_unit), self.max_batch))
+            use_device = n >= self.device_floor
+        if not use_device:
+            flags = loss_flags(self.params.seed, *_uid_arrays(units, n), thresh)
+            self._schedule_batch(units, arrival, notify, flags, keys, round_end)
+            return
+        for i in range(0, n, self.max_batch):
+            j = min(n, i + self.max_batch)
+            lo, hi, npk = _uid_arrays(units[i:j], j - i)
+            handle = self.device.dispatch(lo, hi, npk, thresh[i:j])
+            deadline = max(round_end, int(arrival[i:j].min()))
+            self.outstanding.append(_Outstanding(
+                units[i:j], arrival[i:j], notify[i:j], keys[i:j],
+                round_end, deadline, handle,
+            ))
+
+    # result consumption ----------------------------------------------------
+    def flush_due(self, limit: SimTime) -> None:
+        """Materialize every in-flight batch whose deadline precedes
+        ``limit`` (the end of the round about to run). Batches flush in
+        emission order; canonical keys make the order immaterial anyway."""
+        if not self.outstanding:
+            return
+        due = [b for b in self.outstanding if b.deadline < limit]
+        if not due:
+            return
+        self.outstanding = deque(b for b in self.outstanding if b.deadline >= limit)
+        for b in due:
+            self._schedule_batch(b.units, b.arrival, b.notify,
+                                 b.handle.read(), b.keys, b.round_end)
+
+    def flush_all(self) -> None:
+        self.flush_due(T_NEVER + 1)
+
+    def _schedule_batch(self, units, arrival, notify, dropped, keys,
+                        round_end: SimTime) -> None:
+        sent = 0
+        nbytes = 0
         for i, u in enumerate(units):
-            if not sent[i]:
-                new_pending[u.src].append(u)
-                n_left += 1
-            elif arrival_off[i] >= inf:
-                # no route (also reads as 100% loss): discard silently, like
-                # IP with no route — must precede the drop check
-                self.units_blackholed += 1
-            elif dropped[i]:
+            if dropped[i]:
                 self.units_dropped += 1
                 if u.on_loss is not None:
-                    t_notify = max(u.t_emit, round_start) + self.latency_between(
-                        u.src, u.dst) + u.loss_extra_ns
                     who = u.loss_host if u.loss_host is not None else u.src
-                    self.hosts[who].schedule(max(t_notify, round_end), u.on_loss)
+                    self.hosts[who].schedule(
+                        max(int(notify[i]), round_end), u.on_loss,
+                        band=BAND_NET, key=int(keys[i]))
             else:
-                self.units_sent += 1
-                self.bytes_sent += u.size
-                # clamp keeps causality when experimental.runahead widens the
-                # round beyond the graph's min latency
-                t_arr = max(round_start + int(arrival_off[i]), round_end)
-                self.hosts[u.dst].schedule(t_arr, _make_arrival(self, u, t_arr))
-        return n_left
+                sent += 1
+                nbytes += u.size
+                # clamp keeps causality when experimental.runahead widens
+                # the round beyond the graph's min latency
+                t_arr = max(int(arrival[i]), round_end)
+                self.hosts[u.dst].schedule(
+                    t_arr, partial(self.ingress_arrival, u, t_arr),
+                    band=BAND_NET, key=int(keys[i]))
+        self.units_sent += sent
+        self.bytes_sent += nbytes
 
 
-def _make_arrival(engine: NetworkEngine, u: Unit, t_arr: SimTime):
-    def arrive() -> None:
-        engine.ingress_arrival(u, t_arr)
-
-    return arrive
+def _uid_arrays(units, n):
+    uid = np.fromiter((u.uid for u in units), dtype=np.uint64, count=n)
+    lo = (uid & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi = (uid >> np.uint64(32)).astype(np.uint32)
+    npk = np.fromiter((u.npkts for u in units), dtype=np.uint32, count=n)
+    return lo, hi, npk

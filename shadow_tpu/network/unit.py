@@ -26,7 +26,7 @@ SYN, SYNACK, DATA, ACK, FIN, FINACK, DGRAM = range(7)
 KIND_NAMES = ("SYN", "SYNACK", "DATA", "ACK", "FIN", "FINACK", "DGRAM")
 
 
-@dataclass
+@dataclass(slots=True)
 class Unit:
     uid: int
     src: int  # source host id

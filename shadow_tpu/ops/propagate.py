@@ -1,49 +1,49 @@
-"""TPU kernel for the per-round egress hot path.
+"""TPU kernel for the data-plane hot math: batched per-packet loss draws.
 
-This is the device twin of shadow_tpu/network/fluid.py::depart_round — the
-re-design of the reference's Router/Relay token bucket + routing lookup
-(SURVEY.md §3.4, BASELINE.json north_star) as one fused XLA program:
-
-    per-source FIFO cumulative token drain -> APSP latency gather ->
-    per-packet threefry loss draws -> arrival offsets
+Round-2 redesign. The bucket/departure math moved to a closed form with ONE
+host-side implementation (shadow_tpu/network/fluid.py::TokenBuckets) — it is
+O(1) integer work per unit and needs no twin. What remains hot is the loss
+sampling: 20-round threefry2x32 × MAX_PKTS counters per unit (hundreds of
+integer ops each), a pure function of (seed, uid, npkts, threshold) with no
+state and no feedback — exactly the shape the TPU's vector unit wants.
 
 Design notes (TPU-first):
-- Static shapes: unit batches are padded to power-of-two buckets (bounded
-  set of compiled shapes); a boolean mask marks real entries. The engine
-  chunks batches so per-chunk byte totals stay below 2**31, making int32
-  cumulative sums exact (both backends chunk identically, so bit-equality
-  survives chunking).
-- int32 everywhere on device: times are offsets from the round start (the
-  engine re-bases), token capacities are validated < 2**31 at build, and
-  finite latencies are validated < 2**30 (INF_I32) for device use; >= INF
-  arrival offsets are blackholed by the engine on every backend. No int64
-  emulation on the device path.
-- Token refill is overflow-safe without int64: the host pre-clamps the add
-  to the capacity, the device applies tokens += min(add, cap - tokens),
-  which equals min(tokens + true_add, cap) exactly.
-- Loss draws are threefry2x32 — identical integer arithmetic to the numpy
-  twin (shadow_tpu/ops/prng.py), keyed on (seed, uid, packet index), so
-  drops are a pure function of unit identity on every backend.
-- Tokens live on the device between rounds (donated buffers); per round the
-  only host->device traffic is the unit batch + refill vector, the only
-  device->host traffic is the three result arrays.
+- Stateless kernel: the host gathers each unit's q24 drop threshold from the
+  (G,G) table and ships ONE packed (4, P) uint32 array; the kernel returns
+  one (P,) bool. No device-resident state, no donation, no coherence with
+  the host bucket state — which also makes the kernel trivially shardable
+  over a mesh (shadow_tpu/parallel/) and lets small batches route to the
+  bit-identical numpy twin (fluid.loss_flags) with no semantic difference.
+- Static shapes: batches pad to power-of-two buckets between MIN_BUCKET and
+  the configured cap, so at most ~log2(cap) shapes ever compile.
+- Deferred readback: results are copied device->host asynchronously
+  (copy_to_host_async) and only *consumed* when the simulation clock reaches
+  the batch's causal deadline — the earliest time any unit's arrival or
+  loss notification can fire, which the engine computes host-side without
+  the flags. On links where the device->host path has high latency (e.g. a
+  tunneled chip) the readback overlaps subsequent rounds instead of
+  stalling each one; this is what fixes round 1's ~100 ms-per-round sync
+  (VERDICT.md weak #1).
+- calibrate() measures the real dispatch+readback latency and the numpy
+  twin's per-unit cost once at startup, giving the engine an evidence-based
+  floor for routing batches. Because both paths produce identical flags and
+  event ordering is canonicalized (core/events.py BAND_NET), the floor can
+  NOT affect simulation results — calibration is determinism-safe.
 """
 
 from __future__ import annotations
 
 import functools
+import time as _walltime
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
-from shadow_tpu.network.fluid import MAX_PKTS, NetParams
-from shadow_tpu.network.graph import INF_I32
-from shadow_tpu.ops.prng import threefry2x32
+from shadow_tpu.network.fluid import MAX_PKTS, loss_flags
 
-#: padded-bucket floor; buckets are powers of two between MIN and the
-#: engine's chunk cap, so at most ~log2(cap) shapes ever compile
+#: padded-bucket floor; buckets are powers of two between MIN and the cap
 MIN_BUCKET = 256
 
 
@@ -55,137 +55,85 @@ def _bucket(n: int, cap: int) -> int:
     return b
 
 
-@functools.partial(jax.jit, static_argnames=("seed",), donate_argnums=(0,))
-def _depart_kernel(tokens, add, cap, ints, uids, lat32, thresh, host_node, seed):
-    """One padded chunk, with the round's token refill fused in.
+@functools.partial(jax.jit, static_argnames=("seed",))
+def _draw_kernel(packed, seed):
+    """packed: (4, P) uint32 rows [uid_lo, uid_hi, npkts, thresh]; returns
+    (P,) bool dropped flags. Mirrors fluid.loss_flags exactly: a unit drops
+    iff any of its first npkts threefry draws is below its q24 threshold.
+    (Padded entries carry thresh == 0, which can never hit.)"""
+    from shadow_tpu.ops.prng import threefry2x32
 
-    tokens: (H,) int32, donated. add: (H,) int32 refill (zeros after the
-    first chunk of a round). ints: (5, P) int32 rows [src, dst, size,
-    dep_off, npkts]; uids: (2, P) uint32 rows [uid_lo, uid_hi]. Padded
-    entries carry src == H (sentinel segment) and size 0."""
-    nhosts = tokens.shape[0]
-    src, dst, size, dep_off, npkts = ints
-    uid_lo, uid_hi = uids
-    valid = src < nhosts
-
-    tokens = tokens + jnp.minimum(add, cap - tokens)  # overflow-safe refill
-
-    # per-source FIFO cumulative drain (src-sorted; padding sorts last)
-    size_m = jnp.where(valid, size, 0)
-    csum = jnp.cumsum(size_m, dtype=jnp.int32)
-    prev_src = jnp.concatenate([jnp.full((1,), -1, jnp.int32), src[:-1]])
-    seg_first = src != prev_src
-    base = jnp.where(seg_first, csum - size_m, 0)
-    base = jax.lax.cummax(base)
-    cum_in_seg = csum - base
-    sent = (cum_in_seg <= tokens[jnp.minimum(src, nhosts - 1)]) & valid
-
-    drained = jax.ops.segment_sum(
-        jnp.where(sent, size_m, 0), src, num_segments=nhosts + 1,
-        indices_are_sorted=True,
-    )[:nhosts]
-    tokens = tokens - drained.astype(jnp.int32)
-
-    sn = host_node[jnp.minimum(src, host_node.shape[0] - 1)]
-    dn = host_node[dst]
-    lat = lat32[sn, dn]
-    th = thresh[sn, dn]
-
+    uid_lo, uid_hi, npkts, thresh = packed
+    p = uid_lo.shape[0]
     pkt = jnp.arange(MAX_PKTS, dtype=jnp.uint32)[None, :]
-    c0 = jnp.broadcast_to(uid_lo[:, None], (uid_lo.shape[0], MAX_PKTS))
+    c0 = jnp.broadcast_to(uid_lo[:, None], (p, MAX_PKTS))
     c1 = uid_hi[:, None] | (pkt << jnp.uint32(28))
     k0 = jnp.uint32(seed & 0xFFFFFFFF)
     k1 = jnp.uint32((seed >> 32) & 0xFFFFFFFF)
     draws, _ = threefry2x32(k0, k1, c0, c1, xp=jnp)
     draws = (draws >> jnp.uint32(8)).astype(jnp.uint32)
-    hit = (draws < th[:, None]) & (pkt < npkts.astype(jnp.uint32)[:, None])
-    dropped = sent & jnp.any(hit, axis=1)
-
-    arrival_off = dep_off + lat
-    return tokens, sent, dropped, arrival_off
+    hit = (draws < thresh[:, None]) & (pkt < npkts[:, None])
+    return jnp.any(hit, axis=1)
 
 
-class DeviceDataPlane:
-    """Device-resident egress data plane (up-link tokens live on the TPU).
+class DrawHandle:
+    """An in-flight device draw: read() yields the (n,) bool flags."""
 
-    Interface contract shared with the numpy twin
-    (shadow_tpu/network/fluid.py::CPUDataPlane): the engine accumulates
-    refill time and hands it to the first depart of a round; both twins
-    compute the refill vector with the same clamped_refill() integer math.
+    __slots__ = ("_arr", "_n")
+
+    def __init__(self, arr, n: int) -> None:
+        self._arr = arr
+        self._n = n
+
+    def read(self) -> np.ndarray:
+        return np.asarray(self._arr)[: self._n]
+
+
+class DeviceDrawPlane:
+    """Dispatches loss-draw batches to the accelerator.
+
+    The numpy twin is fluid.loss_flags; tests/test_bitmatch.py asserts the
+    two produce identical flags for identical inputs.
     """
 
     name = "tpu"
 
-    def __init__(self, params: NetParams, round_ns: int, options=None,
-                 device=None) -> None:
-        from shadow_tpu.network.fluid import clamped_refill
+    def __init__(self, seed: int, max_batch: int = 65536) -> None:
+        self.seed = int(seed)
+        self.max_batch = int(max_batch)
 
-        self.params = params
-        lat = params.latency_ns
-        finite = lat[lat < np.int64(INF_I32)]
-        if finite.size and finite.max() >= np.int64(INF_I32):
-            raise ValueError(
-                "graph has finite path latencies >= ~1.07s; the int32 device "
-                "data plane cannot represent them — use a CPU scheduler policy"
-            )
-        self.round_ns = int(round_ns)
-        self.lat32 = jnp.asarray(np.minimum(lat, np.int64(INF_I32)).astype(np.int32))
-        self.thresh = jnp.asarray(params.drop_thresh)
-        self.host_node = jnp.asarray(params.host_node)
-        self.cap32 = jnp.asarray(params.cap_up.astype(np.int32))
-        self.tokens = jnp.asarray(params.cap_up.astype(np.int32))
-        self.seed = int(params.seed)
-        # cached refill vectors: the standard round width (the common case)
-        # and zeros (later chunks) never leave the device
-        self._std_add = jnp.asarray(
-            clamped_refill(params.rate_up, params.cap_up, self.round_ns
-                           ).astype(np.int32))
-        self._zero_add = jnp.zeros_like(self._std_add)
-        self._clamped_refill = clamped_refill
+    def dispatch(self, uid_lo: np.ndarray, uid_hi: np.ndarray,
+                 npkts: np.ndarray, thresh: np.ndarray) -> DrawHandle:
+        """Launch one batch (any length <= max_batch) and start the async
+        device->host copy; returns a handle to read when due."""
+        n = uid_lo.shape[0]
+        p = _bucket(n, self.max_batch)
+        packed = np.zeros((4, p), dtype=np.uint32)
+        packed[0, :n] = uid_lo
+        packed[1, :n] = uid_hi
+        packed[2, :n] = npkts
+        packed[3, :n] = thresh
+        out = _draw_kernel(jnp.asarray(packed), seed=self.seed)
+        try:
+            out.copy_to_host_async()
+        except AttributeError:  # some backends lack the hint; read() suffices
+            pass
+        return DrawHandle(out, n)
 
-    def tokens_host(self) -> np.ndarray:
-        return np.asarray(self.tokens).astype(np.int64)
-
-    def _add_for(self, refill_dt: int):
-        if refill_dt == 0:
-            return self._zero_add
-        if refill_dt == self.round_ns:
-            return self._std_add
-        p = self.params
-        return jnp.asarray(
-            self._clamped_refill(p.rate_up, p.cap_up, refill_dt).astype(np.int32))
-
-    def depart_chunk(self, src, dst, size, dep_off, npkts, uid_lo, uid_hi,
-                     chunk_cap: int, refill_dt: int = 0):
-        """Run one (unpadded, src-sorted) chunk; refill_dt is the elapsed ns
-        to refill for before draining (first chunk of a round only).
-        Returns numpy (sent, dropped, arrival_off[int64])."""
-        n = src.shape[0]
-        p = _bucket(n, chunk_cap)
-        pad = p - n
-        nhosts = int(self.cap32.shape[0])
-
-        ints = np.empty((5, p), dtype=np.int32)
-        for row, (a, fill) in enumerate(
-            ((src, nhosts), (dst, 0), (size, 0), (dep_off, 0), (npkts, 0))
-        ):
-            ints[row, :n] = a
-            ints[row, n:] = fill
-        uids = np.zeros((2, p), dtype=np.uint32)
-        uids[0, :n] = uid_lo
-        uids[1, :n] = uid_hi
-
-        tokens, sent, dropped, arrival_off = _depart_kernel(
-            self.tokens,
-            self._add_for(refill_dt),
-            self.cap32,
-            jnp.asarray(ints),
-            jnp.asarray(uids),
-            self.lat32,
-            self.thresh,
-            self.host_node,
-            seed=self.seed,
-        )
-        self.tokens = tokens
-        sent, dropped, arrival_off = jax.device_get((sent, dropped, arrival_off))
-        return sent[:n], dropped[:n], arrival_off[:n].astype(np.int64)
+    def calibrate(self, n_probe: int = 4096) -> tuple[float, float]:
+        """Measure (device seconds per dispatch+readback at n_probe, numpy
+        seconds per unit). Used by the engine to set the routing floor; has
+        no effect on simulation results (both paths are bit-identical)."""
+        rng = np.random.default_rng(0)
+        lo = rng.integers(0, 1 << 32, n_probe, dtype=np.uint64).astype(np.uint32)
+        hi = rng.integers(0, 1 << 32, n_probe, dtype=np.uint64).astype(np.uint32)
+        npk = np.full(n_probe, MAX_PKTS, np.uint32)
+        th = np.full(n_probe, 1 << 10, np.uint32)
+        self.dispatch(lo, hi, npk, th).read()  # compile + warm
+        t0 = _walltime.perf_counter()
+        self.dispatch(lo, hi, npk, th).read()
+        dev_s = _walltime.perf_counter() - t0
+        t0 = _walltime.perf_counter()
+        loss_flags(self.seed, lo, hi, npk, th)
+        np_per_unit = (_walltime.perf_counter() - t0) / n_probe
+        return dev_s, np_per_unit
