@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Benchmark harness (VERDICT.md round-1 item #2; BASELINE.md metric).
+
+Default mode runs the headline benchmark and prints EXACTLY ONE JSON line:
+
+    {"metric": "sim_sec_per_wall_sec_tgen1k_tpu_batch", "value": ...,
+     "unit": "sim-sec/wall-sec", "vs_baseline": ...}
+
+where vs_baseline is the ratio against the thread_per_core CPU policy on the
+SAME machine and config (BASELINE.md records no absolute reference numbers —
+the reference mount was empty — so the baseline is the reference's own
+headline CPU policy re-implemented here, per BASELINE.json north_star).
+
+``--all`` additionally measures every committed benchmark config under both
+policies plus the raw draw-plane device-vs-numpy throughput, writing
+BENCH_DETAIL.json next to this file. Progress goes to stderr; stdout carries
+only the single JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def run_config(path: str, policy: str, tag: str) -> dict:
+    from shadow_tpu.config import load_config
+    from shadow_tpu.core.controller import Controller
+
+    cfg = load_config(str(ROOT / path), {
+        "experimental.scheduler_policy": policy,
+        "general.data_directory": f"/tmp/shadow-bench-{tag}",
+    })
+    t0 = time.perf_counter()
+    result = Controller(cfg, mirror_log=False).run()
+    result["total_wall_seconds"] = time.perf_counter() - t0  # incl. build
+    if result["process_errors"]:
+        log(f"WARNING {tag}: {len(result['process_errors'])} process errors")
+    log(
+        f"{tag}: {result['sim_sec_per_wall_sec']:.3f} sim-sec/wall-sec "
+        f"({result['events']} events, {result['units_sent']} units, "
+        f"{result['wall_seconds']:.2f}s loop wall)"
+    )
+    return result
+
+
+def draw_plane_throughput(n: int = 1_000_000) -> dict:
+    """Raw loss-draw throughput, device vs numpy twin, at a config-#5-scale
+    batch — the per-round math a 100k-host simulation would batch."""
+    import numpy as np
+
+    from shadow_tpu.network.fluid import MAX_PKTS, loss_flags
+    from shadow_tpu.ops.propagate import DeviceDrawPlane
+
+    rng = np.random.default_rng(0)
+    lo = rng.integers(0, 1 << 32, n, dtype=np.uint64).astype(np.uint32)
+    hi = rng.integers(0, 1 << 32, n, dtype=np.uint64).astype(np.uint32)
+    npk = np.full(n, MAX_PKTS, np.uint32)
+    th = np.full(n, 1 << 12, np.uint32)
+
+    plane = DeviceDrawPlane(seed=7, max_batch=1 << 20)
+    plane.dispatch(lo, hi, npk, th).read()  # warm/compile the full bucket
+    t0 = time.perf_counter()
+    dev_flags = plane.dispatch(lo, hi, npk, th).read()
+    dev_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    np_flags = loss_flags(7, lo, hi, npk, th)
+    np_s = time.perf_counter() - t0
+    assert (dev_flags == np_flags).all(), "draw-plane bitmatch violated"
+    out = {
+        "batch": n,
+        "device_units_per_sec": n / dev_s,
+        "numpy_units_per_sec": n / np_s,
+        "device_speedup": np_s / dev_s,
+    }
+    log(f"draw-plane @1M units: device {out['device_units_per_sec']:.3g}/s "
+        f"vs numpy {out['numpy_units_per_sec']:.3g}/s "
+        f"({out['device_speedup']:.1f}x)")
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--all", action="store_true",
+                    help="full matrix + BENCH_DETAIL.json")
+    ap.add_argument("--config", default="examples/tgen_1k.yaml",
+                    help="headline config (default: BASELINE config #2)")
+    args = ap.parse_args()
+
+    detail: dict = {"machine_note": "tpu_batch uses the local JAX default "
+                    "device; thread_per_core is the CPU baseline policy"}
+
+    # best-of-2 per policy: single runs vary ~±10% on a shared machine
+    base = max((run_config(args.config, "thread_per_core", "tpc")
+                for _ in range(2)), key=lambda r: r["sim_sec_per_wall_sec"])
+    tpu = max((run_config(args.config, "tpu_batch", "tpu")
+               for _ in range(2)), key=lambda r: r["sim_sec_per_wall_sec"])
+    headline = {
+        "metric": "sim_sec_per_wall_sec_tgen1k_tpu_batch",
+        "value": round(tpu["sim_sec_per_wall_sec"], 4),
+        "unit": "sim-sec/wall-sec",
+        "vs_baseline": round(
+            tpu["sim_sec_per_wall_sec"] / base["sim_sec_per_wall_sec"], 4),
+    }
+    detail["tgen_1k"] = {"thread_per_core": base, "tpu_batch": tpu}
+
+    # results must be identical across policies — a benchmark that diverged
+    # would be measuring two different simulations
+    for k in ("events", "units_sent", "units_dropped", "bytes_sent"):
+        assert base[k] == tpu[k], f"policy divergence on {k}"
+
+    if args.all:
+        for path, tag in (("examples/tgen_100host.yaml", "tgen_100"),
+                          ("examples/gossip_10k.yaml", "gossip_10k")):
+            detail[tag] = {
+                "thread_per_core": run_config(path, "thread_per_core", f"{tag}-tpc"),
+                "tpu_batch": run_config(path, "tpu_batch", f"{tag}-tpu"),
+            }
+            for k in ("events", "units_sent", "units_dropped"):
+                assert (detail[tag]["thread_per_core"][k]
+                        == detail[tag]["tpu_batch"][k]), (tag, k)
+        detail["draw_plane"] = draw_plane_throughput()
+        for tag in ("tgen_1k", "tgen_100", "gossip_10k"):
+            for pol in detail[tag]:
+                detail[tag][pol].pop("counters", None)
+                detail[tag][pol].pop("process_errors", None)
+        (ROOT / "BENCH_DETAIL.json").write_text(json.dumps(detail, indent=2))
+        log("wrote BENCH_DETAIL.json")
+
+    print(json.dumps(headline), flush=True)
+
+
+if __name__ == "__main__":
+    main()

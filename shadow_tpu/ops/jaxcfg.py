@@ -1,0 +1,32 @@
+"""Central JAX runtime configuration for shadow_tpu kernels.
+
+Import-and-call before any kernel dispatch. Enables the persistent
+compilation cache so the (20-40 s on TPU) first-compile cost of each padded
+batch shape is paid once per machine, not once per process — a simulation
+binary is a short-lived CLI, unlike a training job.
+"""
+
+from __future__ import annotations
+
+import os
+
+_done = False
+
+
+def configure() -> None:
+    global _done
+    if _done:
+        return
+    _done = True
+    import jax
+
+    cache = os.environ.get(
+        "SHADOW_TPU_JAX_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "shadow_tpu", "jax"),
+    )
+    try:
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # cache is an optimization; never fail the sim for it
+        pass

@@ -73,7 +73,9 @@ def _draw_kernel(packed, seed):
     draws, _ = threefry2x32(k0, k1, c0, c1, xp=jnp)
     draws = (draws >> jnp.uint32(8)).astype(jnp.uint32)
     hit = (draws < thresh[:, None]) & (pkt < npkts[:, None])
-    return jnp.any(hit, axis=1)
+    # bit-pack the flags: the device->host readback is the scarce resource
+    # (see module doc), so ship 1 bit per unit, not 1 byte
+    return jnp.packbits(jnp.any(hit, axis=1), bitorder="little")
 
 
 class DrawHandle:
@@ -86,7 +88,8 @@ class DrawHandle:
         self._n = n
 
     def read(self) -> np.ndarray:
-        return np.asarray(self._arr)[: self._n]
+        packed = np.asarray(self._arr)
+        return np.unpackbits(packed, bitorder="little")[: self._n].astype(bool)
 
 
 class DeviceDrawPlane:
@@ -99,6 +102,9 @@ class DeviceDrawPlane:
     name = "tpu"
 
     def __init__(self, seed: int, max_batch: int = 65536) -> None:
+        from shadow_tpu.ops.jaxcfg import configure
+
+        configure()
         self.seed = int(seed)
         self.max_batch = int(max_batch)
 
