@@ -1,0 +1,1 @@
+from shadow_tpu.parallel.mesh import MeshDataPlane  # noqa: F401

@@ -1,0 +1,264 @@
+"""The multi-chip data plane: one simulation round as an SPMD mesh program.
+
+This is the scale-out architecture for the north star (SURVEY.md §5.8, §7
+phase 3): hosts are sharded round-robin over a ``jax.sharding.Mesh`` axis;
+each shard owns its hosts' closed-form egress buckets (the same integer
+semantics as shadow_tpu/network/fluid.py::TokenBuckets — asserted bit-equal
+in tests/test_multichip.py) and each round executes ONE collective program:
+
+    per-shard closed-form departures  (local bucket state, no communication)
+    -> APSP latency gather            (replicated (G,G) table)
+    -> per-packet threefry loss draws (pure function of unit identity)
+    -> lax.all_to_all                 (route arrivals to their dst shards, ICI)
+    -> lax.pmin                       (the conservative-lookahead barrier)
+    -> lax.psum                       (global sent/dropped counters)
+
+The reference's analog of the pmin barrier is the pthread round barrier in
+its scheduler (SURVEY.md §2 "Parallelism strategies" item 4); the all_to_all
+replaces its shared-memory cross-host event push. Neither has reference
+code to mirror — upstream is single-machine — so this layer is pure design
+freedom exercised the JAX way: shard_map over a named mesh axis, collectives
+riding ICI, static shapes (per-shard unit slots and a full-width exchange
+table) so the whole round is one XLA program.
+
+Determinism: all math is integer (int64 times, uint32 hashes); collectives
+permute data but every value is a pure function of unit identity, so any
+shard count yields bit-identical simulations (tested vs the host plane).
+
+Scale notes: the exchange table is (N, C, 4) int64 per shard with C = the
+per-shard unit-slot count — worst-case capacity (every unit to one shard).
+At pod scale C stays bounded by the per-round emission budget per shard, and
+the table rides ICI, not HBM-resident state; per-shard bucket state is O(H/N).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from shadow_tpu.core.time import NS_PER_SEC
+from shadow_tpu.network.fluid import MAX_PKTS, MIN_CAP, MTU, NetParams
+from shadow_tpu.ops.jaxcfg import configure
+from shadow_tpu.ops.prng import threefry2x32
+
+AXIS = "shard"
+
+#: field order in the exchange table (int64): destination local host id,
+#: arrival time (ns), uid (packed 64-bit), flags (bit0 dropped, bit1 valid)
+F_DST, F_TARR, F_UID, F_FLAGS = range(4)
+
+
+def _bytes_over(rate, dt):
+    q, r = dt // NS_PER_SEC, dt % NS_PER_SEC
+    frac = (rate.astype(jnp.uint64) * r.astype(jnp.uint64)
+            // jnp.uint64(NS_PER_SEC)).astype(jnp.int64)
+    return rate * q + frac
+
+
+def _ceil_ns(need, rate):
+    q, r = need // rate, need % rate
+    frac = ((r.astype(jnp.uint64) * jnp.uint64(NS_PER_SEC)
+             + rate.astype(jnp.uint64) - jnp.uint64(1))
+            // rate.astype(jnp.uint64)).astype(jnp.int64)
+    return q * NS_PER_SEC + frac
+
+
+def _round_step(n_shards, seed, state, units, tables, t_now):
+    """One shard's view of the round. All ``units`` arrays are (1, C) blocks
+    (shard_map splits the global (N, C)); state is (1, Hs). tables
+    (host_node, lat, thresh, rate, cap) are replicated."""
+    t_base, tokens, debt = (s[0] for s in state)
+    src_l, dst_g, size, t_emit, uid = (u[0] for u in units)
+    host_node, lat_ns, thresh, rate_all, cap_all = tables
+    me = lax.axis_index(AXIS)
+    hs = t_base.shape[0]
+    c = src_l.shape[0]
+    valid = src_l < hs
+
+    # my hosts' global ids: h = local * N + me; parameters gathered from the
+    # replicated tables (padded hosts carry rate 1 / cap MIN_CAP upstream)
+    my_global = jnp.arange(hs, dtype=jnp.int64) * n_shards + me
+    rate = rate_all[my_global]
+    cap = cap_all[my_global]
+
+    # lazy saturation rebase at the barrier (fluid.TokenBuckets.rebase)
+    avail = tokens + _bytes_over(rate, t_now - t_base) - debt
+    sat = avail > cap
+    t_base = jnp.where(sat, t_now, t_base)
+    tokens = jnp.where(sat, cap, tokens)
+    debt = jnp.where(sat, 0, debt)
+
+    # per-source FIFO cumulative bytes (src-sorted; padding sorts last)
+    size_m = jnp.where(valid, size, 0)
+    csum = jnp.cumsum(size_m)
+    prev = jnp.concatenate([jnp.full((1,), -1, src_l.dtype), src_l[:-1]])
+    seg_first = src_l != prev
+    seg_base = jax.lax.cummax(jnp.where(seg_first, csum - size_m, 0))
+    cum_in_seg = csum - seg_base
+
+    sl = jnp.minimum(src_l, hs - 1)
+    need = debt[sl] + cum_in_seg - tokens[sl]
+    t_ready = jnp.where(need > 0, t_base[sl] + _ceil_ns(need, rate[sl]), 0)
+    t_dep = jnp.maximum(t_emit, t_ready)
+
+    drained = jax.ops.segment_sum(size_m, sl, num_segments=hs,
+                                  indices_are_sorted=True)
+    debt = debt + drained
+
+    # latency + loss threshold gather
+    src_g = sl.astype(jnp.int64) * n_shards + me
+    sn = host_node[jnp.minimum(src_g, host_node.shape[0] - 1)]
+    dn = host_node[jnp.minimum(dst_g, host_node.shape[0] - 1)]
+    lat = lat_ns[sn, dn]
+    th = thresh[sn, dn]
+    t_arr = t_dep + lat
+
+    # per-packet threefry draws — identical integer math to fluid.loss_flags
+    uid_lo = (uid & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    uid_hi = ((uid >> jnp.int64(32)) & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    npkts = jnp.minimum(jnp.maximum(1, -(-size // MTU)), MAX_PKTS)
+    pkt = jnp.arange(MAX_PKTS, dtype=jnp.uint32)[None, :]
+    c0 = jnp.broadcast_to(uid_lo[:, None], (c, MAX_PKTS))
+    c1 = uid_hi[:, None] | (pkt << jnp.uint32(28))
+    draws, _ = threefry2x32(jnp.uint32(seed & 0xFFFFFFFF),
+                            jnp.uint32((seed >> 32) & 0xFFFFFFFF),
+                            c0, c1, xp=jnp)
+    draws = (draws >> jnp.uint32(8)).astype(jnp.uint32)
+    hit = (draws < th[:, None]) & (pkt < npkts.astype(jnp.uint32)[:, None])
+    dropped = jnp.any(hit, axis=1) & valid
+
+    # route arrivals to their destination shards: stable-sort by dst shard,
+    # rank within group, scatter into the (N, C) exchange table, all_to_all
+    dst_shard = jnp.where(valid, dst_g % n_shards, n_shards)  # pad -> dropped
+    order = jnp.argsort(dst_shard, stable=True)
+    ds = dst_shard[order]
+    first = jnp.searchsorted(ds, ds, side="left")
+    rank = jnp.arange(c) - first
+    flags = (dropped.astype(jnp.int64) | (valid.astype(jnp.int64) << 1))
+    payload = jnp.stack(
+        [(dst_g // n_shards).astype(jnp.int64), t_arr, uid, flags], axis=-1
+    )[order]
+    table = jnp.zeros((n_shards, c, 4), dtype=jnp.int64)
+    table = table.at[ds, rank].set(payload, mode="drop")
+    received = lax.all_to_all(table, AXIS, split_axis=0, concat_axis=0)
+
+    # the conservative-lookahead barrier: global earliest arrival (pmin) —
+    # the controller's next-round window bound in a multi-controller setup
+    inf = jnp.int64(1) << jnp.int64(62)
+    local_min = jnp.min(jnp.where(valid, t_arr, inf))
+    g_min = lax.pmin(local_min, AXIS)
+
+    sent_ct = lax.psum(jnp.sum(valid & ~dropped), AXIS)
+    drop_ct = lax.psum(jnp.sum(dropped), AXIS)
+
+    state_out = (t_base[None], tokens[None], debt[None])
+    return (received[None], state_out, g_min, jnp.stack([sent_ct, drop_ct]))
+
+
+class MeshDataPlane:
+    """Host-sharded data plane over a device mesh.
+
+    Usage: build with NetParams (+ graph tables), feed per-round unit
+    batches with ``round_step``; state lives sharded on the devices.
+    """
+
+    def __init__(self, params: NetParams, n_shards: int | None = None,
+                 units_per_shard: int = 1024, devices=None) -> None:
+        configure()
+        import jax as _jax
+
+        # int64 simulation times flow through this plane; scoped here (not
+        # in jaxcfg) so embedding apps that never build a mesh keep default
+        # 32-bit JAX semantics. Process-global once a mesh is constructed.
+        _jax.config.update("jax_enable_x64", True)
+        devices = devices if devices is not None else jax.devices()
+        n = n_shards or len(devices)
+        if n > len(devices):
+            raise ValueError(f"{n} shards > {len(devices)} devices")
+        self.n_shards = n
+        self.units_per_shard = int(units_per_shard)
+        self.mesh = Mesh(np.array(devices[:n]), (AXIS,))
+        self.params = params
+
+        h = params.rate_up.shape[0]
+        self.h_pad = -(-h // n) * n
+        self.hs = self.h_pad // n
+        rate = np.ones(self.h_pad, dtype=np.int64)
+        cap = np.full(self.h_pad, MIN_CAP, dtype=np.int64)
+        rate[:h] = params.rate_up
+        cap[:h] = params.cap_up
+        node = np.zeros(self.h_pad, dtype=np.int64)
+        node[:h] = params.host_node
+        self._tables = (
+            jnp.asarray(node),
+            jnp.asarray(params.latency_ns),
+            jnp.asarray(params.drop_thresh),
+            jnp.asarray(rate),
+            jnp.asarray(cap),
+        )
+        # sharded bucket state, (N, Hs): row i = shard i's hosts (h % N == i)
+        shard = NamedSharding(self.mesh, P(AXIS))
+
+        def shard_state(vals):
+            arr = np.zeros((n, self.hs), dtype=np.int64)
+            for i in range(n):
+                row = vals[i::n]
+                arr[i, : row.shape[0]] = row
+            return jax.device_put(jnp.asarray(arr), shard)
+
+        self.t_base = shard_state(np.zeros(h, dtype=np.int64))
+        self.tokens = shard_state(params.cap_up)
+        self.debt = shard_state(np.zeros(h, dtype=np.int64))
+
+        self._step = jax.jit(
+            jax.shard_map(
+                partial(_round_step, n, int(params.seed)),
+                mesh=self.mesh,
+                in_specs=((P(AXIS), P(AXIS), P(AXIS)),
+                          (P(AXIS),) * 5,
+                          (P(), P(), P(), P(), P()),
+                          P()),
+                out_specs=(P(AXIS), (P(AXIS), P(AXIS), P(AXIS)), P(), P()),
+            ),
+            static_argnums=(),
+        )
+
+    def shard_units(self, src, dst, size, t_emit, uid):
+        """Pack a (src-sorted FIFO) host batch into per-shard padded slots.
+        Returns the (N, C) int64/int32 arrays ``round_step`` consumes."""
+        n, c, hs = self.n_shards, self.units_per_shard, self.hs
+        out_src = np.full((n, c), hs, dtype=np.int64)  # hs = invalid sentinel
+        out_dst = np.zeros((n, c), dtype=np.int64)
+        out_size = np.zeros((n, c), dtype=np.int64)
+        out_emit = np.zeros((n, c), dtype=np.int64)
+        out_uid = np.zeros((n, c), dtype=np.int64)
+        fill = np.zeros(n, dtype=np.int64)
+        for i in range(src.shape[0]):
+            sh = int(src[i]) % n
+            k = fill[sh]
+            if k >= c:
+                raise ValueError("units_per_shard slot overflow")
+            out_src[sh, k] = int(src[i]) // n
+            out_dst[sh, k] = int(dst[i])
+            out_size[sh, k] = int(size[i])
+            out_emit[sh, k] = int(t_emit[i])
+            out_uid[sh, k] = int(uid[i])
+            fill[sh] = k + 1
+        return tuple(jnp.asarray(a) for a in
+                     (out_src, out_dst, out_size, out_emit, out_uid))
+
+    def round_step(self, units, t_now: int):
+        """Run one round; returns (received, g_min, counters) with
+        ``received`` a (N, N, C, 4) int64 numpy array: received[i, j, c] =
+        the c-th arrival shard j routed to shard i (see F_* field order)."""
+        received, state, g_min, counters = self._step(
+            (self.t_base, self.tokens, self.debt), units, self._tables,
+            jnp.int64(t_now))
+        self.t_base, self.tokens, self.debt = state
+        return (np.asarray(received), int(g_min), np.asarray(counters))

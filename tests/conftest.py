@@ -1,18 +1,27 @@
-"""Test configuration.
+"""Test configuration: force an 8-virtual-device CPU JAX platform.
 
-Tests always run on the CPU backend with 8 virtual devices so that the
-multi-chip sharding path (scheduler_policy: tpu_batch over a mesh) is
-exercised without TPU hardware — the stand-in for a pod recommended by
-SURVEY.md §4 ("multi-node without a cluster").
+Tests run every device kernel on CPU-XLA (same integer ops as TPU-XLA) and
+exercise the mesh data plane (shadow_tpu/parallel/mesh.py) on an 8-device
+mesh — the stand-in for a pod recommended by SURVEY.md §4 ("multi-node
+without a cluster").
 
-These env vars must be set before jax is first imported anywhere.
+The image may pin JAX_PLATFORMS to a single-chip TPU platform and pre-import
+jax from sitecustomize, so env vars alone are not enough (they are only read
+at import): use jax.config overrides, which work any time before backend
+initialization.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+except RuntimeError:
+    # backends already initialized (platform pinned before pytest started);
+    # tests then run on whatever platform exists — still correct, just not
+    # the 8-device mesh fast path
+    pass
