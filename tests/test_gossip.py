@@ -1,0 +1,96 @@
+"""Correctness tests for the gossip workload model (BASELINE.md config #4;
+VERDICT.md round-1 weak #7: the model previously had zero tests)."""
+
+import yaml
+
+from shadow_tpu.config import parse_config
+from shadow_tpu.core.controller import Controller
+
+GOSSIP_CFG = """
+general:
+  stop_time: 40s
+  seed: 9
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 1 host_bandwidth_up "50 Mbit" host_bandwidth_down "50 Mbit" ]
+        edge [ source 0 target 1 latency "30 ms" ]
+        edge [ source 0 target 0 latency "10 ms" ]
+        edge [ source 1 target 1 latency "10 ms" ]
+      ]
+hosts:
+  origin:
+    network_node_id: 0
+    quantity: 2
+    processes:
+      - path: pyapp:shadow_tpu.models.gossip:GossipNode
+        args: ["7000", "30", "4", "2", "1.0"]
+  member:
+    network_node_id: 1
+    quantity: 28
+    processes:
+      - path: pyapp:shadow_tpu.models.gossip:GossipNode
+        args: ["7000", "30", "4", "0", "1.0"]
+"""
+
+
+def run(seed=9, loss_line=None):
+    text = GOSSIP_CFG
+    if loss_line:
+        # loss on every edge (member<->member traffic rides the 10 ms edges)
+        text = text.replace('latency "30 ms"', f'latency "30 ms" {loss_line}')
+        text = text.replace('latency "10 ms"', f'latency "10 ms" {loss_line}')
+    cfg = parse_config(yaml.safe_load(text), {
+        "general.seed": seed,
+        "general.data_directory": f"/tmp/st-gossip-{seed}-{bool(loss_line)}",
+    })
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    return c, result
+
+
+def test_flood_reaches_every_node_without_loss():
+    c, result = run()
+    apps = [p.app for p in c.processes]
+    all_txids = set()
+    for a in apps:
+        all_txids.update(f"{a.api.host_id}:{k}".encode()
+                         for k in range(1, a.originated + 1))
+    assert len(all_txids) == 4  # 2 origins x 2 txs
+    # peer graph with k=4 over 30 nodes is connected w.h.p.; every node
+    # must have learned every tx (INV -> GETDATA -> TX converges well
+    # within 40 sim-seconds at these latencies)
+    for a in apps:
+        assert a.seen == all_txids, a.api.host_id
+    # each tx is received exactly once per non-originating node
+    total_rx = sum(a.received_tx for a in apps)
+    assert total_rx == sum(len(all_txids - {
+        f"{a.api.host_id}:{k}".encode() for k in range(1, a.originated + 1)})
+        for a in apps)
+    assert result["units_dropped"] == 0
+
+
+def test_flood_deterministic_and_seed_sensitive():
+    _, r1 = run(seed=9)
+    _, r2 = run(seed=9)
+    for k in ("events", "units_sent", "counters"):
+        assert r1[k] == r2[k]
+    c3, _ = run(seed=10)
+    # different seed -> different peer graphs (host RNG drives peer choice)
+    assert any(a.peers != b.peers
+               for a, b in zip([p.app for p in c3.processes],
+                               [p.app for p in run(seed=9)[0].processes]))
+
+
+def test_flood_with_loss_still_converges_mostly():
+    c, result = run(loss_line="packet_loss 0.01")
+    assert result["units_dropped"] > 0
+    apps = [p.app for p in c.processes]
+    # redundancy (k=4 peers) makes the flood robust: the vast majority of
+    # nodes still learn every tx despite 1% packet loss on the backbone
+    full = sum(1 for a in apps if len(a.seen) == 4)
+    assert full >= 25, full
