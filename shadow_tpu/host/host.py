@@ -100,10 +100,12 @@ class Host:
             if on_accept is None:
                 self.counters.add("units_unroutable", 1)
                 return
-            ep = StreamEndpoint(self, u.dst_port, u.src, u.src_port, initiator=False)
+            ep = self._make_endpoint(u.dst_port, u.src, u.src_port,
+                                     initiator=False)
             ep.state = ESTABLISHED
+            ep.sender.adv_wnd = u.seq  # client window rides the SYN
             self._conns[key] = ep
-            ep.emit(U.SYNACK)
+            ep.emit(U.SYNACK, wnd=ep.receiver.window())
             on_accept(ep, now)
             return
         if ep is None:
@@ -122,9 +124,18 @@ class Host:
             raise ValueError(f"{self.name}: port {port} already listening")
         self._listeners[port] = on_accept
 
+    def _make_endpoint(self, local_port: int, remote_host: int,
+                       remote_port: int, initiator: bool) -> StreamEndpoint:
+        exp = self.controller.cfg.experimental
+        return StreamEndpoint(
+            self, local_port, remote_host, remote_port, initiator=initiator,
+            send_buffer=exp.socket_send_buffer,
+            recv_buffer=exp.socket_recv_buffer,
+        )
+
     def connect(self, remote_host: int, remote_port: int) -> StreamEndpoint:
-        ep = StreamEndpoint(self, self.ephemeral_port(), remote_host,
-                            remote_port, initiator=True)
+        ep = self._make_endpoint(self.ephemeral_port(), remote_host,
+                                 remote_port, initiator=True)
         self._conns[(ep.local_port, remote_host, remote_port)] = ep
         return ep  # caller sets callbacks, then calls ep.connect()
 

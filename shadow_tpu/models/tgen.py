@@ -29,6 +29,14 @@ class TGenServer:
         self.api.log(f"tgen server listening on {self.port}")
 
     def _on_accept(self, conn, now):
+        pending = {"n": 0}
+
+        def push(room=0):
+            # send() may accept only part (bounded send buffer); the rest
+            # streams out through on_drain as acks free space
+            if pending["n"] > 0:
+                pending["n"] -= conn.send(pending["n"])
+
         def on_data(nbytes, payload, t):
             if payload is not None:
                 try:
@@ -37,9 +45,11 @@ class TGenServer:
                     want = 0
                 if want > 0:
                     self.transfers += 1
-                    conn.send(want)
+                    pending["n"] += want
+                    push()
 
         conn.on_data = on_data
+        conn.on_drain = push
 
     def stop(self):
         pass

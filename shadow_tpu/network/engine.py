@@ -80,6 +80,12 @@ class NetworkEngine:
         self.units_dropped = 0
         self.units_blackholed = 0
         self.bytes_sent = 0
+        #: targeted fault injection (tests, experiments): units for which
+        #: this predicate returns True are force-dropped in the network.
+        #: With fault_silent the sender gets no loss notification either —
+        #: recovery must come from its own timers (SURVEY.md §5.3).
+        self.fault_filter = None
+        self.fault_silent = False
 
         self.max_batch = int(getattr(tpu_options, "tpu_max_batch", 65536) or 65536)
         self.device = None
@@ -186,6 +192,16 @@ class NetworkEngine:
         keys = np.arange(self._ev_key, self._ev_key + n, dtype=np.int64)
         self._ev_key += n
 
+        forced = None
+        if self.fault_filter is not None:
+            forced = np.fromiter((self.fault_filter(u) for u in units),
+                                 dtype=bool, count=n)
+            if self.fault_silent:
+                for i in np.flatnonzero(forced):
+                    units[i].on_loss = None
+            if not forced.any():
+                forced = None
+
         use_device = (
             self.device is not None
             and n >= self.device_floor
@@ -200,12 +216,16 @@ class NetworkEngine:
             use_device = n >= self.device_floor
         if not use_device:
             flags = loss_flags(self.params.seed, *_uid_arrays(units, n), thresh)
+            if forced is not None:
+                flags = flags | forced
             self._schedule_batch(units, arrival, notify, flags, keys, round_end)
             return
         for i in range(0, n, self.max_batch):
             j = min(n, i + self.max_batch)
             lo, hi, npk = _uid_arrays(units[i:j], j - i)
             handle = self.device.dispatch(lo, hi, npk, thresh[i:j])
+            if forced is not None:
+                handle = _ForcedHandle(handle, forced[i:j])
             deadline = max(round_end, int(arrival[i:j].min()))
             self.outstanding.append(_Outstanding(
                 units[i:j], arrival[i:j], notify[i:j], keys[i:j],
@@ -253,6 +273,19 @@ class NetworkEngine:
                     band=BAND_NET, key=int(keys[i]))
         self.units_sent += sent
         self.bytes_sent += nbytes
+
+
+class _ForcedHandle:
+    """Wraps a DrawHandle, OR-ing in fault-injected drops at read time."""
+
+    __slots__ = ("_inner", "_forced")
+
+    def __init__(self, inner, forced):
+        self._inner = inner
+        self._forced = forced
+
+    def read(self):
+        return self._inner.read() | self._forced
 
 
 def _uid_arrays(units, n):
