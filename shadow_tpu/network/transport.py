@@ -100,7 +100,14 @@ class StreamSender:
             return  # not yet connected (or closing past data); connect re-pumps
         window = min(self.cwnd, max(self.adv_wnd, MSS))
         while self.buffered > 0 and self.inflight < window:
-            budget = min(window - self.inflight, CHUNK)
+            usable = window - self.inflight
+            # silly-window avoidance (Nagle-shaped): emit only full-size
+            # chunks or the final tail of the app buffer; sub-chunk window
+            # remainders wait for more acks — except when idle, where
+            # sending something is what restarts the ack clock
+            if usable < CHUNK and usable < self.buffered and self.inflight > 0:
+                break
+            budget = min(usable, CHUNK)
             nbytes, payload = self.sendbuf[0]
             if nbytes <= budget:
                 self.sendbuf.popleft()
