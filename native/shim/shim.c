@@ -1,0 +1,227 @@
+/* libshadow_shim.so — the managed-process side of phase 4.
+ *
+ * Reference analog: the LD_PRELOAD shim + seccomp SIGSYS trap of
+ * SURVEY.md §2 "Shim" / §3.2-3.3, re-designed around a deliberately DUMB
+ * shim: it knows nothing about syscall semantics. Every trapped syscall is
+ * forwarded verbatim ({nr, args[6]}) over a fixed-fd socketpair to the
+ * Python worker, which owns all emulation state and reads/writes this
+ * process's memory directly via process_vm_readv/writev (the MemoryManager
+ * equivalent, shadow_tpu/native/memory.py). Strict turn-taking falls out
+ * of the blocking request/reply protocol: exactly one of {worker, managed
+ * thread} runs at a time.
+ *
+ * v1 interposition set (documented in shadow_tpu/native/managed.py): the
+ * seccomp filter TRAPS the simulation-relevant syscalls (time, sleep,
+ * sockets, stdio writes, virtual fds, getrandom) and ALLOWS everything
+ * else natively (memory management, dynamic linking, real file IO below
+ * the virtual-fd base). This is inverted from upstream Shadow's trap-all
+ * stance — chosen so unknown syscalls degrade to native behavior instead
+ * of crashing — and is tightened per-family as emulation coverage grows.
+ *
+ * Time has a fast path: the worker maintains an mmap'd page holding the
+ * emulated clock (ns since the UNIX epoch), updated before every turn
+ * grant; interposed clock_gettime/gettimeofday/time read it without a
+ * context switch (and without the vDSO, which seccomp cannot intercept).
+ *
+ * Wire protocol (host byte order, x86-64):
+ *   request : uint64 nr; uint64 args[6];          (56 bytes)
+ *   response: int64 ret;                          (8 bytes, -errno on error)
+ *   handshake: request with nr = SHIM_HELLO, arg0 = getpid()
+ */
+#define _GNU_SOURCE
+#include <errno.h>
+#include <fcntl.h>
+#include <linux/audit.h>
+#include <linux/filter.h>
+#include <linux/seccomp.h>
+#include <signal.h>
+#include <stddef.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/prctl.h>
+#include <sys/syscall.h>
+#include <time.h>
+#include <ucontext.h>
+#include <unistd.h>
+
+#define SHIM_IPC_FD 995          /* worker dup2()s the socketpair here   */
+#define SHIM_VFD_BASE 0x100000   /* fds >= this are simulated sockets    */
+#define SHIM_HELLO 0xFFFFFFFFu
+
+struct shim_req { uint64_t nr; uint64_t args[6]; };
+
+static volatile int64_t *shim_time_page; /* emulated ns since UNIX epoch */
+static int shim_active;
+
+/* raw syscalls only — the shim must not recurse through libc wrappers */
+static long raw3(long nr, long a, long b, long c) {
+  long ret;
+  __asm__ volatile("syscall"
+                   : "=a"(ret)
+                   : "a"(nr), "D"(a), "S"(b), "d"(c)
+                   : "rcx", "r11", "memory");
+  return ret;
+}
+
+static int write_all(const void *buf, size_t n) {
+  const char *p = buf;
+  while (n) {
+    long r = raw3(SYS_write, SHIM_IPC_FD, (long)p, (long)n);
+    if (r < 0) { if (r == -EINTR) continue; return -1; }
+    p += r; n -= (size_t)r;
+  }
+  return 0;
+}
+
+static int read_all(void *buf, size_t n) {
+  char *p = buf;
+  while (n) {
+    long r = raw3(SYS_read, SHIM_IPC_FD, (long)p, (long)n);
+    if (r < 0) { if (r == -EINTR) continue; return -1; }
+    if (r == 0) raw3(SYS_exit_group, 125, 0, 0); /* worker vanished */
+    p += r; n -= (size_t)r;
+  }
+  return 0;
+}
+
+static int64_t forward(uint64_t nr, uint64_t a0, uint64_t a1, uint64_t a2,
+                       uint64_t a3, uint64_t a4, uint64_t a5) {
+  struct shim_req rq = {nr, {a0, a1, a2, a3, a4, a5}};
+  int64_t ret = -ENOSYS;
+  if (write_all(&rq, sizeof rq) != 0) return -EPIPE;
+  if (read_all(&ret, sizeof ret) != 0) return -EPIPE;
+  return ret;
+}
+
+static void sigsys_handler(int signo, siginfo_t *info, void *vctx) {
+  (void)signo;
+  ucontext_t *ctx = vctx;
+  greg_t *g = ctx->uc_mcontext.gregs;
+  int64_t ret = forward((uint64_t)info->si_syscall, (uint64_t)g[REG_RDI],
+                        (uint64_t)g[REG_RSI], (uint64_t)g[REG_RDX],
+                        (uint64_t)g[REG_R10], (uint64_t)g[REG_R8],
+                        (uint64_t)g[REG_R9]);
+  g[REG_RAX] = (greg_t)ret;
+}
+
+/* ---- interposed time family (catches the vDSO paths) ------------------- */
+
+static int64_t emulated_now_ns(void) {
+  if (shim_time_page) return *shim_time_page;
+  struct shim_req unused; (void)unused;
+  /* no page mapped: ask the worker (slow path, still deterministic) */
+  return forward(SYS_clock_gettime, (uint64_t)-1, 0, 0, 0, 0, 0);
+}
+
+int clock_gettime(clockid_t clk, struct timespec *ts) {
+  if (!shim_active) return (int)raw3(SYS_clock_gettime, clk, (long)ts, 0);
+  int64_t ns = emulated_now_ns();
+  ts->tv_sec = ns / 1000000000;
+  ts->tv_nsec = ns % 1000000000;
+  return 0;
+}
+
+int gettimeofday(struct timeval *tv, void *tz) {
+  (void)tz;
+  if (!shim_active) return (int)raw3(SYS_gettimeofday, (long)tv, 0, 0);
+  int64_t ns = emulated_now_ns();
+  tv->tv_sec = ns / 1000000000;
+  tv->tv_usec = (ns % 1000000000) / 1000;
+  return 0;
+}
+
+time_t time(time_t *out) {
+  if (!shim_active) return (time_t)raw3(SYS_time, (long)out, 0, 0);
+  time_t t = (time_t)(emulated_now_ns() / 1000000000);
+  if (out) *out = t;
+  return t;
+}
+
+/* ---- seccomp filter ----------------------------------------------------- */
+
+#define BPF_NR (offsetof(struct seccomp_data, nr))
+#define BPF_ARG0 (offsetof(struct seccomp_data, args[0]))
+#define BPF_ARCHF (offsetof(struct seccomp_data, arch))
+
+#define LD(off) BPF_STMT(BPF_LD | BPF_W | BPF_ABS, (off))
+#define RET(v) BPF_STMT(BPF_RET | BPF_K, (v))
+#define JEQ(v, t, f) BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, (v), (t), (f))
+#define JGE(v, t, f) BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, (v), (t), (f))
+
+static int install_seccomp(void) {
+  /* layout (jump targets are relative to the NEXT instruction):
+   *   25 = TRAP, 26 = ALLOW
+   *   [13]/[14]: nr 41..59 -> TRAP (sockets + clone/fork/vfork/execve,
+   *   which the worker fails loudly with ENOSYS — a second guest thread
+   *   would race the single IPC channel)
+   *   15..18 read:  ipc->ALLOW, stdin->TRAP, vfd->TRAP, else ALLOW
+   *   19..22 write: ipc->ALLOW, fd<3->TRAP, vfd->TRAP, else ALLOW
+   *   23..24 close: vfd->TRAP, else ALLOW
+   */
+  struct sock_filter prog[] = {
+      /* [0] */ LD(BPF_ARCHF),
+      /* [1] */ JEQ(AUDIT_ARCH_X86_64, 0, 24),          /* !x86-64 -> ALLOW */
+      /* [2] */ LD(BPF_NR),
+      /* [3] */ JEQ(SYS_read, 11, 0),                   /* -> 15            */
+      /* [4] */ JEQ(SYS_write, 14, 0),                  /* -> 19            */
+      /* [5] */ JEQ(SYS_close, 17, 0),                  /* -> 23            */
+      /* [6] */ JEQ(SYS_nanosleep, 18, 0),              /* -> TRAP          */
+      /* [7] */ JEQ(SYS_clock_nanosleep, 17, 0),
+      /* [8] */ JEQ(SYS_clock_gettime, 16, 0),
+      /* [9] */ JEQ(SYS_gettimeofday, 15, 0),
+      /* [10] */ JEQ(SYS_time, 14, 0),
+      /* [11] */ JEQ(SYS_getrandom, 13, 0),
+      /* [12] */ JEQ(435 /* clone3 */, 12, 0),
+      /* [13] */ JGE(SYS_socket, 0, 12),                /* nr<41 -> ALLOW   */
+      /* [14] */ JGE(60, 11, 10),                       /* 41..59 -> TRAP   */
+      /* read */
+      /* [15] */ LD(BPF_ARG0),
+      /* [16] */ JEQ(SHIM_IPC_FD, 9, 0),                /* -> ALLOW         */
+      /* [17] */ JEQ(0, 7, 0),                          /* stdin -> TRAP    */
+      /* [18] */ JGE(SHIM_VFD_BASE, 6, 7),              /* vfd->TRAP/ALLOW  */
+      /* write */
+      /* [19] */ LD(BPF_ARG0),
+      /* [20] */ JEQ(SHIM_IPC_FD, 5, 0),                /* -> ALLOW         */
+      /* [21] */ JGE(3, 0, 3),                          /* fd<3 -> TRAP     */
+      /* [22] */ JGE(SHIM_VFD_BASE, 2, 3),              /* vfd->TRAP/ALLOW  */
+      /* close */
+      /* [23] */ LD(BPF_ARG0),
+      /* [24] */ JGE(SHIM_VFD_BASE, 0, 1),              /* vfd->TRAP/ALLOW  */
+      /* [25] */ RET(SECCOMP_RET_TRAP),
+      /* [26] */ RET(SECCOMP_RET_ALLOW),
+  };
+  struct sock_fprog fprog = {sizeof(prog) / sizeof(prog[0]), prog};
+  if (prctl(PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0) != 0) return -1;
+  return (int)prctl(PR_SET_SECCOMP, SECCOMP_MODE_FILTER, &fprog);
+}
+
+/* ---- constructor -------------------------------------------------------- */
+
+__attribute__((constructor)) static void shim_init(void) {
+  const char *on = getenv("SHADOW_SHIM");
+  if (!on || on[0] != '1') return; /* not under the simulator */
+
+  const char *shm = getenv("SHADOW_TIME_SHM");
+  if (shm) {
+    int fd = open(shm, O_RDONLY);
+    if (fd >= 0) {
+      void *p = mmap(NULL, 4096, PROT_READ, MAP_SHARED, fd, 0);
+      if (p != MAP_FAILED) shim_time_page = (volatile int64_t *)p;
+      close(fd);
+    }
+  }
+
+  struct sigaction sa;
+  memset(&sa, 0, sizeof sa);
+  sa.sa_sigaction = sigsys_handler;
+  sa.sa_flags = SA_SIGINFO | SA_NODEFER;
+  sigemptyset(&sa.sa_mask);
+  if (sigaction(SIGSYS, &sa, NULL) != 0) _exit(124);
+
+  shim_active = 1;
+  /* handshake: block until the simulation's spawn event grants the turn */
+  if (forward(SHIM_HELLO, (uint64_t)getpid(), 0, 0, 0, 0, 0) != 0) _exit(124);
+  if (install_seccomp() != 0) _exit(123);
+}

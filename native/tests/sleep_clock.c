@@ -1,0 +1,27 @@
+/* sleep_clock — time/sleep semantics test program (dual-run oracle).
+ *
+ * Sleeps 250 ms three times, printing the clock before and after; the
+ * elapsed time reported must be >= the requested sleep. Natively the Linux
+ * kernel enforces that; in the simulator the emulated clock must.
+ *
+ *   usage: sleep_clock
+ */
+#include <stdio.h>
+#include <time.h>
+
+int main(void) {
+  for (int i = 0; i < 3; i++) {
+    struct timespec a, b, d = {0, 250 * 1000 * 1000};
+    clock_gettime(CLOCK_REALTIME, &a);
+    nanosleep(&d, NULL);
+    clock_gettime(CLOCK_REALTIME, &b);
+    long ms = (b.tv_sec - a.tv_sec) * 1000 + (b.tv_nsec - a.tv_nsec) / 1000000;
+    printf("sleep %d elapsed_ms=%ld\n", i, ms);
+    if (ms < 250) {
+      printf("FAIL: clock went too fast\n");
+      return 1;
+    }
+  }
+  printf("ok\n");
+  return 0;
+}

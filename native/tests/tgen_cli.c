@@ -1,0 +1,63 @@
+/* tgen_cli — a real, unmodified-style TCP client test program.
+ *
+ * Used BOTH natively (against a real TCP server, the Linux kernel as the
+ * test oracle — SURVEY.md §4's dual-run trick) and as a managed process
+ * inside the simulator. Behavior: connect to <ip> <port>, send the 8-byte
+ * decimal byte-count request (the tgen wire format), read exactly that
+ * many bytes back, print a summary line, exit 0.
+ *
+ *   usage: tgen_cli <ip> <port> <nbytes>
+ */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s <ip> <port> <nbytes>\n", argv[0]);
+    return 2;
+  }
+  long want = atol(argv[3]);
+
+  struct timespec t0, t1;
+  clock_gettime(CLOCK_REALTIME, &t0);
+
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) { perror("socket"); return 1; }
+
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof addr);
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((unsigned short)atoi(argv[2]));
+  if (inet_pton(AF_INET, argv[1], &addr.sin_addr) != 1) {
+    fprintf(stderr, "bad ip %s\n", argv[1]);
+    return 2;
+  }
+  if (connect(fd, (struct sockaddr *)&addr, sizeof addr) != 0) {
+    perror("connect");
+    return 1;
+  }
+
+  char req[9];
+  snprintf(req, sizeof req, "%8ld", want);
+  if (send(fd, req, 8, 0) != 8) { perror("send"); return 1; }
+
+  long got = 0;
+  char buf[65536];
+  while (got < want) {
+    long n = recv(fd, buf, sizeof buf, 0);
+    if (n <= 0) { perror("recv"); return 1; }
+    got += n;
+  }
+  close(fd);
+
+  clock_gettime(CLOCK_REALTIME, &t1);
+  long ms = (t1.tv_sec - t0.tv_sec) * 1000 + (t1.tv_nsec - t0.tv_nsec) / 1000000;
+  printf("transfer-complete bytes=%ld elapsed_ms=%ld\n", got, ms);
+  return 0;
+}

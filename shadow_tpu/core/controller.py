@@ -112,17 +112,26 @@ class Controller:
             h.engine = self.engine
         self.scheduler = make_scheduler(policy, self.hosts, cfg.general.parallelism)
 
-        # processes
-        self.processes: list[PluginProcess] = []
+        # processes: pyapp: plugins run in-process; any other path is a real
+        # executable run under the native preload shim (SURVEY.md §7 phase 4)
+        self.processes: list = []
         for host, hopts in zip(self.hosts, cfg.hosts):
             for i, popts in enumerate(hopts.processes):
-                if not PluginProcess.is_plugin_path(popts.path):
-                    raise NotImplementedError(
-                        f"host {hopts.name!r}: real managed executables "
-                        f"({popts.path!r}) require the native shim (phase 4, "
-                        f"SURVEY.md §7); use a pyapp: plugin path"
-                    )
-                proc = PluginProcess(host, popts, i)
+                if PluginProcess.is_plugin_path(popts.path):
+                    proc = PluginProcess(host, popts, i)
+                else:
+                    from shadow_tpu.native.managed import ManagedProcess, _shim_lib
+
+                    # fail fast at build time, not inside a scheduler event
+                    if not Path(popts.path).is_file():
+                        raise ValueError(
+                            f"host {hopts.name!r}: managed executable "
+                            f"{popts.path!r} does not exist")
+                    if not _shim_lib().exists():
+                        raise ValueError(
+                            f"native shim {_shim_lib()} missing — build it "
+                            f"first: make -C native")
+                    proc = ManagedProcess(host, popts, i)
                 host.processes.append(proc)
                 self.processes.append(proc)
                 host.schedule(popts.start_time, proc.spawn)
@@ -207,14 +216,18 @@ class Controller:
         )
 
     def _finalize(self, end_time: SimTime) -> dict:
-        for h in self.hosts:
-            self.counters.merge(h.counters)
         errors = []
         for p in self.processes:
             err = p.check_final_state()
             if err is not None:
                 errors.append(err)
                 self.log.error(err)
+        for p in self.processes:  # reference §3.5: kill remaining managed
+            reap = getattr(p, "reap", None)
+            if reap is not None:
+                reap()
+        for h in self.hosts:  # merge AFTER reaping so its counters land
+            self.counters.merge(h.counters)
         sim_sec = end_time / NS_PER_SEC
         rate = sim_sec / self.wall_seconds if self.wall_seconds > 0 else float("inf")
         self.log.info(

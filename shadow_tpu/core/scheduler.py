@@ -96,6 +96,7 @@ class ThreadPerHostScheduler:
         self._done = [threading.Event() for _ in hosts]
         self._stop = False
         self._counts = [0] * len(hosts)
+        self._errors: list = [None] * len(hosts)
         self._threads = [
             threading.Thread(
                 target=self._loop, args=(i,), name=f"shadow-host-{h.name}", daemon=True
@@ -111,16 +112,23 @@ class ThreadPerHostScheduler:
             self._go[i].clear()
             if self._stop:
                 return
-            self._counts[i] = self.hosts[i].run_events(self._round_end)
+            try:
+                self._counts[i] = self.hosts[i].run_events(self._round_end)
+            except BaseException as exc:  # propagate instead of hanging
+                self._errors[i] = exc
             self._done[i].set()
 
     def run_round(self, round_end: SimTime) -> int:
         self._round_end = round_end
+        self._errors = [None] * len(self.hosts)
         for ev in self._go:
             ev.set()
         for ev in self._done:
             ev.wait()
             ev.clear()
+        for exc in self._errors:
+            if exc is not None:
+                raise exc
         return sum(self._counts)
 
     def shutdown(self) -> None:

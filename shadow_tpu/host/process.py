@@ -81,7 +81,38 @@ class ProcessAPI:
         self._proc.finish(code)
 
 
-class PluginProcess:
+class ProcessLifecycle:
+    """Shared exit accounting + expected_final_state validation for both
+    plugin processes and native managed processes (native/managed.py)."""
+
+    def finish(self, code: int) -> None:
+        self.running = False
+        if self.exit_code is None:
+            self.exit_code = code
+            self.host.counters.add("processes_exited", 1)
+
+    def check_final_state(self) -> Optional[str]:
+        """Validate expected_final_state at sim end; returns an error or None."""
+        exp = self.opts.expected_final_state
+        if exp is None:
+            return None
+        if exp == "running":
+            if not self.running:
+                return (f"{self.host.name}/{self.name}: expected running, "
+                        f"exited {self.exit_code}")
+            return None
+        if isinstance(exp, dict) and "exited" in exp:
+            want = int(exp["exited"])
+            if self.running:
+                return f"{self.host.name}/{self.name}: expected exit {want}, still running"
+            if self.exit_code != want:
+                return (f"{self.host.name}/{self.name}: expected exit {want}, "
+                        f"got {self.exit_code}")
+            return None
+        return f"{self.host.name}/{self.name}: unrecognized expected_final_state {exp!r}"
+
+
+class PluginProcess(ProcessLifecycle):
     """Lifecycle wrapper for one configured plugin-process instance."""
 
     PYAPP_PREFIX = "pyapp:"
@@ -124,29 +155,6 @@ class PluginProcess:
             if self.running:  # app didn't exit itself
                 self.finish(0)
 
-    def finish(self, code: int) -> None:
-        self.running = False
-        if self.exit_code is None:
-            self.exit_code = code
-            self.host.counters.add("processes_exited", 1)
-
-    def check_final_state(self) -> Optional[str]:
-        """Validate expected_final_state at sim end; returns an error or None."""
-        exp = self.opts.expected_final_state
-        if exp is None:
-            return None
-        if exp == "running":
-            if not self.running:
-                return f"{self.host.name}/{self.name}: expected running, exited {self.exit_code}"
-            return None
-        if isinstance(exp, dict) and "exited" in exp:
-            want = int(exp["exited"])
-            if self.running:
-                return f"{self.host.name}/{self.name}: expected exit {want}, still running"
-            if self.exit_code != want:
-                return f"{self.host.name}/{self.name}: expected exit {want}, got {self.exit_code}"
-            return None
-        return f"{self.host.name}/{self.name}: unrecognized expected_final_state {exp!r}"
 
 
 def _basename(path: str) -> str:

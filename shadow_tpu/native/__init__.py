@@ -1,0 +1,1 @@
+from shadow_tpu.native.managed import ManagedProcess  # noqa: F401

@@ -1,0 +1,501 @@
+"""Managed processes: real, unmodified Linux executables inside the sim.
+
+Reference analog: SURVEY.md §2 "Process / ManagedThread" + §3.2/3.3 (spawn
+handshake, seccomp trap, strict turn-taking). The division of labor is
+deliberately different from upstream: the C shim (native/shim/shim.c) is
+DUMB — it forwards trapped syscalls verbatim over a fixed-fd socketpair —
+and this module owns every bit of emulation: the descriptor table, the
+socket bridge onto the simulated transport, the emulated clock, blocking
+semantics, and guest memory access (native/memory.py, process_vm_readv).
+
+Turn-taking: the managed process is *always* blocked except between our
+reply and its next request. The pump loop services syscalls at the current
+sim instant (app compute costs zero sim time, upstream's default model);
+a syscall that must wait (nanosleep, connect, recv on an empty buffer,
+send into a full buffer) parks the process — no reply — and a host event
+or transport callback later resumes the pump. The blocking socket read
+releases the GIL, so hosts running managed processes get real OS-thread
+parallelism under thread_per_core — the phase-4 payoff promised in
+core/scheduler.py.
+
+v1 emulation surface (grown as workloads need): write/read on stdio and
+virtual sockets, socket/connect/send/recv/close/shutdown + sockname peers
++ sockopt stubs, nanosleep/clock_nanosleep, clock_gettime/gettimeofday/
+time, getrandom (deterministic, per-host RNG), stdin EOF. bind/listen/
+accept (server side) intentionally return -ENOSYS until implemented.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import socket
+import struct
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+from shadow_tpu.core.time import NS_PER_SEC, SimTime, emulated
+from shadow_tpu.host.process import ProcessLifecycle
+from shadow_tpu.native.memory import ProcessMemory
+
+SHIM_IPC_FD = 995
+VFD_BASE = 0x100000
+HELLO = 0xFFFFFFFF
+
+# x86-64 syscall numbers
+SYS_read, SYS_write, SYS_close = 0, 1, 3
+SYS_nanosleep = 35
+SYS_socket, SYS_connect, SYS_accept, SYS_sendto, SYS_recvfrom = 41, 42, 43, 44, 45
+SYS_sendmsg, SYS_recvmsg, SYS_shutdown, SYS_bind, SYS_listen = 46, 47, 48, 49, 50
+SYS_getsockname, SYS_getpeername = 51, 52
+SYS_setsockopt, SYS_getsockopt = 54, 55
+SYS_gettimeofday, SYS_time = 96, 201
+SYS_clock_gettime, SYS_clock_nanosleep = 228, 230
+SYS_getrandom = 318
+SYS_clone, SYS_fork, SYS_vfork, SYS_execve, SYS_clone3 = 56, 57, 58, 59, 435
+
+EPERM, EBADF, EAGAIN, EFAULT, EINVAL, EPIPE = 1, 9, 11, 14, 22, 32
+ENOSYS, ENOTCONN, ECONNRESET, ETIMEDOUT, EAFNOSUPPORT, ENETUNREACH = (
+    38, 107, 104, 110, 97, 101)
+
+_BLOCK = object()  # service() sentinel: no reply yet, process parked
+
+#: spawn serialization: the child end of the socketpair rides a FIXED fd
+#: number (the seccomp filter bakes it in), so concurrent spawns on
+#: different scheduler threads must not interleave the dup2/Popen window
+_SPAWN_LOCK = threading.Lock()
+
+#: how long (real seconds) to wait for the shim's HELLO before concluding
+#: LD_PRELOAD injection failed (statically linked binary, setuid, ...)
+HANDSHAKE_TIMEOUT_S = 30.0
+
+_reserved_ipc_slot = False
+
+
+def _reserve_ipc_slot() -> None:
+    """Pin /dev/null onto SHIM_IPC_FD so the process-wide fd allocator can
+    never hand that number to an unrelated file; spawns dup2 over it and
+    restore it afterwards. Without this, a large sim would eventually
+    allocate fd 995 to some live object and the next spawn's dup2 would
+    silently destroy it."""
+    global _reserved_ipc_slot
+    if _reserved_ipc_slot:
+        return
+    try:
+        os.fstat(SHIM_IPC_FD)
+        raise RuntimeError(
+            f"fd {SHIM_IPC_FD} (SHIM_IPC_FD) is already in use in this "
+            f"process; managed processes need it reserved")
+    except OSError:
+        pass
+    devnull = os.open(os.devnull, os.O_RDWR)
+    os.dup2(devnull, SHIM_IPC_FD)
+    os.close(devnull)
+    _reserved_ipc_slot = True
+
+TIMER_ABSTIME = 1
+
+
+def _shim_lib() -> Path:
+    override = os.environ.get("SHADOW_SHIM_LIB")
+    if override:
+        return Path(override)
+    return Path(__file__).resolve().parents[2] / "native" / "build" / "libshadow_shim.so"
+
+
+class VSocket:
+    """One virtual descriptor: a simulated stream socket."""
+
+    __slots__ = ("vfd", "endpoint", "rxbuf", "peer_closed", "connected")
+
+    def __init__(self, vfd: int) -> None:
+        self.vfd = vfd
+        self.endpoint = None
+        self.rxbuf = bytearray()
+        self.peer_closed = False
+        self.connected = False
+
+
+class ManagedProcess(ProcessLifecycle):
+    """Lifecycle + syscall service for one real executable in the sim.
+
+    Mirrors PluginProcess's surface (spawn/shutdown/finish/check_final_state)
+    so the controller treats both uniformly.
+    """
+
+    def __init__(self, host, opts, index: int) -> None:
+        self.host = host
+        self.opts = opts
+        self.name = f"{Path(opts.path).name}.{index}"
+        self.exit_code: Optional[int] = None
+        self.running = False
+        self.app = None  # parity with PluginProcess (no plugin object)
+        self.proc: Optional[subprocess.Popen] = None
+        self.mem: Optional[ProcessMemory] = None
+        self.sock: Optional[socket.socket] = None
+        self._time_map: Optional[mmap.mmap] = None
+        self._time_path: Optional[Path] = None
+        self.fds: dict[int, VSocket] = {}
+        self._next_vfd = VFD_BASE
+        self._files: dict[int, object] = {}  # 1/2 -> open capture files
+        self._waiting = None  # (kind, ...) while parked
+
+    # -- lifecycle ---------------------------------------------------------
+    def spawn(self) -> None:
+        lib = _shim_lib()
+        if not lib.exists():
+            raise FileNotFoundError(
+                f"{lib} missing — build the native shim first: make -C native")
+        ddir = Path(self.host.controller.data_dir) / "hosts" / self.host.name
+        ddir.mkdir(parents=True, exist_ok=True)
+        self._time_path = ddir / f"{self.name}.clock"
+        with open(self._time_path, "wb") as f:
+            f.write(b"\0" * 4096)
+        tf = open(self._time_path, "r+b")
+        self._time_map = mmap.mmap(tf.fileno(), 4096)
+        tf.close()
+
+        env = dict(os.environ)
+        env.update(self.opts.environment)
+        env.update({
+            "LD_PRELOAD": str(lib),
+            "SHADOW_SHIM": "1",
+            "SHADOW_TIME_SHM": str(self._time_path),
+        })
+        with _SPAWN_LOCK:
+            _reserve_ipc_slot()
+            parent, child = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+            os.dup2(child.fileno(), SHIM_IPC_FD)
+            child.close()
+            try:
+                self.proc = subprocess.Popen(
+                    [self.opts.path] + list(self.opts.args),
+                    env=env,
+                    pass_fds=(SHIM_IPC_FD,),
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                    cwd=str(ddir),
+                )
+            finally:
+                devnull = os.open(os.devnull, os.O_RDWR)
+                os.dup2(devnull, SHIM_IPC_FD)  # restore the reservation
+                os.close(devnull)
+        self.sock = parent
+        self.mem = ProcessMemory(self.proc.pid)
+        self.running = True
+        self.host.counters.add("processes_spawned", 1)
+
+        # handshake with a real-time bound: a binary the preload cannot
+        # enter (static link, setuid) would otherwise hang the scheduler
+        self.sock.settimeout(HANDSHAKE_TIMEOUT_S)
+        try:
+            req = self._read_req()
+        finally:
+            self.sock.settimeout(None)
+        if req is None or req[0] != HELLO:
+            self.proc.kill()
+            self._exited()
+            raise RuntimeError(
+                f"{self.host.name}/{self.name}: shim handshake failed — is "
+                f"{self.opts.path!r} dynamically linked? (LD_PRELOAD cannot "
+                f"enter static or setuid binaries)")
+        self._reply(0)  # grant the first turn
+        self._pump()
+
+    def shutdown(self) -> None:
+        if self.running and self.proc is not None:
+            self.proc.kill()
+            # the pump (or a pending continuation) observes EOF/EPIPE next
+            if self._waiting is None:
+                self._pump()
+            else:
+                self._exited()
+
+    def reap(self) -> None:
+        """Sim over (reference §3.5): kill and reap a still-running child."""
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+            self._exited()
+
+    # -- IPC ---------------------------------------------------------------
+    def _read_req(self):
+        buf = b""
+        while len(buf) < 56:
+            try:
+                chunk = self.sock.recv(56 - len(buf))
+            except socket.timeout:
+                return None
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            buf += chunk
+        nr = struct.unpack_from("<Q", buf, 0)[0]
+        args = struct.unpack_from("<6Q", buf, 8)
+        return nr, args
+
+    def _reply(self, ret: int) -> None:
+        self._time_map[:8] = struct.pack("<q", emulated(self.host.now))
+        self.sock.sendall(struct.pack("<q", ret))
+
+    def _pump(self) -> None:
+        """Service syscalls until the process blocks in sim time or exits."""
+        while True:
+            req = self._read_req()
+            if req is None:
+                self._exited()
+                return
+            nr, args = req
+            try:
+                ret = self._service(nr, args)
+            except OSError:
+                ret = -EFAULT  # guest memory went away (racing exit)
+            if ret is _BLOCK:
+                return
+            try:
+                self._reply(ret)
+            except OSError:
+                self._exited()
+                return
+            self.host.counters.add("syscalls", 1)
+
+    def _resume(self, ret: int) -> None:
+        """A continuation fired: reply to the parked syscall, resume pumping."""
+        if not self.running:
+            return
+        self._waiting = None
+        try:
+            self._reply(ret)
+        except OSError:
+            self._exited()
+            return
+        self.host.counters.add("syscalls", 1)
+        self._pump()
+
+    def _exited(self) -> None:
+        if self.proc is None:
+            return
+        code = self.proc.wait()
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
+        for vs in self.fds.values():
+            if vs.endpoint is not None:
+                vs.endpoint.close()
+        self.fds.clear()
+        if self.sock is not None:
+            self.sock.close()
+            self.sock = None
+        self.finish(code)
+
+    # -- syscall emulation -------------------------------------------------
+    def _service(self, nr: int, args):
+        h = self.host
+        if nr == SYS_write:
+            fd, addr, n = args[0], args[1], args[2]
+            if fd in (1, 2):
+                data = self.mem.read(addr, min(n, 1 << 20))
+                self._capture(fd).write(data)
+                return len(data)
+            return self._vfd_send(fd, addr, n)
+        if nr == SYS_read:
+            if args[0] == 0:
+                return 0  # stdin: EOF
+            return self._vfd_recv(args[0], args[1], args[2])
+        if nr == SYS_close:
+            vs = self.fds.pop(args[0], None)
+            if vs is None:
+                return -EBADF
+            if vs.endpoint is not None:
+                vs.endpoint.close()
+            return 0
+        if nr == SYS_clock_gettime:
+            if args[0] == 2**64 - 1:  # shim slow-path sentinel: raw ns
+                return emulated(h.now)
+            self.mem.write(args[1], struct.pack(
+                "<qq", emulated(h.now) // NS_PER_SEC, emulated(h.now) % NS_PER_SEC))
+            return 0
+        if nr == SYS_gettimeofday:
+            if args[0]:
+                ns = emulated(h.now)
+                self.mem.write(args[0], struct.pack(
+                    "<qq", ns // NS_PER_SEC, (ns % NS_PER_SEC) // 1000))
+            return 0
+        if nr == SYS_time:
+            secs = emulated(h.now) // NS_PER_SEC
+            if args[0]:
+                self.mem.write(args[0], struct.pack("<q", secs))
+            return secs
+        if nr in (SYS_nanosleep, SYS_clock_nanosleep):
+            ts_addr = args[0] if nr == SYS_nanosleep else args[2]
+            sec, nsec = struct.unpack("<qq", self.mem.read(ts_addr, 16))
+            dur = sec * NS_PER_SEC + nsec
+            if nr == SYS_clock_nanosleep and args[1] & TIMER_ABSTIME:
+                dur = max(0, sec * NS_PER_SEC + nsec - emulated(h.now))
+            self._waiting = ("sleep",)
+            h.schedule_in(max(dur, 0), lambda: self._resume(0))
+            return _BLOCK
+        if nr == SYS_getrandom:
+            n = min(args[1], 1 << 16)
+            self.mem.write(args[0], h.rng.bytes(n))
+            return n
+        if nr == SYS_socket:
+            domain, typ = args[0], args[1] & 0xFF
+            if domain != socket.AF_INET or typ != socket.SOCK_STREAM:
+                return -EAFNOSUPPORT
+            vfd = self._next_vfd
+            self._next_vfd += 1
+            self.fds[vfd] = VSocket(vfd)
+            return vfd
+        if nr == SYS_connect:
+            return self._connect(args[0], args[1], args[2])
+        if nr == SYS_sendto:
+            return self._vfd_send(args[0], args[1], args[2])
+        if nr == SYS_recvfrom:
+            return self._vfd_recv(args[0], args[1], args[2])
+        if nr == SYS_shutdown:
+            vs = self.fds.get(args[0])
+            if vs is None:
+                return -EBADF
+            if vs.endpoint is not None:
+                vs.endpoint.close()
+            return 0
+        if nr in (SYS_setsockopt,):
+            return 0
+        if nr == SYS_getsockopt:
+            # SO_ERROR et al: report "no error", optval = 0
+            if args[3] and args[4]:
+                self.mem.write(args[3], b"\0\0\0\0")
+                self.mem.write(args[4], struct.pack("<i", 4))
+            return 0
+        if nr in (SYS_getsockname, SYS_getpeername):
+            vs = self.fds.get(args[0])
+            if vs is None:
+                return -EBADF
+            vs = self.fds[args[0]]
+            port = vs.endpoint.local_port if vs.endpoint is not None else 0
+            sa = (struct.pack("<H", socket.AF_INET)
+                  + struct.pack(">H", port)
+                  + socket.inet_aton(h.ip) + b"\0" * 8)
+            if args[1] and args[2]:
+                self.mem.write(args[1], sa)
+                self.mem.write(args[2], struct.pack("<i", len(sa)))
+            return 0
+        if nr in (SYS_bind, SYS_listen, SYS_accept, SYS_sendmsg, SYS_recvmsg):
+            return -ENOSYS  # server-side sockets: next iteration
+        if nr in (SYS_clone, SYS_fork, SYS_vfork, SYS_execve, SYS_clone3):
+            # multi-threaded/forking guests would race the single IPC
+            # channel; fail loudly until per-thread channels exist
+            return -ENOSYS
+        return -ENOSYS
+
+    # -- socket bridge -----------------------------------------------------
+    def _connect(self, fd: int, addr: int, addrlen: int):
+        vs = self.fds.get(fd)
+        if vs is None:
+            return -EBADF
+        raw = self.mem.read(addr, min(max(addrlen, 16), 128))
+        family = struct.unpack_from("<H", raw, 0)[0]
+        if family != socket.AF_INET:
+            return -EAFNOSUPPORT
+        port = struct.unpack_from(">H", raw, 2)[0]
+        ip = socket.inet_ntoa(raw[4:8])
+        try:
+            peer = self.host.controller.resolve(ip)
+        except KeyError:
+            return -ENETUNREACH
+        ep = self.host.connect(peer, port)
+        vs.endpoint = ep
+        ep.on_data = lambda n, payload, now: self._on_net_data(vs, n, payload)
+        ep.on_close = lambda now: self._on_net_close(vs)
+        ep.on_error = lambda msg: self._on_net_error(vs)
+        ep.on_connected = lambda now: self._on_connected(vs)
+        self._waiting = ("connect", vs)
+        ep.connect()
+        return _BLOCK
+
+    def _on_connected(self, vs: VSocket) -> None:
+        vs.connected = True
+        if self._waiting and self._waiting[0] == "connect" and self._waiting[1] is vs:
+            self._resume(0)
+
+    def _on_net_data(self, vs: VSocket, n: int, payload) -> None:
+        vs.rxbuf += payload if payload is not None else b"\0" * n
+        w = self._waiting
+        if w and w[0] == "recv" and w[1] is vs:
+            _, _, bufaddr, buflen = w
+            self._fulfill_recv(vs, bufaddr, buflen)
+
+    def _on_net_close(self, vs: VSocket) -> None:
+        vs.peer_closed = True
+        w = self._waiting
+        if w and w[0] == "recv" and w[1] is vs and not vs.rxbuf:
+            self._resume(0)
+
+    def _on_net_error(self, vs: VSocket) -> None:
+        w = self._waiting
+        if w and w[0] == "connect" and w[1] is vs:
+            self._resume(-ETIMEDOUT)
+        elif w and w[0] in ("recv", "send") and w[1] is vs:
+            self._resume(-ECONNRESET)
+
+    def _vfd_send(self, fd: int, addr: int, n: int):
+        vs = self.fds.get(fd)
+        if vs is None:
+            return -EBADF
+        if vs.endpoint is None or not vs.connected:
+            return -ENOTCONN
+        if vs.peer_closed:
+            return -EPIPE
+        data = self.mem.read(addr, min(n, 1 << 20))
+        accepted = vs.endpoint.send(payload=data)
+        if accepted > 0:
+            return accepted
+        # send buffer full: park until acks drain it
+        self._waiting = ("send", vs)
+        vs.endpoint.on_drain = lambda room: self._retry_send(vs, addr, n)
+        return _BLOCK
+
+    def _retry_send(self, vs: VSocket, addr: int, n: int) -> None:
+        if not (self._waiting and self._waiting[0] == "send" and self._waiting[1] is vs):
+            return
+        data = self.mem.read(addr, min(n, 1 << 20))
+        accepted = vs.endpoint.send(payload=data)
+        if accepted > 0:
+            vs.endpoint.on_drain = None
+            self._resume(accepted)
+
+    def _vfd_recv(self, fd: int, bufaddr: int, buflen: int):
+        vs = self.fds.get(fd)
+        if vs is None:
+            return -EBADF
+        if vs.endpoint is None:
+            return -ENOTCONN
+        if vs.rxbuf:
+            return self._take_rx(vs, bufaddr, buflen)
+        if vs.peer_closed:
+            return 0
+        self._waiting = ("recv", vs, bufaddr, buflen)
+        return _BLOCK
+
+    def _fulfill_recv(self, vs: VSocket, bufaddr: int, buflen: int) -> None:
+        self._resume(self._take_rx(vs, bufaddr, buflen))
+
+    def _take_rx(self, vs: VSocket, bufaddr: int, buflen: int) -> int:
+        k = min(len(vs.rxbuf), buflen)
+        self.mem.write(bufaddr, bytes(vs.rxbuf[:k]))
+        del vs.rxbuf[:k]
+        return k
+
+    # -- stdio capture -----------------------------------------------------
+    def _capture(self, fd: int):
+        f = self._files.get(fd)
+        if f is None:
+            ddir = Path(self.host.controller.data_dir) / "hosts" / self.host.name
+            ddir.mkdir(parents=True, exist_ok=True)
+            suffix = "stdout" if fd == 1 else "stderr"
+            f = open(ddir / f"{self.name}.{suffix}", "wb")  # fresh per run
+            self._files[fd] = f
+        return f
