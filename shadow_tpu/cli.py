@@ -68,7 +68,9 @@ def overrides_from_args(args: argparse.Namespace) -> dict:
             ov[key] = val
     for item in args.set:
         if "=" not in item:
-            raise SystemExit(f"--set expects KEY=VALUE, got {item!r}")
+            print(f"shadow_tpu: --set expects KEY=VALUE, got {item!r}",
+                  file=sys.stderr)
+            raise SystemExit(2)
         k, v = item.split("=", 1)
         import yaml as _yaml
 

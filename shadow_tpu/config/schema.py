@@ -88,6 +88,9 @@ class ConfigOptions:
     network: dict = field(default_factory=lambda: {"graph": {"type": "1_gbit_switch"}})
     experimental: ExperimentalOptions = field(default_factory=ExperimentalOptions)
     hosts: list[HostOptions] = field(default_factory=list)
+    #: accepted-but-unimplemented options the user actually set; the
+    #: controller logs each (silently ignoring a knob is a correctness trap)
+    warnings: list[str] = field(default_factory=list)
 
 
 def _require(cond: bool, msg: str) -> None:
@@ -201,6 +204,18 @@ def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
     e.strace_logging_mode = str(exp.get("strace_logging_mode", "off"))
     e.interface_qdisc = str(exp.get("interface_qdisc", "fifo"))
     e.max_unapplied_cpu_latency = parse_time(exp.get("max_unapplied_cpu_latency", 0))
+    if e.use_dynamic_runahead:
+        cfg.warnings.append(
+            "experimental.use_dynamic_runahead accepted but not implemented "
+            "(fixed conservative lookahead is used)")
+    if e.interface_qdisc != "fifo":
+        cfg.warnings.append(
+            f"experimental.interface_qdisc {e.interface_qdisc!r} accepted "
+            "but only 'fifo' is implemented")
+    if e.max_unapplied_cpu_latency:
+        cfg.warnings.append(
+            "experimental.max_unapplied_cpu_latency accepted but not "
+            "implemented (unblocked-syscall latency is a fixed 1 us)")
     e.tpu_max_batch = int(exp.get("tpu_max_batch", 65536))
     e.tpu_device_floor = int(exp.get("tpu_device_floor", 0))
     e.tpu_mesh_shards = int(exp.get("tpu_mesh_shards", 0))

@@ -86,6 +86,14 @@ class Controller:
                 down = down or DEFAULT_BANDWIDTH
             ip = hopts.ip_addr or _default_ip(hid)
             host = Host(hid, hopts.name, ip, node, cfg.general.seed, self)
+            host.log_level = hopts.log_level or cfg.general.log_level
+            if hopts.pcap_enabled:
+                from shadow_tpu.utils.pcap import PcapWriter
+
+                d = self.data_dir / "hosts" / hopts.name
+                d.mkdir(parents=True, exist_ok=True)
+                host.pcap = PcapWriter(d / f"{hopts.name}.pcap",
+                                       hopts.pcap_capture_size)
             self.hosts.append(host)
             self._by_name[hopts.name] = hid
             self._by_ip[ip] = hid
@@ -107,6 +115,7 @@ class Controller:
         self.engine = NetworkEngine(
             self.graph, params, self.hosts, self.round_ns, backend=backend,
             tpu_options=cfg.experimental,
+            bootstrap_end=cfg.general.bootstrap_end_time,
         )
         for h in self.hosts:
             h.engine = self.engine
@@ -142,6 +151,8 @@ class Controller:
         self.rounds = 0
         self.events = 0
         self.wall_seconds = 0.0
+        for w in cfg.warnings:
+            self.log.warning(w)
 
     # -- naming -----------------------------------------------------------
     def resolve(self, name_or_ip) -> int:
@@ -166,6 +177,8 @@ class Controller:
         )
         hb_interval = cfg.general.heartbeat_interval
         next_hb = hb_interval if hb_interval else T_NEVER
+        prog_step = max(stop // 100, 1)
+        next_prog = prog_step if cfg.general.progress else T_NEVER
         t0 = _walltime.perf_counter()
         now: SimTime = 0
         while now < stop:
@@ -178,6 +191,9 @@ class Controller:
             if round_end >= next_hb:
                 self._heartbeat(round_end, t0)
                 next_hb += hb_interval
+            if round_end >= next_prog:
+                self._progress(round_end, stop, t0)
+                next_prog = round_end + prog_step
             if executed == 0 and not self.engine.has_immediate_work():
                 # provably idle: materialize any in-flight draw batch that
                 # could produce an event before the next queued one, then
@@ -201,9 +217,25 @@ class Controller:
             else:
                 now = round_end
         self.engine.flush_all()  # finalize counters for in-flight batches
+        if cfg.general.progress:
+            import sys as _sys
+
+            print(file=_sys.stderr)  # end the \r status line
         self.wall_seconds = _walltime.perf_counter() - t0
         self.scheduler.shutdown()
         return self._finalize(min(now, stop))
+
+    def _progress(self, sim_now: SimTime, stop: SimTime, t0: float) -> None:
+        """Terminal status line (reference: the status bar, SURVEY.md §2)."""
+        import sys as _sys
+
+        wall = _walltime.perf_counter() - t0
+        pct = 100 * sim_now // stop
+        rate = (sim_now / NS_PER_SEC) / wall if wall > 0 else 0.0
+        eta = (stop - sim_now) / NS_PER_SEC / rate if rate > 0 else 0.0
+        print(f"\r[{pct:3d}%] sim {format_time(sim_now)} / "
+              f"{format_time(stop)}  {rate:.2f} sim-s/s  eta {eta:.0f}s   ",
+              end="", file=_sys.stderr, flush=True)
 
     def _heartbeat(self, sim_now: SimTime, t0: float) -> None:
         wall = _walltime.perf_counter() - t0
@@ -241,6 +273,8 @@ class Controller:
         self.data_dir.mkdir(parents=True, exist_ok=True)
         for h in self.hosts:
             h.flush_logs(self.data_dir)
+            if h.pcap is not None:
+                h.pcap.close()
         self.log.flush()
         return {
             "sim_seconds": sim_sec,

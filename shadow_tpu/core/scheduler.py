@@ -14,7 +14,7 @@ Mirrors the reference's scheduler crate (SURVEY.md §1 layer 4, §2
 Correctness note: within a round, a host's events touch only that host's
 state; cross-host effects flow exclusively through the engine at the round
 barrier. So any assignment of hosts to threads yields identical results —
-the determinism tests (tests/test_determinism.py) assert this across
+the determinism tests (tests/test_e2e_phase1.py) assert this across
 policies.
 
 CPython's GIL means thread policies don't add real CPU parallelism for pure-

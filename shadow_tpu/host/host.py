@@ -21,6 +21,7 @@ from shadow_tpu.network.unit import Unit
 from shadow_tpu.utils.counters import Counters
 
 EPHEMERAL_BASE = 49152
+LOG_LEVELS = ("error", "warning", "info", "debug", "trace")
 
 
 class Host:
@@ -46,6 +47,8 @@ class Host:
         self._conns: dict[tuple[int, int, int], StreamEndpoint] = {}
         self._next_ephemeral = EPHEMERAL_BASE
         self._log_lines: list[str] = []
+        self.pcap = None  # PcapWriter when hosts.<name>.pcap_enabled
+        self.log_level = "info"  # per-host override (hosts.<name>.log_level)
 
     # -- time & events ----------------------------------------------------
     @property
@@ -81,11 +84,16 @@ class Host:
     def emit_unit(self, u: Unit) -> None:
         self.egress.append(u)
         self.counters.add("units_emitted", 1)
+        if self.pcap is not None:
+            ctl = self.controller
+            self.pcap.capture(u, u.t_emit, self.ip, ctl.hosts[u.dst].ip)
 
     def deliver(self, u: Unit, now: SimTime) -> None:
         """A unit cleared the ingress token bucket: dispatch to a socket."""
         self._now = max(self._now, now)
         self.counters.add("units_delivered", 1)
+        if self.pcap is not None:
+            self.pcap.capture(u, now, self.controller.hosts[u.src].ip, self.ip)
         if u.kind == U.DGRAM:
             sock = self._udp.get(u.dst_port)
             if sock is not None:
@@ -156,8 +164,9 @@ class Host:
         self._conns.pop((ep.local_port, ep.remote_host, ep.remote_port), None)
 
     # -- logging ----------------------------------------------------------
-    def log(self, msg: str) -> None:
-        self._log_lines.append(msg)
+    def log(self, msg: str, level: str = "info") -> None:
+        if LOG_LEVELS.index(level) <= LOG_LEVELS.index(self.log_level):
+            self._log_lines.append(msg)
 
     def flush_logs(self, data_dir) -> None:
         if not self._log_lines:

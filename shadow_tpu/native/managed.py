@@ -141,6 +141,9 @@ class ManagedProcess(ProcessLifecycle):
         self._next_vfd = VFD_BASE
         self._files: dict[int, object] = {}  # 1/2 -> open capture files
         self._waiting = None  # (kind, ...) while parked
+        self._strace = None  # open file when strace_logging_mode != off
+        gen = host.controller.cfg.general
+        self._syscall_latency = 1000 if gen.model_unblocked_syscall_latency else 0
 
     # -- lifecycle ---------------------------------------------------------
     def spawn(self) -> None:
@@ -186,6 +189,14 @@ class ManagedProcess(ProcessLifecycle):
         self.mem = ProcessMemory(self.proc.pid)
         self.running = True
         self.host.counters.add("processes_spawned", 1)
+        mode = self.host.controller.cfg.experimental.strace_logging_mode
+        if mode != "off":
+            # reference analog: strace_logging (SURVEY.md §5.1): every
+            # emulated syscall with args and result. "deterministic" omits
+            # the sim timestamp so logs diff clean across configs whose
+            # timing legitimately differs.
+            self._strace = open(ddir / f"{self.name}.strace", "w")
+            self._strace_times = mode != "deterministic"
 
         # handshake with a real-time bound: a binary the preload cannot
         # enter (static link, setuid) would otherwise hang the scheduler
@@ -206,12 +217,19 @@ class ManagedProcess(ProcessLifecycle):
 
     def shutdown(self) -> None:
         if self.running and self.proc is not None:
-            self.proc.kill()
-            # the pump (or a pending continuation) observes EOF/EPIPE next
-            if self._waiting is None:
-                self._pump()
-            else:
-                self._exited()
+            import signal as _signal
+
+            sig = getattr(_signal, self.opts.shutdown_signal, _signal.SIGKILL)
+            self.proc.send_signal(sig)
+            try:
+                # whenever this event can run, the process is parked on the
+                # IPC channel (not mid-turn), so a termination signal takes
+                # effect without a grant; handlers that ignore it get the
+                # reference's escalation: SIGKILL
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+            self._exited()
 
     def reap(self) -> None:
         """Sim over (reference §3.5): kill and reap a still-running child."""
@@ -253,7 +271,14 @@ class ManagedProcess(ProcessLifecycle):
             except OSError:
                 ret = -EFAULT  # guest memory went away (racing exit)
             if ret is _BLOCK:
+                self._trace(nr, args, "<blocked>")
                 return
+            self._trace(nr, args, ret)
+            if self._syscall_latency:
+                # model_unblocked_syscall_latency: each serviced syscall
+                # advances this host's clock slightly, so busy-loops spin
+                # forward in sim time instead of livelocking the round
+                self.host._now += self._syscall_latency
             try:
                 self._reply(ret)
             except OSError:
@@ -266,6 +291,7 @@ class ManagedProcess(ProcessLifecycle):
         if not self.running:
             return
         self._waiting = None
+        self._trace(-1, (), f"<resumed> = {ret}")
         try:
             self._reply(ret)
         except OSError:
@@ -274,10 +300,26 @@ class ManagedProcess(ProcessLifecycle):
         self.host.counters.add("syscalls", 1)
         self._pump()
 
+    def _trace(self, nr: int, args, ret) -> None:
+        if self._strace is None:
+            return
+        ts = f"{self.host.now} " if self._strace_times else ""
+        if nr < 0:
+            self._strace.write(f"{ts}{ret}\n")
+        else:
+            # deterministic mode omits raw args: they carry ASLR'd guest
+            # pointers that legitimately differ between runs
+            a = ",".join(hex(x) for x in args) if self._strace_times else "..."
+            self._strace.write(f"{ts}syscall_{nr}({a}) = {ret}\n")
+
     def _exited(self) -> None:
         if self.proc is None:
             return
         code = self.proc.wait()
+        if self._strace is not None:
+            self._strace.write(f"+++ exited with {code} +++\n")
+            self._strace.close()
+            self._strace = None
         for f in self._files.values():
             f.close()
         self._files.clear()

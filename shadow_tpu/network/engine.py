@@ -65,13 +65,17 @@ class _Outstanding:
 class NetworkEngine:
     def __init__(self, graph: NetworkGraph, params: NetParams, hosts,
                  round_ns: SimTime, backend: str = "numpy",
-                 tpu_options=None) -> None:
+                 tpu_options=None, bootstrap_end: SimTime = 0) -> None:
         self.graph = graph
         self.params = params
         self.hosts = hosts
         self.round_ns = round_ns
         self.backend = backend
         self.buckets = TokenBuckets(params)
+        #: before this sim time, bandwidth limits are suspended (reference:
+        #: general.bootstrap_end_time — lets large deployments bootstrap
+        #: without token-bucket congestion; loss still applies)
+        self.bootstrap_end = bootstrap_end
         self.tokens_down = params.cap_down.copy()
         self._last_refill: SimTime = 0
         self._ev_key = 0  # canonical per-unit event key counter
@@ -94,7 +98,9 @@ class NetworkEngine:
         if backend == "tpu":
             from shadow_tpu.ops.propagate import DeviceDrawPlane
 
-            self.device = DeviceDrawPlane(params.seed, self.max_batch)
+            self.device = DeviceDrawPlane(
+                params.seed, self.max_batch,
+                n_shards=int(getattr(tpu_options, "tpu_mesh_shards", 0) or 0))
             floor = int(getattr(tpu_options, "tpu_device_floor", 0) or 0)
             if floor > 0:
                 self.device_floor = floor
@@ -147,6 +153,9 @@ class NetworkEngine:
     def ingress_arrival(self, u: Unit, now: SimTime) -> None:
         """Down-link token bucket at the destination (runs on the dst host's
         thread via its arrival event, or single-threaded from round start)."""
+        if now < self.bootstrap_end:
+            self.hosts[u.dst].deliver(u, now)
+            return
         if self.tokens_down[u.dst] >= u.size:
             self.tokens_down[u.dst] -= u.size
             self.hosts[u.dst].deliver(u, now)
@@ -167,7 +176,10 @@ class NetworkEngine:
         src = np.fromiter((u.src for u in units), dtype=np.int32, count=n)
         size = np.fromiter((u.size for u in units), dtype=np.int32, count=n)
         t_emit = np.fromiter((u.t_emit for u in units), dtype=np.int64, count=n)
-        depart = self.buckets.depart_times(src, size, t_emit, round_start)
+        if round_start < self.bootstrap_end:
+            depart = t_emit.copy()  # bootstrap: unlimited bandwidth
+        else:
+            depart = self.buckets.depart_times(src, size, t_emit, round_start)
 
         dst = np.fromiter((u.dst for u in units), dtype=np.int32, count=n)
         sn = self.params.host_node[src]

@@ -62,8 +62,8 @@ class NetworkGraph:
     @property
     def min_latency_ns(self) -> SimTime:
         """The conservative-PDES lookahead bound: the smallest finite
-        off-path... smallest finite latency anywhere in the table (including
-        self-edges, which bound same-node host pairs)."""
+        nonzero latency anywhere in the table (including self-edges, which
+        bound same-node host pairs)."""
         finite = self.latency_ns[self.latency_ns < INF_I64]
         finite = finite[finite > 0]
         if finite.size == 0:

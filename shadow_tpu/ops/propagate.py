@@ -101,12 +101,27 @@ class DeviceDrawPlane:
 
     name = "tpu"
 
-    def __init__(self, seed: int, max_batch: int = 65536) -> None:
+    def __init__(self, seed: int, max_batch: int = 65536,
+                 n_shards: int = 0) -> None:
+        """n_shards > 1 shards each batch over that many local devices
+        (experimental.tpu_mesh_shards; 0 = all local devices). The kernel
+        is elementwise along the unit axis, so XLA partitions it with no
+        communication — data-parallel draws across the mesh."""
         from shadow_tpu.ops.jaxcfg import configure
 
         configure()
         self.seed = int(seed)
         self.max_batch = int(max_batch)
+        self._sharding = None
+        devs = jax.devices()
+        n = n_shards if n_shards > 0 else len(devs)
+        n = min(n, len(devs))
+        if n > 1:
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+            mesh = Mesh(np.array(devs[:n]), ("d",))
+            self._sharding = NamedSharding(mesh, PartitionSpec(None, "d"))
+            self._n_shards = n
 
     def dispatch(self, uid_lo: np.ndarray, uid_hi: np.ndarray,
                  npkts: np.ndarray, thresh: np.ndarray) -> DrawHandle:
@@ -114,12 +129,17 @@ class DeviceDrawPlane:
         device->host copy; returns a handle to read when due."""
         n = uid_lo.shape[0]
         p = _bucket(n, self.max_batch)
+        if self._sharding is not None:
+            q = 8 * self._n_shards  # packbits + even split across shards
+            p = -(-max(p, q) // q) * q
         packed = np.zeros((4, p), dtype=np.uint32)
         packed[0, :n] = uid_lo
         packed[1, :n] = uid_hi
         packed[2, :n] = npkts
         packed[3, :n] = thresh
-        out = _draw_kernel(jnp.asarray(packed), seed=self.seed)
+        dev_in = (jax.device_put(packed, self._sharding)
+                  if self._sharding is not None else jnp.asarray(packed))
+        out = _draw_kernel(dev_in, seed=self.seed)
         try:
             out.copy_to_host_async()
         except AttributeError:  # some backends lack the hint; read() suffices
