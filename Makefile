@@ -1,0 +1,25 @@
+# Top-level developer/CI entry points.
+#
+#   make native   - build the preload shim + native test programs
+#   make test     - full pytest suite (CPU JAX, 8 virtual devices)
+#   make ci       - the full gate: native build, tests, multichip dry run,
+#                   and the 1k-host twice-run determinism check
+#   make bench    - the benchmark harness (one JSON line on stdout)
+
+.PHONY: native test ci bench clean
+
+native:
+	$(MAKE) -C native
+
+test: native
+	python -m pytest tests/ -q
+
+ci: native
+	bash tools/ci.sh
+
+bench:
+	python bench.py
+
+clean:
+	$(MAKE) -C native clean
+	rm -rf shadow.data

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# The full local CI gate (SURVEY.md §2 "CI", §4): unit+integration tests on
+# the 8-virtual-device CPU platform, the multichip dry run, and a 1k-host
+# scale determinism check (twice-run, full output-tree hash compare).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== pytest (CPU JAX, 8 virtual devices) =="
+python -m pytest tests/ -q
+
+echo "== multichip dry run (8-shard virtual mesh) =="
+GRAFT_NDEV=8 python __graft_entry__.py
+
+echo "== 1k-host scale determinism (twice-run hash compare) =="
+export JAX_PLATFORMS=cpu
+run() {
+    python -m shadow_tpu examples/tgen_1k.yaml --quiet --json-summary \
+        --data-directory "/tmp/ci-det-$1" \
+        | python -c 'import json,sys; d=json.load(sys.stdin); d.pop("wall_seconds"); d.pop("sim_sec_per_wall_sec"); print(json.dumps(d,sort_keys=True))' \
+        > "/tmp/ci-det-$1.json"
+    (cd "/tmp/ci-det-$1" && find hosts -type f | sort | xargs sha256sum) \
+        > "/tmp/ci-det-$1.hashes"
+}
+run a
+run b
+diff /tmp/ci-det-a.json /tmp/ci-det-b.json
+diff /tmp/ci-det-a.hashes /tmp/ci-det-b.hashes
+echo "determinism OK: $(python -c 'import json;print(json.load(open("/tmp/ci-det-a.json"))["events"])') events bit-identical"
+
+echo "== CI gate passed =="
