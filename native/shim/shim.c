@@ -152,45 +152,46 @@ time_t time(time_t *out) {
 
 static int install_seccomp(void) {
   /* layout (jump targets are relative to the NEXT instruction):
-   *   25 = TRAP, 26 = ALLOW
-   *   [13]/[14]: nr 41..59 -> TRAP (sockets + clone/fork/vfork/execve,
+   *   26 = TRAP, 27 = ALLOW
+   *   [14]/[15]: nr 41..59 -> TRAP (sockets + clone/fork/vfork/execve,
    *   which the worker fails loudly with ENOSYS — a second guest thread
-   *   would race the single IPC channel)
-   *   15..18 read:  ipc->ALLOW, stdin->TRAP, vfd->TRAP, else ALLOW
-   *   19..22 write: ipc->ALLOW, fd<3->TRAP, vfd->TRAP, else ALLOW
-   *   23..24 close: vfd->TRAP, else ALLOW
+   *   would race the single IPC channel); accept4/clone3 trapped by JEQ
+   *   16..19 read:  ipc->ALLOW, stdin->TRAP, vfd->TRAP, else ALLOW
+   *   20..23 write: ipc->ALLOW, fd<3->TRAP, vfd->TRAP, else ALLOW
+   *   24..25 close: vfd->TRAP, else ALLOW
    */
   struct sock_filter prog[] = {
       /* [0] */ LD(BPF_ARCHF),
-      /* [1] */ JEQ(AUDIT_ARCH_X86_64, 0, 24),          /* !x86-64 -> ALLOW */
+      /* [1] */ JEQ(AUDIT_ARCH_X86_64, 0, 25),          /* !x86-64 -> ALLOW */
       /* [2] */ LD(BPF_NR),
-      /* [3] */ JEQ(SYS_read, 11, 0),                   /* -> 15            */
-      /* [4] */ JEQ(SYS_write, 14, 0),                  /* -> 19            */
-      /* [5] */ JEQ(SYS_close, 17, 0),                  /* -> 23            */
-      /* [6] */ JEQ(SYS_nanosleep, 18, 0),              /* -> TRAP          */
-      /* [7] */ JEQ(SYS_clock_nanosleep, 17, 0),
-      /* [8] */ JEQ(SYS_clock_gettime, 16, 0),
-      /* [9] */ JEQ(SYS_gettimeofday, 15, 0),
-      /* [10] */ JEQ(SYS_time, 14, 0),
-      /* [11] */ JEQ(SYS_getrandom, 13, 0),
-      /* [12] */ JEQ(435 /* clone3 */, 12, 0),
-      /* [13] */ JGE(SYS_socket, 0, 12),                /* nr<41 -> ALLOW   */
-      /* [14] */ JGE(60, 11, 10),                       /* 41..59 -> TRAP   */
+      /* [3] */ JEQ(SYS_read, 12, 0),                   /* -> 16            */
+      /* [4] */ JEQ(SYS_write, 15, 0),                  /* -> 20            */
+      /* [5] */ JEQ(SYS_close, 18, 0),                  /* -> 24            */
+      /* [6] */ JEQ(SYS_nanosleep, 19, 0),              /* -> TRAP          */
+      /* [7] */ JEQ(SYS_clock_nanosleep, 18, 0),
+      /* [8] */ JEQ(SYS_clock_gettime, 17, 0),
+      /* [9] */ JEQ(SYS_gettimeofday, 16, 0),
+      /* [10] */ JEQ(SYS_time, 15, 0),
+      /* [11] */ JEQ(SYS_getrandom, 14, 0),
+      /* [12] */ JEQ(435 /* clone3 */, 13, 0),
+      /* [13] */ JEQ(288 /* accept4 */, 12, 0),
+      /* [14] */ JGE(SYS_socket, 0, 12),                /* nr<41 -> ALLOW   */
+      /* [15] */ JGE(60, 11, 10),                       /* 41..59 -> TRAP   */
       /* read */
-      /* [15] */ LD(BPF_ARG0),
-      /* [16] */ JEQ(SHIM_IPC_FD, 9, 0),                /* -> ALLOW         */
-      /* [17] */ JEQ(0, 7, 0),                          /* stdin -> TRAP    */
-      /* [18] */ JGE(SHIM_VFD_BASE, 6, 7),              /* vfd->TRAP/ALLOW  */
+      /* [16] */ LD(BPF_ARG0),
+      /* [17] */ JEQ(SHIM_IPC_FD, 9, 0),                /* -> ALLOW         */
+      /* [18] */ JEQ(0, 7, 0),                          /* stdin -> TRAP    */
+      /* [19] */ JGE(SHIM_VFD_BASE, 6, 7),              /* vfd->TRAP/ALLOW  */
       /* write */
-      /* [19] */ LD(BPF_ARG0),
-      /* [20] */ JEQ(SHIM_IPC_FD, 5, 0),                /* -> ALLOW         */
-      /* [21] */ JGE(3, 0, 3),                          /* fd<3 -> TRAP     */
-      /* [22] */ JGE(SHIM_VFD_BASE, 2, 3),              /* vfd->TRAP/ALLOW  */
+      /* [20] */ LD(BPF_ARG0),
+      /* [21] */ JEQ(SHIM_IPC_FD, 5, 0),                /* -> ALLOW         */
+      /* [22] */ JGE(3, 0, 3),                          /* fd<3 -> TRAP     */
+      /* [23] */ JGE(SHIM_VFD_BASE, 2, 3),              /* vfd->TRAP/ALLOW  */
       /* close */
-      /* [23] */ LD(BPF_ARG0),
-      /* [24] */ JGE(SHIM_VFD_BASE, 0, 1),              /* vfd->TRAP/ALLOW  */
-      /* [25] */ RET(SECCOMP_RET_TRAP),
-      /* [26] */ RET(SECCOMP_RET_ALLOW),
+      /* [24] */ LD(BPF_ARG0),
+      /* [25] */ JGE(SHIM_VFD_BASE, 0, 1),              /* vfd->TRAP/ALLOW  */
+      /* [26] */ RET(SECCOMP_RET_TRAP),
+      /* [27] */ RET(SECCOMP_RET_ALLOW),
   };
   struct sock_fprog fprog = {sizeof(prog) / sizeof(prog[0]), prog};
   if (prctl(PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0) != 0) return -1;

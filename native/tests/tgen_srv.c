@@ -1,0 +1,64 @@
+/* tgen_srv — a real TCP server test program (dual-run oracle, like
+ * tgen_cli.c but the accept side): serve <nconns> tgen-format requests
+ * (8-byte decimal byte count -> that many bytes back), then exit 0.
+ *
+ *   usage: tgen_srv <port> <nconns>
+ */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+  if (argc != 3) {
+    fprintf(stderr, "usage: %s <port> <nconns>\n", argv[0]);
+    return 2;
+  }
+  int nconns = atoi(argv[2]);
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  if (srv < 0) { perror("socket"); return 1; }
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof addr);
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((unsigned short)atoi(argv[1]));
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (bind(srv, (struct sockaddr *)&addr, sizeof addr) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(srv, 16) != 0) { perror("listen"); return 1; }
+
+  static char buf[65536];
+  memset(buf, 'x', sizeof buf);
+  long served = 0;
+  for (int i = 0; i < nconns; i++) {
+    struct sockaddr_in peer;
+    socklen_t plen = sizeof peer;
+    int conn = accept(srv, (struct sockaddr *)&peer, &plen);
+    if (conn < 0) { perror("accept"); return 1; }
+    char req[9] = {0};
+    long got = 0;
+    while (got < 8) {
+      long n = recv(conn, req + got, 8 - got, 0);
+      if (n <= 0) { perror("recv"); return 1; }
+      got += n;
+    }
+    long want = atol(req), sent = 0;
+    while (sent < want) {
+      long k = want - sent > (long)sizeof buf ? (long)sizeof buf : want - sent;
+      long n = send(conn, buf, k, 0);
+      if (n <= 0) { perror("send"); return 1; }
+      sent += n;
+    }
+    close(conn);
+    served += sent;
+  }
+  close(srv);
+  printf("served=%d bytes=%ld\n", nconns, served);
+  return 0;
+}

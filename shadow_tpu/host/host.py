@@ -132,6 +132,9 @@ class Host:
             raise ValueError(f"{self.name}: port {port} already listening")
         self._listeners[port] = on_accept
 
+    def unlisten(self, port: int) -> None:
+        self._listeners.pop(port, None)
+
     def _make_endpoint(self, local_port: int, remote_host: int,
                        remote_port: int, initiator: bool) -> StreamEndpoint:
         exp = self.controller.cfg.experimental

@@ -54,6 +54,7 @@ SYS_setsockopt, SYS_getsockopt = 54, 55
 SYS_gettimeofday, SYS_time = 96, 201
 SYS_clock_gettime, SYS_clock_nanosleep = 228, 230
 SYS_getrandom = 318
+SYS_accept4 = 288
 SYS_clone, SYS_fork, SYS_vfork, SYS_execve, SYS_clone3 = 56, 57, 58, 59, 435
 
 EPERM, EBADF, EAGAIN, EFAULT, EINVAL, EPIPE = 1, 9, 11, 14, 22, 32
@@ -106,9 +107,10 @@ def _shim_lib() -> Path:
 
 
 class VSocket:
-    """One virtual descriptor: a simulated stream socket."""
+    """One virtual descriptor: a simulated stream socket (or listener)."""
 
-    __slots__ = ("vfd", "endpoint", "rxbuf", "peer_closed", "connected")
+    __slots__ = ("vfd", "endpoint", "rxbuf", "peer_closed", "connected",
+                 "bound_port", "listening", "accept_q")
 
     def __init__(self, vfd: int) -> None:
         self.vfd = vfd
@@ -116,6 +118,9 @@ class VSocket:
         self.rxbuf = bytearray()
         self.peer_closed = False
         self.connected = False
+        self.bound_port = 0
+        self.listening = False
+        self.accept_q: list = []  # pre-wired VSockets awaiting accept()
 
 
 class ManagedProcess(ProcessLifecycle):
@@ -350,6 +355,8 @@ class ManagedProcess(ProcessLifecycle):
             vs = self.fds.pop(args[0], None)
             if vs is None:
                 return -EBADF
+            if vs.listening:
+                self.host.unlisten(vs.bound_port)
             if vs.endpoint is not None:
                 vs.endpoint.close()
             return 0
@@ -425,8 +432,19 @@ class ManagedProcess(ProcessLifecycle):
                 self.mem.write(args[1], sa)
                 self.mem.write(args[2], struct.pack("<i", len(sa)))
             return 0
-        if nr in (SYS_bind, SYS_listen, SYS_accept, SYS_sendmsg, SYS_recvmsg):
-            return -ENOSYS  # server-side sockets: next iteration
+        if nr == SYS_bind:
+            vs = self.fds.get(args[0])
+            if vs is None:
+                return -EBADF
+            raw = self.mem.read(args[1], min(max(args[2], 16), 128))
+            vs.bound_port = struct.unpack_from(">H", raw, 2)[0]
+            return 0
+        if nr == SYS_listen:
+            return self._listen(args[0])
+        if nr in (SYS_accept, SYS_accept4):
+            return self._accept(args[0], args[1], args[2])
+        if nr in (SYS_sendmsg, SYS_recvmsg):
+            return -ENOSYS  # scatter-gather io: not yet
         if nr in (SYS_clone, SYS_fork, SYS_vfork, SYS_execve, SYS_clone3):
             # multi-threaded/forking guests would race the single IPC
             # channel; fail loudly until per-thread channels exist
@@ -434,6 +452,68 @@ class ManagedProcess(ProcessLifecycle):
         return -ENOSYS
 
     # -- socket bridge -----------------------------------------------------
+    def _wire_endpoint(self, vs: VSocket, ep) -> None:
+        vs.endpoint = ep
+        ep.on_data = lambda n, payload, now: self._on_net_data(vs, n, payload)
+        ep.on_close = lambda now: self._on_net_close(vs)
+        ep.on_error = lambda msg: self._on_net_error(vs)
+
+    def _listen(self, fd: int):
+        vs = self.fds.get(fd)
+        if vs is None:
+            return -EBADF
+        if not vs.bound_port:
+            return -EINVAL
+        if vs.listening:
+            return 0
+
+        def on_accept(ep, now):
+            # wire rx buffering IMMEDIATELY: the peer's first data can land
+            # before the app calls accept() (SYNACK already went out)
+            conn = VSocket(-1)
+            conn.connected = True
+            self._wire_endpoint(conn, ep)
+            w = self._waiting
+            if w and w[0] == "accept" and w[1] is vs:
+                self._finish_accept(vs, conn, w[2], w[3])
+            else:
+                vs.accept_q.append(conn)
+
+        try:
+            self.host.listen(vs.bound_port, on_accept)
+        except ValueError:
+            return -98  # EADDRINUSE
+        vs.listening = True
+        return 0
+
+    def _accept(self, fd: int, addr: int, addrlen: int):
+        vs = self.fds.get(fd)
+        if vs is None:
+            return -EBADF
+        if not vs.listening:
+            return -EINVAL
+        if vs.accept_q:
+            return self._do_accept(vs, vs.accept_q.pop(0), addr, addrlen)
+        self._waiting = ("accept", vs, addr, addrlen)
+        return _BLOCK
+
+    def _do_accept(self, vs: VSocket, conn: VSocket, addr: int, addrlen: int):
+        conn.vfd = self._next_vfd
+        self._next_vfd += 1
+        self.fds[conn.vfd] = conn
+        if addr and addrlen:
+            peer = self.host.controller.hosts[conn.endpoint.remote_host]
+            sa = (struct.pack("<H", socket.AF_INET)
+                  + struct.pack(">H", conn.endpoint.remote_port)
+                  + socket.inet_aton(peer.ip) + b"\0" * 8)
+            self.mem.write(addr, sa)
+            self.mem.write(addrlen, struct.pack("<i", len(sa)))
+        return conn.vfd
+
+    def _finish_accept(self, vs: VSocket, conn: VSocket, addr: int,
+                       addrlen: int) -> None:
+        self._resume(self._do_accept(vs, conn, addr, addrlen))
+
     def _connect(self, fd: int, addr: int, addrlen: int):
         vs = self.fds.get(fd)
         if vs is None:
@@ -449,10 +529,7 @@ class ManagedProcess(ProcessLifecycle):
         except KeyError:
             return -ENETUNREACH
         ep = self.host.connect(peer, port)
-        vs.endpoint = ep
-        ep.on_data = lambda n, payload, now: self._on_net_data(vs, n, payload)
-        ep.on_close = lambda now: self._on_net_close(vs)
-        ep.on_error = lambda msg: self._on_net_error(vs)
+        self._wire_endpoint(vs, ep)
         ep.on_connected = lambda now: self._on_connected(vs)
         self._waiting = ("connect", vs)
         ep.connect()

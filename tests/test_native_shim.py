@@ -173,3 +173,104 @@ def test_managed_run_deterministic():
     outs = [Path(f"/tmp/st-native-det-{t}/hosts/client/tgen_cli.0.stdout"
                  ).read_text() for t in ("a", "b")]
     assert outs[0] == outs[1]
+
+
+# ---- server-side managed sockets (bind/listen/accept) ---------------------
+
+def test_tgen_srv_native_oracle():
+    import random
+
+    port = random.randint(20000, 60000)
+    p = subprocess.Popen([str(BUILD / "tgen_srv"), str(port), "2"],
+                         stdout=subprocess.PIPE, text=True)
+    import time as _t
+
+    _t.sleep(0.2)
+    for _ in range(2):
+        s = socket.socket()
+        s.connect(("127.0.0.1", port))
+        s.sendall(b"   40000")
+        got = 0
+        while got < 40000:
+            chunk = s.recv(65536)
+            assert chunk
+            got += len(chunk)
+        s.close()
+    out, _ = p.communicate(timeout=10)
+    assert p.returncode == 0
+    assert "served=2 bytes=80000" in out
+
+
+SRV_MANAGED_CFG = f"""
+general:
+  stop_time: 30s
+  seed: 8
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 1 host_bandwidth_up "50 Mbit" host_bandwidth_down "50 Mbit" ]
+        edge [ source 0 target 1 latency "20 ms" ]
+        edge [ source 0 target 0 latency "5 ms" ]
+        edge [ source 1 target 1 latency "5 ms" ]
+      ]
+hosts:
+  server:
+    network_node_id: 0
+    ip_addr: 11.0.0.1
+    processes:
+      - path: {BUILD}/tgen_srv
+        args: ["8080", "2"]
+        expected_final_state: {{exited: 0}}
+  client:
+    network_node_id: 1
+    processes:
+      - path: pyapp:shadow_tpu.models.tgen:TGenClient
+        args: ["200 kB", "2", serial, "8080", server]
+        start_time: 1s
+        expected_final_state: {{exited: 0}}
+"""
+
+
+def test_real_server_binary_serves_simulated_clients():
+    """The accept side: a real C server binary (socket/bind/listen/accept/
+    recv/send) serving two transfers to a plugin client over the simulated
+    network, then exiting cleanly."""
+    cfg = parse_config(yaml.safe_load(SRV_MANAGED_CFG), {
+        "general.data_directory": "/tmp/st-native-srv",
+    })
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    out = Path("/tmp/st-native-srv/hosts/server/tgen_srv.0.stdout").read_text()
+    assert "served=2 bytes=400000" in out, out
+    client = c.processes[1].app
+    assert client.completed == 2 and client.failed == 0
+    for h in c.hosts:
+        assert h._conns == {}, h.name
+
+
+def test_real_server_real_client_end_to_end():
+    """Both endpoints are real binaries: tgen_srv serves tgen_cli entirely
+    through the simulated network."""
+    cfg_text = SRV_MANAGED_CFG.replace(
+        'path: pyapp:shadow_tpu.models.tgen:TGenClient',
+        f'path: {BUILD}/tgen_cli',
+    ).replace('args: ["200 kB", "2", serial, "8080", server]',
+              'args: ["11.0.0.1", "8080", "150000"]'
+    ).replace('args: ["8080", "2"]', 'args: ["8080", "1"]')
+    cfg = parse_config(yaml.safe_load(cfg_text), {
+        "general.data_directory": "/tmp/st-native-both",
+    })
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    srv_out = Path("/tmp/st-native-both/hosts/server/tgen_srv.0.stdout").read_text()
+    cli_out = Path("/tmp/st-native-both/hosts/client/tgen_cli.0.stdout").read_text()
+    assert "served=1 bytes=150000" in srv_out, srv_out
+    assert "transfer-complete bytes=150000" in cli_out, cli_out
+    ms = int(cli_out.split("elapsed_ms=")[1].split()[0])
+    assert 40 <= ms <= 10_000, ms
