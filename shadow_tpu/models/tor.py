@@ -1,0 +1,293 @@
+"""Tor-shaped onion-routing workload (BASELINE.md config #3).
+
+Models the traffic *shape* of the reference's headline use case — the Tor
+network simulated by Shadow — without the cryptography: clients build
+3-hop circuits (guard, middle, exit chosen deterministically from the
+per-host RNG), telescope them with CREATE/EXTEND cells, then stream data
+through the circuit from a destination server via the exit. Every hop is a
+separate simulated TCP stream; relays maintain a circuit table and forward
+cells/bytes hop by hop, so the model exercises multi-hop stream relaying,
+connection fan-in at relays, and cascaded congestion control — the load
+profile of BASELINE config #3 (tornettools-shaped topologies).
+
+Wire protocol (framed over the byte stream; send boundaries may split but
+never merge, and byte counts are exact):
+  control cell: 12 real bytes [type:1][circ:2][len:2][pad:7] + len real
+                payload bytes (e.g. the EXTEND target's name)
+  data:         a DATA header cell followed by `len` counted bytes
+                (synthetic payload — only byte counts matter)
+
+Cell types: CREATE, CREATED, EXTEND, EXTENDED, BEGIN, CONNECTED, DATA, END.
+"""
+
+from __future__ import annotations
+
+from shadow_tpu.utils.units import parse_size
+
+HDR = 12
+CREATE, CREATED, EXTEND, EXTENDED, BEGIN, CONNECTED, DATA, END = range(8)
+
+
+def cell(ctype: int, circ: int, payload: bytes = b"") -> bytes:
+    return (bytes([ctype]) + circ.to_bytes(2, "big")
+            + len(payload).to_bytes(2, "big") + b"\0" * 7 + payload)
+
+
+class FrameReader:
+    """Reassembles the framed protocol from (nbytes, payload|None) chunks.
+
+    Control bytes arrive as real payload; DATA bodies arrive as counted
+    synthetic bytes. on_cell(type, circ, payload); on_body(circ, nbytes).
+    """
+
+    def __init__(self, on_cell, on_body):
+        self.buf = b""
+        self.body_left = 0
+        self.body_circ = 0
+        self.on_cell = on_cell
+        self.on_body = on_body
+
+    def feed(self, nbytes: int, payload) -> None:
+        if self.body_left > 0 and payload is None:
+            take = min(nbytes, self.body_left)
+            self.body_left -= take
+            self.on_body(self.body_circ, take)
+            if nbytes > take:  # next body's bytes can't precede its header
+                raise ValueError("framing error: stray counted bytes")
+            return
+        if payload is None:
+            raise ValueError("framing error: counted bytes outside DATA body")
+        self.buf += payload
+        while len(self.buf) >= HDR:
+            ctype = self.buf[0]
+            circ = int.from_bytes(self.buf[1:3], "big")
+            ln = int.from_bytes(self.buf[3:5], "big")
+            if ctype == DATA:
+                self.buf = self.buf[HDR:]
+                self.body_left = ln
+                self.body_circ = circ
+                return  # counted body follows in subsequent chunks
+            if len(self.buf) < HDR + ln:
+                return
+            payload_bytes = self.buf[HDR: HDR + ln]
+            self.buf = self.buf[HDR + ln:]
+            self.on_cell(ctype, circ, payload_bytes)
+
+
+class _Conn:
+    """One framed connection (either direction) owned by a relay/client."""
+
+    __slots__ = ("ep", "reader")
+
+    def __init__(self, ep, on_cell, on_body):
+        self.ep = ep
+        self.reader = FrameReader(on_cell, on_body)
+        ep.on_data = lambda n, p, now: self.reader.feed(n, p)
+
+
+class TorRelay:
+    """args: [or_port]"""
+
+    def __init__(self, api, args, env):
+        self.api = api
+        self.port = int(args[0]) if args else 9001
+        # circuit table: (conn id, circ) -> (peer conn, peer circ) both ways
+        self.table = {}
+        self.conns = {}
+        self._next_conn = 0
+        self._next_circ = 1
+        self.cells_relayed = 0
+        self.bytes_relayed = 0
+
+    def start(self):
+        self.api.listen(self.port, self._on_accept)
+
+    def _new_conn(self, ep):
+        cid = self._next_conn
+        self._next_conn += 1
+        conn = _Conn(ep,
+                     lambda t, c, p: self._on_cell(cid, t, c, p),
+                     lambda c, n: self._on_body(cid, c, n))
+        self.conns[cid] = conn
+        return cid, conn
+
+    def _on_accept(self, ep, now):
+        self._new_conn(ep)
+
+    def _on_cell(self, cid, ctype, circ, payload):
+        api = self.api
+        key = (cid, circ)
+        if ctype == CREATE:
+            self.conns[cid].ep.send(payload=cell(CREATED, circ))
+            return
+        if ctype == EXTEND:
+            # open (or reuse) a connection to the named next relay and
+            # splice a new circuit segment onto it
+            target, port = payload.decode().rsplit(":", 1)
+            ep = api.connect(target, int(port))
+            ncid, nconn = self._new_conn(ep)
+            ncirc = self._next_circ
+            self._next_circ += 1
+            self.table[key] = (ncid, ncirc)
+            self.table[(ncid, ncirc)] = key
+
+            def on_connected(now):
+                nconn.ep.send(payload=cell(CREATE, ncirc))
+
+            ep.on_connected = on_connected
+            ep.connect()
+            return
+        if ctype == CREATED:
+            back = self.table.get((cid, circ))
+            if back is not None:
+                self.conns[back[0]].ep.send(payload=cell(EXTENDED, back[1]))
+            return
+        # everything else forwards along the circuit unchanged
+        nxt = self.table.get(key)
+        if nxt is None:
+            return
+        self.cells_relayed += 1
+        self.conns[nxt[0]].ep.send(payload=cell(ctype, nxt[1], payload))
+
+    def _on_body(self, cid, circ, nbytes):
+        nxt = self.table.get((cid, circ))
+        if nxt is None:
+            return
+        self.bytes_relayed += nbytes
+        self.conns[nxt[0]].ep.send(nbytes=nbytes)
+
+    def stop(self):
+        self.api.log(f"relay done: cells={self.cells_relayed} "
+                     f"bytes={self.bytes_relayed}")
+
+
+class TorExit(TorRelay):
+    """An exit relay: terminates BEGIN cells by fetching from the
+    destination (a tgen-format server) and streaming DATA back.
+
+    args: [or_port]
+    """
+
+    def _on_cell(self, cid, ctype, circ, payload):
+        if ctype != BEGIN:
+            super()._on_cell(cid, ctype, circ, payload)
+            return
+        dest, port, want = payload.decode().split(":")
+        api = self.api
+        ep = api.connect(dest, int(port))
+        got = {"n": 0}
+        want_n = int(want)
+
+        def on_connected(now):
+            ep.send(payload=str(want_n).encode().rjust(8))
+            self.conns[cid].ep.send(payload=cell(CONNECTED, circ))
+
+        def on_data(nbytes, p, now):
+            got["n"] += nbytes
+            # re-frame the fetched bytes as circuit DATA toward the client
+            self.conns[cid].ep.send(payload=cell(DATA, circ, b"")[:3]
+                                    + nbytes.to_bytes(2, "big") + b"\0" * 7)
+            self.conns[cid].ep.send(nbytes=nbytes)
+            if got["n"] >= want_n:
+                ep.close()
+                self.conns[cid].ep.send(payload=cell(END, circ))
+
+        ep.on_connected = on_connected
+        ep.on_data = on_data
+        ep.connect()
+
+
+class TorClient:
+    """args: [n_relays, relay_port, server, server_port, size, circuits]
+
+    Relay hosts must be named relay0..relayN-1 with the exit being the
+    relay chosen last; the client telescopes guard->middle->exit, BEGINs a
+    fetch of `size` bytes from `server`, and records completion.
+    """
+
+    def __init__(self, api, args, env):
+        self.api = api
+        self.n_relays = int(args[0])
+        self.relay_port = int(args[1])
+        self.server = args[2]
+        self.server_port = int(args[3])
+        self.size = parse_size(args[4]) if len(args) > 4 else 100_000
+        self.n_circuits = int(args[5]) if len(args) > 5 else 1
+        self.completed = 0
+        self.failed = 0
+        self.completion_times = []
+
+    def start(self):
+        for _ in range(self.n_circuits):
+            self._build_circuit()
+
+    def _pick_hops(self):
+        rng = self.api.rng
+        hops = []
+        while len(hops) < 3:
+            r = int(rng.integers(0, self.n_relays))
+            if r not in hops:
+                hops.append(r)
+        return [f"relay{r}" for r in hops]
+
+    def _build_circuit(self):
+        api = self.api
+        hops = self._pick_hops()
+        t0 = api.now
+        circ = 1
+        got = {"n": 0}
+        state = {"stage": 0}  # hops extended so far
+
+        ep = api.connect(hops[0], self.relay_port)
+
+        def advance():
+            if state["stage"] < 2:
+                nxt = hops[state["stage"] + 1]
+                conn.ep.send(payload=cell(
+                    EXTEND, circ, f"{nxt}:{self.relay_port}".encode()))
+            else:
+                conn.ep.send(payload=cell(
+                    BEGIN, circ,
+                    f"{self.server}:{self.server_port}:{self.size}".encode()))
+
+        def on_cell(ctype, c, payload):
+            if ctype in (CREATED, EXTENDED):
+                state["stage"] += 1
+                advance()
+            elif ctype == END:
+                elapsed = api.now - t0
+                if got["n"] >= self.size:
+                    self.completed += 1
+                    self.completion_times.append(elapsed)
+                    api.log(f"circuit-complete hops={hops} bytes={got['n']} "
+                            f"elapsed_ms={elapsed // 1_000_000}")
+                else:
+                    self.failed += 1
+                conn.ep.close()
+                self._finish()
+
+        def on_body(c, nbytes):
+            got["n"] += nbytes
+
+        conn = _Conn(ep, on_cell, on_body)
+
+        def on_connected(now):
+            conn.ep.send(payload=cell(CREATE, circ))
+
+        def on_error(msg):
+            self.failed += 1
+            api.log(f"circuit-failed hops={hops}: {msg}")
+            self._finish()
+
+        ep.on_connected = on_connected
+        ep.on_error = on_error
+        ep.connect()
+
+    def _finish(self):
+        if self.completed + self.failed >= self.n_circuits:
+            self.api.log(
+                f"tor client done: {self.completed}/{self.n_circuits} ok")
+            self.api.exit(0 if self.failed == 0 else 1)
+
+    def stop(self):
+        pass

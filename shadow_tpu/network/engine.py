@@ -94,23 +94,41 @@ class NetworkEngine:
         self.max_batch = int(getattr(tpu_options, "tpu_max_batch", 65536) or 65536)
         self.device = None
         self.device_floor = float("inf")
-        self._auto_floor = False
         if backend == "tpu":
-            from shadow_tpu.ops.propagate import DeviceDrawPlane
-
-            self.device = DeviceDrawPlane(
-                params.seed, self.max_batch,
-                n_shards=int(getattr(tpu_options, "tpu_mesh_shards", 0) or 0))
+            n_shards = int(getattr(tpu_options, "tpu_mesh_shards", 0) or 0)
             floor = int(getattr(tpu_options, "tpu_device_floor", 0) or 0)
             if floor > 0:
+                from shadow_tpu.ops.propagate import DeviceDrawPlane
+
+                self.device = DeviceDrawPlane(params.seed, self.max_batch,
+                                              n_shards=n_shards)
                 self.device_floor = floor
             else:
-                # auto: route to the device when it beats the numpy twin.
-                # Calibration (a probe dispatch + compile) is deferred until
-                # a batch first reaches the provisional floor, so runs whose
-                # batches never get that large pay nothing.
-                self._auto_floor = True
-                self.device_floor = 512
+                # auto mode: device attach (~seconds on a tunneled chip),
+                # kernel compile, and floor calibration all run on a
+                # background thread; batches route to the numpy twin until
+                # the plane publishes. Because both paths are bit-identical
+                # and event order is canonicalized, WHEN the device comes
+                # online cannot affect results — only wall time.
+                import threading
+
+                threading.Thread(
+                    target=self._bg_init_device,
+                    args=(params.seed, n_shards), daemon=True,
+                ).start()
+
+    def _bg_init_device(self, seed: int, n_shards: int) -> None:
+        try:
+            from shadow_tpu.ops.propagate import DeviceDrawPlane
+
+            plane = DeviceDrawPlane(seed, self.max_batch, n_shards=n_shards)
+            dev_s, np_per_unit = plane.calibrate()
+            if np_per_unit > 0:
+                self.device_floor = max(512, min(
+                    int(dev_s / np_per_unit), self.max_batch))
+            self.device = plane  # publish last (reads are GIL-atomic)
+        except Exception:
+            pass  # no usable device: the numpy twin serves everything
 
     # latency helpers ------------------------------------------------------
     def latency_between(self, src_host: int, dst_host: int) -> SimTime:
@@ -219,13 +237,6 @@ class NetworkEngine:
             and n >= self.device_floor
             and bool((thresh > 0).any())
         )
-        if use_device and self._auto_floor:
-            self._auto_floor = False
-            dev_s, np_per_unit = self.device.calibrate()
-            if np_per_unit > 0:
-                self.device_floor = max(512, min(
-                    int(dev_s / np_per_unit), self.max_batch))
-            use_device = n >= self.device_floor
         if not use_device:
             flags = loss_flags(self.params.seed, *_uid_arrays(units, n), thresh)
             if forced is not None:
