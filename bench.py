@@ -120,6 +120,7 @@ def main() -> None:
 
     if args.all:
         for path, tag in (("examples/tgen_100host.yaml", "tgen_100"),
+                          ("examples/tor_400relay.yaml", "tor_400"),
                           ("examples/gossip_10k.yaml", "gossip_10k")):
             detail[tag] = {
                 "thread_per_core": run_config(path, "thread_per_core", f"{tag}-tpc"),
@@ -129,7 +130,7 @@ def main() -> None:
                 assert (detail[tag]["thread_per_core"][k]
                         == detail[tag]["tpu_batch"][k]), (tag, k)
         detail["draw_plane"] = draw_plane_throughput()
-        for tag in ("tgen_1k", "tgen_100", "gossip_10k"):
+        for tag in ("tgen_1k", "tgen_100", "tor_400", "gossip_10k"):
             for pol in detail[tag]:
                 detail[tag][pol].pop("counters", None)
                 detail[tag][pol].pop("process_errors", None)

@@ -33,6 +33,12 @@ def cell(ctype: int, circ: int, payload: bytes = b"") -> bytes:
             + len(payload).to_bytes(2, "big") + b"\0" * 7 + payload)
 
 
+def data_header(circ: int, body_len: int) -> bytes:
+    """A DATA cell header announcing `body_len` counted bytes to follow."""
+    return (bytes([DATA]) + circ.to_bytes(2, "big")
+            + body_len.to_bytes(2, "big") + b"\0" * 7)
+
+
 class FrameReader:
     """Reassembles the framed protocol from (nbytes, payload|None) chunks.
 
@@ -40,12 +46,13 @@ class FrameReader:
     synthetic bytes. on_cell(type, circ, payload); on_body(circ, nbytes).
     """
 
-    def __init__(self, on_cell, on_body):
+    def __init__(self, on_cell, on_body, on_data_hdr=None):
         self.buf = b""
         self.body_left = 0
         self.body_circ = 0
         self.on_cell = on_cell
         self.on_body = on_body
+        self.on_data_hdr = on_data_hdr  # (circ, body_len); relays forward it
 
     def feed(self, nbytes: int, payload) -> None:
         if self.body_left > 0 and payload is None:
@@ -66,6 +73,8 @@ class FrameReader:
                 self.buf = self.buf[HDR:]
                 self.body_left = ln
                 self.body_circ = circ
+                if self.on_data_hdr is not None:
+                    self.on_data_hdr(circ, ln)
                 return  # counted body follows in subsequent chunks
             if len(self.buf) < HDR + ln:
                 return
@@ -75,14 +84,57 @@ class FrameReader:
 
 
 class _Conn:
-    """One framed connection (either direction) owned by a relay/client."""
+    """One framed connection (either direction) owned by a relay/client.
 
-    __slots__ = ("ep", "reader")
+    Writes go through a pending queue pumped by on_drain: send() accepts
+    only what the bounded socket send buffer can hold, and a partially
+    written frame header would desync the peer's FrameReader."""
 
-    def __init__(self, ep, on_cell, on_body):
+    __slots__ = ("ep", "reader", "pending")
+
+    def __init__(self, ep, on_cell, on_body, on_data_hdr=None):
         self.ep = ep
-        self.reader = FrameReader(on_cell, on_body)
+        self.reader = FrameReader(on_cell, on_body, on_data_hdr)
+        self.pending = []  # ('p', bytes, offset) | ('n', count)
         ep.on_data = lambda n, p, now: self.reader.feed(n, p)
+        ep.on_drain = lambda room: self._pump()
+
+    def write(self, payload: bytes) -> None:
+        self.pending.append(["p", payload, 0])
+        self._pump()
+
+    def write_counted(self, nbytes: int) -> None:
+        self.pending.append(["n", nbytes])
+        self._pump()
+
+    def _pump(self) -> None:
+        while self.pending:
+            head = self.pending[0]
+            if head[0] == "p":
+                sent = self.ep.send(payload=head[1][head[2]:])
+                head[2] += sent
+                done = head[2] >= len(head[1])
+            else:
+                sent = self.ep.send(nbytes=head[1])
+                head[1] -= sent
+                done = head[1] <= 0
+            if done:
+                self.pending.pop(0)
+            if sent == 0 and not done:
+                return  # buffer full; on_drain resumes
+
+    def close_when_drained(self) -> None:
+        if not self.pending:
+            self.ep.close()
+        else:
+            prev = self.ep.on_drain
+
+            def pump_then_close(room):
+                prev(room)
+                if not self.pending:
+                    self.ep.close()
+
+            self.ep.on_drain = pump_then_close
 
 
 class TorRelay:
@@ -107,9 +159,23 @@ class TorRelay:
         self._next_conn += 1
         conn = _Conn(ep,
                      lambda t, c, p: self._on_cell(cid, t, c, p),
-                     lambda c, n: self._on_body(cid, c, n))
+                     lambda c, n: self._on_body(cid, c, n),
+                     lambda c, ln: self._on_data_hdr(cid, c, ln))
         self.conns[cid] = conn
+        # circuit teardown cascades along the connection chain: when one
+        # side closes, close every spliced peer connection too
+        ep.on_close = lambda now: self._on_conn_close(cid)
         return cid, conn
+
+    def _on_conn_close(self, cid):
+        self.conns.pop(cid, None)
+        peers = [v for k, v in self.table.items() if k[0] == cid]
+        self.table = {k: v for k, v in self.table.items()
+                      if k[0] != cid and v[0] != cid}
+        for ncid, _ in peers:
+            pc = self.conns.get(ncid)
+            if pc is not None:
+                pc.close_when_drained()
 
     def _on_accept(self, ep, now):
         self._new_conn(ep)
@@ -118,11 +184,12 @@ class TorRelay:
         api = self.api
         key = (cid, circ)
         if ctype == CREATE:
-            self.conns[cid].ep.send(payload=cell(CREATED, circ))
+            self.conns[cid].write(cell(CREATED, circ))
             return
-        if ctype == EXTEND:
-            # open (or reuse) a connection to the named next relay and
-            # splice a new circuit segment onto it
+        if ctype == EXTEND and key not in self.table:
+            # this relay is the circuit's current endpoint: open a
+            # connection to the named next relay and splice a new segment
+            # (an EXTEND for a further hop falls through to forwarding)
             target, port = payload.decode().rsplit(":", 1)
             ep = api.connect(target, int(port))
             ncid, nconn = self._new_conn(ep)
@@ -132,7 +199,7 @@ class TorRelay:
             self.table[(ncid, ncirc)] = key
 
             def on_connected(now):
-                nconn.ep.send(payload=cell(CREATE, ncirc))
+                nconn.write(cell(CREATE, ncirc))
 
             ep.on_connected = on_connected
             ep.connect()
@@ -140,21 +207,26 @@ class TorRelay:
         if ctype == CREATED:
             back = self.table.get((cid, circ))
             if back is not None:
-                self.conns[back[0]].ep.send(payload=cell(EXTENDED, back[1]))
+                self.conns[back[0]].write(cell(EXTENDED, back[1]))
             return
         # everything else forwards along the circuit unchanged
         nxt = self.table.get(key)
         if nxt is None:
             return
         self.cells_relayed += 1
-        self.conns[nxt[0]].ep.send(payload=cell(ctype, nxt[1], payload))
+        self.conns[nxt[0]].write(cell(ctype, nxt[1], payload))
+
+    def _on_data_hdr(self, cid, circ, body_len):
+        nxt = self.table.get((cid, circ))
+        if nxt is not None:
+            self.conns[nxt[0]].write(data_header(nxt[1], body_len))
 
     def _on_body(self, cid, circ, nbytes):
         nxt = self.table.get((cid, circ))
         if nxt is None:
             return
         self.bytes_relayed += nbytes
-        self.conns[nxt[0]].ep.send(nbytes=nbytes)
+        self.conns[nxt[0]].write_counted(nbytes)
 
     def stop(self):
         self.api.log(f"relay done: cells={self.cells_relayed} "
@@ -169,7 +241,8 @@ class TorExit(TorRelay):
     """
 
     def _on_cell(self, cid, ctype, circ, payload):
-        if ctype != BEGIN:
+        if ctype != BEGIN or (cid, circ) in self.table:
+            # mid-circuit relays forward BEGIN; only the endpoint exits
             super()._on_cell(cid, ctype, circ, payload)
             return
         dest, port, want = payload.decode().split(":")
@@ -180,17 +253,17 @@ class TorExit(TorRelay):
 
         def on_connected(now):
             ep.send(payload=str(want_n).encode().rjust(8))
-            self.conns[cid].ep.send(payload=cell(CONNECTED, circ))
+            self.conns[cid].write(cell(CONNECTED, circ))
 
         def on_data(nbytes, p, now):
             got["n"] += nbytes
             # re-frame the fetched bytes as circuit DATA toward the client
-            self.conns[cid].ep.send(payload=cell(DATA, circ, b"")[:3]
-                                    + nbytes.to_bytes(2, "big") + b"\0" * 7)
-            self.conns[cid].ep.send(nbytes=nbytes)
+            out = self.conns[cid]
+            out.write(data_header(circ, nbytes))
+            out.write_counted(nbytes)
             if got["n"] >= want_n:
                 ep.close()
-                self.conns[cid].ep.send(payload=cell(END, circ))
+                out.write(cell(END, circ))
 
         ep.on_connected = on_connected
         ep.on_data = on_data
@@ -236,17 +309,17 @@ class TorClient:
         t0 = api.now
         circ = 1
         got = {"n": 0}
-        state = {"stage": 0}  # hops extended so far
+        state = {"stage": 0}  # hops established so far (guard = 1)
 
         ep = api.connect(hops[0], self.relay_port)
 
         def advance():
-            if state["stage"] < 2:
-                nxt = hops[state["stage"] + 1]
-                conn.ep.send(payload=cell(
+            if state["stage"] < 3:
+                nxt = hops[state["stage"]]
+                conn.write(cell(
                     EXTEND, circ, f"{nxt}:{self.relay_port}".encode()))
             else:
-                conn.ep.send(payload=cell(
+                conn.write(cell(
                     BEGIN, circ,
                     f"{self.server}:{self.server_port}:{self.size}".encode()))
 
@@ -272,7 +345,7 @@ class TorClient:
         conn = _Conn(ep, on_cell, on_body)
 
         def on_connected(now):
-            conn.ep.send(payload=cell(CREATE, circ))
+            conn.write(cell(CREATE, circ))
 
         def on_error(msg):
             self.failed += 1

@@ -1,0 +1,82 @@
+"""Tor-shaped onion-routing workload tests (BASELINE.md config #3 model)."""
+
+import yaml
+
+from shadow_tpu.config import parse_config
+from shadow_tpu.core.controller import Controller
+
+TOR_CFG = """
+general:
+  stop_time: 60s
+  seed: 12
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+        node [ id 1 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 2 host_bandwidth_up "50 Mbit" host_bandwidth_down "50 Mbit" ]
+        edge [ source 0 target 1 latency "20 ms" ]
+        edge [ source 0 target 2 latency "40 ms" ]
+        edge [ source 1 target 2 latency "30 ms" ]
+        edge [ source 0 target 0 latency "5 ms" ]
+        edge [ source 1 target 1 latency "5 ms" ]
+        edge [ source 2 target 2 latency "5 ms" ]
+      ]
+hosts:
+  relay:
+    network_node_id: 1
+    quantity: 6
+    processes:
+      - path: pyapp:shadow_tpu.models.tor:TorExit
+        args: ["9001"]
+  web:
+    network_node_id: 0
+    processes:
+      - path: pyapp:shadow_tpu.models.tgen:TGenServer
+        args: ["80"]
+  user:
+    network_node_id: 2
+    quantity: 4
+    processes:
+      - path: pyapp:shadow_tpu.models.tor:TorClient
+        args: ["6", "9001", web, "80", "200 kB", "2"]
+        start_time: 1s
+        expected_final_state: {exited: 0}
+"""
+
+
+def run(**over):
+    cfg = parse_config(yaml.safe_load(TOR_CFG), {
+        "general.data_directory": "/tmp/st-tor", **over})
+    c = Controller(cfg, mirror_log=False)
+    return c, c.run()
+
+
+def test_circuits_complete_through_three_hops():
+    c, result = run()
+    assert result["process_errors"] == [], result["process_errors"]
+    clients = [p.app for p in c.processes if p.name.startswith("torclient")]
+    assert len(clients) == 4
+    for cl in clients:
+        assert cl.completed == 2 and cl.failed == 0
+        # 3 hops + exit fetch: at least 4 one-way latencies each direction
+        # plus telescoping handshakes; must be well over one direct RTT
+        for t in cl.completion_times:
+            assert t > 100_000_000, t
+    # relays actually relayed: total relayed bytes >= 2 hops' worth of the
+    # 8 fetches (the exit hop re-frames rather than relays)
+    relays = [p.app for p in c.processes if p.name.startswith("torexit")]
+    total = sum(r.bytes_relayed for r in relays)
+    assert total >= 2 * 8 * 200_000, total
+    for h in c.hosts:
+        assert h._conns == {}, h.name
+
+
+def test_tor_deterministic():
+    _, r1 = run(**{"general.data_directory": "/tmp/st-tor-d1"})
+    _, r2 = run(**{"general.data_directory": "/tmp/st-tor-d2"})
+    for k in ("events", "units_sent", "units_dropped", "bytes_sent", "counters"):
+        assert r1[k] == r2[k], k
