@@ -152,46 +152,60 @@ time_t time(time_t *out) {
 
 static int install_seccomp(void) {
   /* layout (jump targets are relative to the NEXT instruction):
-   *   26 = TRAP, 27 = ALLOW
-   *   [14]/[15]: nr 41..59 -> TRAP (sockets + clone/fork/vfork/execve,
+   *   35 = TRAP, 36 = ALLOW
+   *   [3]..[7]: fd-conditional families (read/write get their own checks;
+   *   close/ioctl/fcntl trap only on virtual fds)
+   *   [8]..[22]: unconditional traps — time/sleep family, getrandom,
+   *   poll/ppoll + the epoll family (I/O multiplexing over virtual fds),
+   *   accept4, clone3
+   *   [23]/[24]: nr 41..59 -> TRAP (sockets + clone/fork/vfork/execve,
    *   which the worker fails loudly with ENOSYS — a second guest thread
-   *   would race the single IPC channel); accept4/clone3 trapped by JEQ
-   *   16..19 read:  ipc->ALLOW, stdin->TRAP, vfd->TRAP, else ALLOW
-   *   20..23 write: ipc->ALLOW, fd<3->TRAP, vfd->TRAP, else ALLOW
-   *   24..25 close: vfd->TRAP, else ALLOW
+   *   would race the single IPC channel)
+   *   25..28 read:  ipc->ALLOW, stdin->TRAP, vfd->TRAP, else ALLOW
+   *   29..32 write: ipc->ALLOW, fd<3->TRAP, vfd->TRAP, else ALLOW
+   *   33..34 vfd-check (close/ioctl/fcntl): vfd->TRAP, else ALLOW
    */
   struct sock_filter prog[] = {
       /* [0] */ LD(BPF_ARCHF),
-      /* [1] */ JEQ(AUDIT_ARCH_X86_64, 0, 25),          /* !x86-64 -> ALLOW */
+      /* [1] */ JEQ(AUDIT_ARCH_X86_64, 0, 34),          /* !x86-64 -> ALLOW */
       /* [2] */ LD(BPF_NR),
-      /* [3] */ JEQ(SYS_read, 12, 0),                   /* -> 16            */
-      /* [4] */ JEQ(SYS_write, 15, 0),                  /* -> 20            */
-      /* [5] */ JEQ(SYS_close, 18, 0),                  /* -> 24            */
-      /* [6] */ JEQ(SYS_nanosleep, 19, 0),              /* -> TRAP          */
-      /* [7] */ JEQ(SYS_clock_nanosleep, 18, 0),
-      /* [8] */ JEQ(SYS_clock_gettime, 17, 0),
-      /* [9] */ JEQ(SYS_gettimeofday, 16, 0),
-      /* [10] */ JEQ(SYS_time, 15, 0),
-      /* [11] */ JEQ(SYS_getrandom, 14, 0),
-      /* [12] */ JEQ(435 /* clone3 */, 13, 0),
-      /* [13] */ JEQ(288 /* accept4 */, 12, 0),
-      /* [14] */ JGE(SYS_socket, 0, 12),                /* nr<41 -> ALLOW   */
-      /* [15] */ JGE(60, 11, 10),                       /* 41..59 -> TRAP   */
+      /* [3] */ JEQ(SYS_read, 21, 0),                   /* -> 25            */
+      /* [4] */ JEQ(SYS_write, 24, 0),                  /* -> 29            */
+      /* [5] */ JEQ(SYS_close, 27, 0),                  /* -> 33            */
+      /* [6] */ JEQ(16 /* ioctl */, 26, 0),             /* -> 33            */
+      /* [7] */ JEQ(72 /* fcntl */, 25, 0),             /* -> 33            */
+      /* [8] */ JEQ(SYS_nanosleep, 26, 0),              /* -> TRAP          */
+      /* [9] */ JEQ(SYS_clock_nanosleep, 25, 0),
+      /* [10] */ JEQ(SYS_clock_gettime, 24, 0),
+      /* [11] */ JEQ(SYS_gettimeofday, 23, 0),
+      /* [12] */ JEQ(SYS_time, 22, 0),
+      /* [13] */ JEQ(SYS_getrandom, 21, 0),
+      /* [14] */ JEQ(7 /* poll */, 20, 0),
+      /* [15] */ JEQ(271 /* ppoll */, 19, 0),
+      /* [16] */ JEQ(213 /* epoll_create */, 18, 0),
+      /* [17] */ JEQ(291 /* epoll_create1 */, 17, 0),
+      /* [18] */ JEQ(233 /* epoll_ctl */, 16, 0),
+      /* [19] */ JEQ(232 /* epoll_wait */, 15, 0),
+      /* [20] */ JEQ(281 /* epoll_pwait */, 14, 0),
+      /* [21] */ JEQ(288 /* accept4 */, 13, 0),
+      /* [22] */ JEQ(435 /* clone3 */, 12, 0),
+      /* [23] */ JGE(SYS_socket, 0, 12),                /* nr<41 -> ALLOW   */
+      /* [24] */ JGE(60, 11, 10),                       /* 41..59 -> TRAP   */
       /* read */
-      /* [16] */ LD(BPF_ARG0),
-      /* [17] */ JEQ(SHIM_IPC_FD, 9, 0),                /* -> ALLOW         */
-      /* [18] */ JEQ(0, 7, 0),                          /* stdin -> TRAP    */
-      /* [19] */ JGE(SHIM_VFD_BASE, 6, 7),              /* vfd->TRAP/ALLOW  */
+      /* [25] */ LD(BPF_ARG0),
+      /* [26] */ JEQ(SHIM_IPC_FD, 9, 0),                /* -> ALLOW         */
+      /* [27] */ JEQ(0, 7, 0),                          /* stdin -> TRAP    */
+      /* [28] */ JGE(SHIM_VFD_BASE, 6, 7),              /* vfd->TRAP/ALLOW  */
       /* write */
-      /* [20] */ LD(BPF_ARG0),
-      /* [21] */ JEQ(SHIM_IPC_FD, 5, 0),                /* -> ALLOW         */
-      /* [22] */ JGE(3, 0, 3),                          /* fd<3 -> TRAP     */
-      /* [23] */ JGE(SHIM_VFD_BASE, 2, 3),              /* vfd->TRAP/ALLOW  */
-      /* close */
-      /* [24] */ LD(BPF_ARG0),
-      /* [25] */ JGE(SHIM_VFD_BASE, 0, 1),              /* vfd->TRAP/ALLOW  */
-      /* [26] */ RET(SECCOMP_RET_TRAP),
-      /* [27] */ RET(SECCOMP_RET_ALLOW),
+      /* [29] */ LD(BPF_ARG0),
+      /* [30] */ JEQ(SHIM_IPC_FD, 5, 0),                /* -> ALLOW         */
+      /* [31] */ JGE(3, 0, 3),                          /* fd<3 -> TRAP     */
+      /* [32] */ JGE(SHIM_VFD_BASE, 2, 3),              /* vfd->TRAP/ALLOW  */
+      /* close/ioctl/fcntl */
+      /* [33] */ LD(BPF_ARG0),
+      /* [34] */ JGE(SHIM_VFD_BASE, 0, 1),              /* vfd->TRAP/ALLOW  */
+      /* [35] */ RET(SECCOMP_RET_TRAP),
+      /* [36] */ RET(SECCOMP_RET_ALLOW),
   };
   struct sock_fprog fprog = {sizeof(prog) / sizeof(prog[0]), prog};
   if (prctl(PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0) != 0) return -1;

@@ -1,0 +1,150 @@
+/* mux_srv — an event-loop TCP server (poll or epoll), the I/O-multiplexing
+ * test program. Nonblocking listener + connections; serves tgen-format
+ * requests (8-byte decimal count -> counted bytes back) to many clients
+ * CONCURRENTLY — the interleaving proves readiness notification works.
+ *
+ *   usage: mux_srv <port> <nconns> <poll|epoll>
+ */
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#define MAXC 64
+
+struct conn {
+  int fd;
+  long want, sent;
+  int got_req;
+  char req[8];
+  int reqn;
+};
+
+static struct conn conns[MAXC];
+static int nconn;
+static char buf[32768];
+
+static void set_nonblock(int fd) {
+  fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+}
+
+int main(int argc, char **argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s <port> <nconns> <poll|epoll>\n", argv[0]);
+    return 2;
+  }
+  int total = atoi(argv[2]);
+  if (total > MAXC) {
+    fprintf(stderr, "nconns > %d unsupported\n", MAXC);
+    return 2;
+  }
+  int use_epoll = strcmp(argv[3], "epoll") == 0;
+  memset(buf, 'y', sizeof buf);
+
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof addr);
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((unsigned short)atoi(argv[1]));
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (bind(srv, (struct sockaddr *)&addr, sizeof addr) != 0 ||
+      listen(srv, 16) != 0) {
+    perror("bind/listen");
+    return 1;
+  }
+  set_nonblock(srv);
+
+  int epfd = -1;
+  if (use_epoll) {
+    epfd = epoll_create1(0);
+    struct epoll_event ev = {EPOLLIN, {.u64 = (unsigned long)-1}};
+    epoll_ctl(epfd, EPOLL_CTL_ADD, srv, &ev);
+  }
+
+  int done = 0, accepted = 0;
+  long total_bytes = 0;
+  while (done < total) {
+    /* build interest sets */
+    if (!use_epoll) {
+      struct pollfd pfds[MAXC + 1];
+      int n = 0;
+      pfds[n].fd = srv;
+      pfds[n].events = accepted < total ? POLLIN : 0;
+      n++;
+      for (int i = 0; i < nconn; i++) {
+        if (conns[i].fd < 0) continue;
+        pfds[n].fd = conns[i].fd;
+        pfds[n].events = conns[i].got_req ? POLLOUT : POLLIN;
+        n++;
+      }
+      if (poll(pfds, n, 5000) < 0) { perror("poll"); return 1; }
+    } else {
+      struct epoll_event evs[MAXC];
+      if (epoll_wait(epfd, evs, MAXC, 5000) < 0) { perror("epoll"); return 1; }
+    }
+    /* accept */
+    for (;;) {
+      int fd = accept(srv, NULL, NULL);
+      if (fd < 0) break;
+      set_nonblock(fd);
+      conns[nconn].fd = fd;
+      conns[nconn].want = -1;
+      if (use_epoll) {
+        /* EPOLLIN only until we have something to write: registering
+         * EPOLLOUT on an idle writable socket would make epoll_wait
+         * level-trigger forever (a busy-loop under any kernel — and a
+         * sim-time livelock under the simulator) */
+        struct epoll_event ev = {EPOLLIN, {.u64 = (unsigned)nconn}};
+        epoll_ctl(epfd, EPOLL_CTL_ADD, fd, &ev);
+      }
+      nconn++;
+      accepted++;
+    }
+    /* service every connection that is ready (level-triggered) */
+    for (int i = 0; i < nconn; i++) {
+      struct conn *c = &conns[i];
+      if (c->fd < 0) continue;
+      if (!c->got_req) {
+        long n = recv(c->fd, c->req + c->reqn, 8 - c->reqn, 0);
+        if (n > 0) c->reqn += (int)n;
+        if (c->reqn == 8) {
+          char tmp[9];
+          memcpy(tmp, c->req, 8);
+          tmp[8] = 0;
+          c->want = atol(tmp);
+          c->got_req = 1;
+          if (use_epoll) {
+            struct epoll_event ev = {EPOLLOUT, {.u64 = (unsigned)i}};
+            epoll_ctl(epfd, EPOLL_CTL_MOD, c->fd, &ev);
+          }
+        }
+      }
+      if (c->got_req && c->sent < c->want) {
+        long k = c->want - c->sent;
+        if (k > (long)sizeof buf) k = sizeof buf;
+        long n = send(c->fd, buf, k, 0);
+        if (n > 0) {
+          c->sent += n;
+          total_bytes += n;
+        }
+      }
+      if (c->got_req && c->sent >= c->want) {
+        if (use_epoll) epoll_ctl(epfd, EPOLL_CTL_DEL, c->fd, NULL);
+        close(c->fd);
+        c->fd = -1;
+        done++;
+      }
+    }
+  }
+  printf("served=%d bytes=%ld mode=%s\n", done, total_bytes, argv[3]);
+  return 0;
+}

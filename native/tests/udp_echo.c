@@ -1,0 +1,50 @@
+/* udp_echo — UDP datagram client test program: sends <count> datagrams to
+ * an echo server and verifies each reply round-trips.
+ *
+ *   usage: udp_echo <ip> <port> <count>
+ */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+
+int main(int argc, char **argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s <ip> <port> <count>\n", argv[0]);
+    return 2;
+  }
+  int count = atoi(argv[3]);
+  int fd = socket(AF_INET, SOCK_DGRAM, 0);
+  if (fd < 0) { perror("socket"); return 1; }
+  struct sockaddr_in dst;
+  memset(&dst, 0, sizeof dst);
+  dst.sin_family = AF_INET;
+  dst.sin_port = htons((unsigned short)atoi(argv[2]));
+  inet_pton(AF_INET, argv[1], &dst.sin_addr);
+
+  for (int i = 0; i < count; i++) {
+    char msg[64], reply[64];
+    struct timespec t0, t1;
+    clock_gettime(CLOCK_REALTIME, &t0);
+    int n = snprintf(msg, sizeof msg, "ping-%d", i);
+    if (sendto(fd, msg, n, 0, (struct sockaddr *)&dst, sizeof dst) != n) {
+      perror("sendto");
+      return 1;
+    }
+    struct sockaddr_in src;
+    socklen_t slen = sizeof src;
+    long r = recvfrom(fd, reply, sizeof reply, 0, (struct sockaddr *)&src, &slen);
+    if (r != n || memcmp(msg, reply, n) != 0) {
+      fprintf(stderr, "bad echo %d: %ld\n", i, r);
+      return 1;
+    }
+    clock_gettime(CLOCK_REALTIME, &t1);
+    long ms = (t1.tv_sec - t0.tv_sec) * 1000 + (t1.tv_nsec - t0.tv_nsec) / 1000000;
+    printf("echo %d rtt_ms=%ld\n", i, ms);
+  }
+  printf("ok count=%d\n", count);
+  return 0;
+}

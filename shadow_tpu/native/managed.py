@@ -55,6 +55,17 @@ SYS_gettimeofday, SYS_time = 96, 201
 SYS_clock_gettime, SYS_clock_nanosleep = 228, 230
 SYS_getrandom = 318
 SYS_accept4 = 288
+SYS_poll, SYS_ppoll = 7, 271
+SYS_ioctl, SYS_fcntl = 16, 72
+SYS_epoll_create, SYS_epoll_create1 = 213, 291
+SYS_epoll_ctl, SYS_epoll_wait, SYS_epoll_pwait = 233, 232, 281
+
+POLLIN, POLLOUT, POLLERR, POLLHUP = 0x001, 0x004, 0x008, 0x010
+EPOLL_CTL_ADD, EPOLL_CTL_DEL, EPOLL_CTL_MOD = 1, 2, 3
+EPOLLIN, EPOLLOUT, EPOLLERR, EPOLLHUP = 0x001, 0x004, 0x008, 0x010
+F_GETFD, F_SETFD, F_GETFL, F_SETFL = 1, 2, 3, 4
+O_NONBLOCK = 0o4000
+FIONREAD, FIONBIO = 0x541B, 0x5421
 SYS_clone, SYS_fork, SYS_vfork, SYS_execve, SYS_clone3 = 56, 57, 58, 59, 435
 
 EPERM, EBADF, EAGAIN, EFAULT, EINVAL, EPIPE = 1, 9, 11, 14, 22, 32
@@ -107,20 +118,28 @@ def _shim_lib() -> Path:
 
 
 class VSocket:
-    """One virtual descriptor: a simulated stream socket (or listener)."""
+    """One virtual descriptor: a simulated socket (stream, listener,
+    datagram) or an epoll instance."""
 
-    __slots__ = ("vfd", "endpoint", "rxbuf", "peer_closed", "connected",
-                 "bound_port", "listening", "accept_q")
+    __slots__ = ("vfd", "kind", "endpoint", "rxbuf", "peer_closed",
+                 "connected", "connect_err", "bound_port", "listening",
+                 "accept_q", "nonblock", "dgram_q", "udp", "interest")
 
-    def __init__(self, vfd: int) -> None:
+    def __init__(self, vfd: int, kind: str = "stream") -> None:
         self.vfd = vfd
+        self.kind = kind  # stream | dgram | epoll
         self.endpoint = None
         self.rxbuf = bytearray()
         self.peer_closed = False
         self.connected = False
+        self.connect_err = 0
         self.bound_port = 0
         self.listening = False
         self.accept_q: list = []  # pre-wired VSockets awaiting accept()
+        self.nonblock = False
+        self.dgram_q: list = []  # (payload bytes|b"", nbytes, src, sport)
+        self.udp = None  # DatagramSocket when bound
+        self.interest: dict = {}  # epoll: vfd -> (events, userdata)
 
 
 class ManagedProcess(ProcessLifecycle):
@@ -149,6 +168,8 @@ class ManagedProcess(ProcessLifecycle):
         self._strace = None  # open file when strace_logging_mode != off
         gen = host.controller.cfg.general
         self._syscall_latency = 1000 if gen.model_unblocked_syscall_latency else 0
+        self._spin_t = -1  # busy-loop detector: syscalls at one sim instant
+        self._spin_n = 0
 
     # -- lifecycle ---------------------------------------------------------
     def spawn(self) -> None:
@@ -279,6 +300,22 @@ class ManagedProcess(ProcessLifecycle):
                 self._trace(nr, args, "<blocked>")
                 return
             self._trace(nr, args, ret)
+            if self._syscall_latency == 0:
+                # livelock detector: a guest spinning on nonblocking
+                # syscalls at a frozen sim instant (e.g. sloppy epoll
+                # usage) would hang the simulation silently
+                if self.host.now != self._spin_t:
+                    self._spin_t, self._spin_n = self.host.now, 0
+                self._spin_n += 1
+                if self._spin_n == 200_000:
+                    import sys as _sys
+
+                    print(
+                        f"shadow_tpu: {self.host.name}/{self.name} has made "
+                        f"200000 syscalls without sim time advancing — guest "
+                        f"busy-loop? Set general."
+                        f"model_unblocked_syscall_latency: true to break it",
+                        file=_sys.stderr)
             if self._syscall_latency:
                 # model_unblocked_syscall_latency: each serviced syscall
                 # advances this host's clock slightly, so busy-loops spin
@@ -392,17 +429,28 @@ class ManagedProcess(ProcessLifecycle):
             return n
         if nr == SYS_socket:
             domain, typ = args[0], args[1] & 0xFF
-            if domain != socket.AF_INET or typ != socket.SOCK_STREAM:
+            if domain != socket.AF_INET or typ not in (socket.SOCK_STREAM,
+                                                       socket.SOCK_DGRAM):
                 return -EAFNOSUPPORT
             vfd = self._next_vfd
             self._next_vfd += 1
-            self.fds[vfd] = VSocket(vfd)
+            kind = "stream" if typ == socket.SOCK_STREAM else "dgram"
+            vs = VSocket(vfd, kind)
+            if args[1] & 0o4000:  # SOCK_NONBLOCK
+                vs.nonblock = True
+            self.fds[vfd] = vs
             return vfd
         if nr == SYS_connect:
             return self._connect(args[0], args[1], args[2])
         if nr == SYS_sendto:
+            vs = self.fds.get(args[0])
+            if vs is not None and vs.kind == "dgram":
+                return self._dgram_sendto(vs, args)
             return self._vfd_send(args[0], args[1], args[2])
         if nr == SYS_recvfrom:
+            vs = self.fds.get(args[0])
+            if vs is not None and vs.kind == "dgram":
+                return self._dgram_recvfrom(vs, args)
             return self._vfd_recv(args[0], args[1], args[2])
         if nr == SYS_shutdown:
             vs = self.fds.get(args[0])
@@ -414,9 +462,15 @@ class ManagedProcess(ProcessLifecycle):
         if nr in (SYS_setsockopt,):
             return 0
         if nr == SYS_getsockopt:
-            # SO_ERROR et al: report "no error", optval = 0
+            vs = self.fds.get(args[0])
+            if vs is None:
+                return -EBADF
+            err = 0
+            if args[1] == 1 and args[2] == 4:  # SOL_SOCKET, SO_ERROR
+                err = vs.connect_err
+                vs.connect_err = 0  # SO_ERROR reads clear the error
             if args[3] and args[4]:
-                self.mem.write(args[3], b"\0\0\0\0")
+                self.mem.write(args[3], struct.pack("<i", err))
                 self.mem.write(args[4], struct.pack("<i", 4))
             return 0
         if nr in (SYS_getsockname, SYS_getpeername):
@@ -438,11 +492,49 @@ class ManagedProcess(ProcessLifecycle):
                 return -EBADF
             raw = self.mem.read(args[1], min(max(args[2], 16), 128))
             vs.bound_port = struct.unpack_from(">H", raw, 2)[0]
+            if vs.kind == "dgram":
+                return self._dgram_bind(vs)
             return 0
         if nr == SYS_listen:
             return self._listen(args[0])
         if nr in (SYS_accept, SYS_accept4):
             return self._accept(args[0], args[1], args[2])
+        if nr in (SYS_poll, SYS_ppoll):
+            return self._poll(args[0], args[1], args[2], nr == SYS_ppoll)
+        if nr in (SYS_epoll_create, SYS_epoll_create1):
+            vfd = self._next_vfd
+            self._next_vfd += 1
+            self.fds[vfd] = VSocket(vfd, "epoll")
+            return vfd
+        if nr == SYS_epoll_ctl:
+            return self._epoll_ctl(args[0], args[1], args[2], args[3])
+        if nr in (SYS_epoll_wait, SYS_epoll_pwait):
+            return self._epoll_wait(args[0], args[1], args[2], args[3])
+        if nr == SYS_fcntl:
+            vs = self.fds.get(args[0])
+            if vs is None:
+                return -EBADF
+            cmd = args[1]
+            if cmd == F_GETFL:
+                return 0o2 | (O_NONBLOCK if vs.nonblock else 0)  # O_RDWR
+            if cmd == F_SETFL:
+                vs.nonblock = bool(args[2] & O_NONBLOCK)
+                return 0
+            return 0  # F_GETFD/F_SETFD/etc: benign
+        if nr == SYS_ioctl:
+            vs = self.fds.get(args[0])
+            if vs is None:
+                return -EBADF
+            if args[1] == FIONBIO:
+                flag = struct.unpack("<i", self.mem.read(args[2], 4))[0]
+                vs.nonblock = bool(flag)
+                return 0
+            if args[1] == FIONREAD:
+                avail = (len(vs.rxbuf) if vs.kind == "stream"
+                         else (vs.dgram_q[0][1] if vs.dgram_q else 0))
+                self.mem.write(args[2], struct.pack("<i", avail))
+                return 0
+            return 0
         if nr in (SYS_sendmsg, SYS_recvmsg):
             return -ENOSYS  # scatter-gather io: not yet
         if nr in (SYS_clone, SYS_fork, SYS_vfork, SYS_execve, SYS_clone3):
@@ -451,12 +543,104 @@ class ManagedProcess(ProcessLifecycle):
             return -ENOSYS
         return -ENOSYS
 
+    # -- readiness (poll/epoll) --------------------------------------------
+    def _readable(self, vs: VSocket) -> bool:
+        if vs.kind == "dgram":
+            return bool(vs.dgram_q)
+        if vs.listening:
+            return bool(vs.accept_q)
+        return bool(vs.rxbuf) or vs.peer_closed
+
+    def _writable(self, vs: VSocket) -> bool:
+        if vs.kind == "dgram":
+            return True
+        ep = vs.endpoint
+        if ep is None or not vs.connected or vs.peer_closed:
+            return bool(vs.connect_err)  # error state is "writable" (POLLERR)
+        return ep.sender.buffered < ep.sender.send_buffer
+
+    def _revents(self, vs: VSocket, want: int) -> int:
+        r = 0
+        if want & POLLIN and self._readable(vs):
+            r |= POLLIN
+        if want & POLLOUT and self._writable(vs):
+            r |= POLLOUT
+        if vs.peer_closed:
+            r |= POLLHUP
+        if vs.connect_err:
+            r |= POLLERR
+        return r
+
+    def _notify(self) -> None:
+        """Some vfd's state changed: re-evaluate a parked poll/epoll wait."""
+        w = self._waiting
+        if not w:
+            return
+        if w[0] == "poll":
+            n = self._poll_scan(w[2], w[3])
+            if n:
+                self._resume(n)
+        elif w[0] == "epoll":
+            n = self._epoll_scan(w[2], w[3], w[4])
+            if n:
+                self._resume(n)
+
+    def _poll_scan(self, entries, fds_ptr) -> int:
+        """Write revents for ready entries; returns the ready count."""
+        n = 0
+        for i, (fd, want) in enumerate(entries):
+            if fd < 0:  # poll(2): negative fds are ignored, revents = 0
+                r = 0
+            else:
+                vs = self.fds.get(fd)
+                r = (self._revents(vs, want) if vs is not None
+                     else 0x20)  # POLLNVAL
+            if r:
+                n += 1
+            self.mem.write(fds_ptr + 8 * i + 6, struct.pack("<h", r))
+        return n
+
+    def _epoll_scan(self, ep_vs: VSocket, events_ptr: int, maxev: int) -> int:
+        n = 0
+        for fd, (want, data) in list(ep_vs.interest.items()):
+            vs = self.fds.get(fd)
+            if vs is None:
+                continue
+            r = self._revents(vs, want)
+            if r and n < maxev:
+                self.mem.write(events_ptr + 12 * n,
+                               struct.pack("<I", r) + struct.pack("<Q", data))
+                n += 1
+        return n
+
+    def _arm_wait_timeout(self, timeout_ns: int):
+        token = object()
+        if timeout_ns >= 0:
+            def fire():
+                w = self._waiting
+                if w and len(w) > 1 and w[1] is token:
+                    self._resume(0)
+
+            self.host.schedule_in(timeout_ns, fire)
+        return token
+
     # -- socket bridge -----------------------------------------------------
     def _wire_endpoint(self, vs: VSocket, ep) -> None:
         vs.endpoint = ep
         ep.on_data = lambda n, payload, now: self._on_net_data(vs, n, payload)
         ep.on_close = lambda now: self._on_net_close(vs)
         ep.on_error = lambda msg: self._on_net_error(vs)
+        ep.on_drain = lambda room: self._on_drain(vs)
+
+    def _on_drain(self, vs: VSocket) -> None:
+        w = self._waiting
+        if w and w[0] == "send" and w[1] is vs:
+            data = self.mem.read(w[2], min(w[3], 1 << 20))
+            accepted = vs.endpoint.send(payload=data)
+            if accepted > 0:
+                self._resume(accepted)
+            return
+        self._notify()
 
     def _listen(self, fd: int):
         vs = self.fds.get(fd)
@@ -478,6 +662,7 @@ class ManagedProcess(ProcessLifecycle):
                 self._finish_accept(vs, conn, w[2], w[3])
             else:
                 vs.accept_q.append(conn)
+                self._notify()
 
         try:
             self.host.listen(vs.bound_port, on_accept)
@@ -494,6 +679,8 @@ class ManagedProcess(ProcessLifecycle):
             return -EINVAL
         if vs.accept_q:
             return self._do_accept(vs, vs.accept_q.pop(0), addr, addrlen)
+        if vs.nonblock:
+            return -EAGAIN
         self._waiting = ("accept", vs, addr, addrlen)
         return _BLOCK
 
@@ -518,6 +705,10 @@ class ManagedProcess(ProcessLifecycle):
         vs = self.fds.get(fd)
         if vs is None:
             return -EBADF
+        if vs.endpoint is not None:  # the re-connect completion idiom
+            if vs.connect_err:
+                return -vs.connect_err
+            return -106 if vs.connected else -114  # EISCONN / EALREADY
         raw = self.mem.read(addr, min(max(addrlen, 16), 128))
         family = struct.unpack_from("<H", raw, 0)[0]
         if family != socket.AF_INET:
@@ -531,6 +722,9 @@ class ManagedProcess(ProcessLifecycle):
         ep = self.host.connect(peer, port)
         self._wire_endpoint(vs, ep)
         ep.on_connected = lambda now: self._on_connected(vs)
+        if vs.nonblock:
+            ep.connect()
+            return -115  # EINPROGRESS; completion via POLLOUT + SO_ERROR
         self._waiting = ("connect", vs)
         ep.connect()
         return _BLOCK
@@ -539,6 +733,8 @@ class ManagedProcess(ProcessLifecycle):
         vs.connected = True
         if self._waiting and self._waiting[0] == "connect" and self._waiting[1] is vs:
             self._resume(0)
+            return
+        self._notify()
 
     def _on_net_data(self, vs: VSocket, n: int, payload) -> None:
         vs.rxbuf += payload if payload is not None else b"\0" * n
@@ -546,19 +742,26 @@ class ManagedProcess(ProcessLifecycle):
         if w and w[0] == "recv" and w[1] is vs:
             _, _, bufaddr, buflen = w
             self._fulfill_recv(vs, bufaddr, buflen)
+            return
+        self._notify()
 
     def _on_net_close(self, vs: VSocket) -> None:
         vs.peer_closed = True
         w = self._waiting
         if w and w[0] == "recv" and w[1] is vs and not vs.rxbuf:
             self._resume(0)
+            return
+        self._notify()
 
     def _on_net_error(self, vs: VSocket) -> None:
+        vs.connect_err = ETIMEDOUT if not vs.connected else ECONNRESET
         w = self._waiting
         if w and w[0] == "connect" and w[1] is vs:
             self._resume(-ETIMEDOUT)
         elif w and w[0] in ("recv", "send") and w[1] is vs:
             self._resume(-ECONNRESET)
+        else:
+            self._notify()
 
     def _vfd_send(self, fd: int, addr: int, n: int):
         vs = self.fds.get(fd)
@@ -572,19 +775,11 @@ class ManagedProcess(ProcessLifecycle):
         accepted = vs.endpoint.send(payload=data)
         if accepted > 0:
             return accepted
-        # send buffer full: park until acks drain it
-        self._waiting = ("send", vs)
-        vs.endpoint.on_drain = lambda room: self._retry_send(vs, addr, n)
+        if vs.nonblock:
+            return -EAGAIN
+        # send buffer full: park until acks drain it (_on_drain resumes)
+        self._waiting = ("send", vs, addr, n)
         return _BLOCK
-
-    def _retry_send(self, vs: VSocket, addr: int, n: int) -> None:
-        if not (self._waiting and self._waiting[0] == "send" and self._waiting[1] is vs):
-            return
-        data = self.mem.read(addr, min(n, 1 << 20))
-        accepted = vs.endpoint.send(payload=data)
-        if accepted > 0:
-            vs.endpoint.on_drain = None
-            self._resume(accepted)
 
     def _vfd_recv(self, fd: int, bufaddr: int, buflen: int):
         vs = self.fds.get(fd)
@@ -596,6 +791,8 @@ class ManagedProcess(ProcessLifecycle):
             return self._take_rx(vs, bufaddr, buflen)
         if vs.peer_closed:
             return 0
+        if vs.nonblock:
+            return -EAGAIN
         self._waiting = ("recv", vs, bufaddr, buflen)
         return _BLOCK
 
@@ -606,6 +803,126 @@ class ManagedProcess(ProcessLifecycle):
         k = min(len(vs.rxbuf), buflen)
         self.mem.write(bufaddr, bytes(vs.rxbuf[:k]))
         del vs.rxbuf[:k]
+        return k
+
+    # -- poll / epoll -------------------------------------------------------
+    def _poll(self, fds_ptr: int, nfds: int, timeout, is_ppoll: bool):
+        nfds = min(nfds, 1024)
+        raw = self.mem.read(fds_ptr, 8 * nfds)
+        entries = []
+        for i in range(nfds):
+            fd = struct.unpack_from("<i", raw, 8 * i)[0]
+            want = struct.unpack_from("<h", raw, 8 * i + 4)[0]
+            entries.append((fd, want))
+        n = self._poll_scan(entries, fds_ptr)
+        if n:
+            return n
+        if is_ppoll:  # timeout is a timespec pointer (NULL = infinite)
+            if timeout == 0:
+                timeout_ns = -1
+            else:
+                sec, nsec = struct.unpack("<qq", self.mem.read(timeout, 16))
+                timeout_ns = sec * NS_PER_SEC + nsec
+        else:  # poll: signed ms (negative = infinite)
+            tmo = timeout if timeout < (1 << 63) else timeout - (1 << 64)
+            timeout_ns = -1 if tmo < 0 else int(tmo) * 1_000_000
+        if timeout_ns == 0:
+            return 0
+        token = self._arm_wait_timeout(timeout_ns)
+        self._waiting = ("poll", token, entries, fds_ptr)
+        return _BLOCK
+
+    def _epoll_ctl(self, epfd: int, op: int, fd: int, event_ptr: int):
+        ep_vs = self.fds.get(epfd)
+        if ep_vs is None or ep_vs.kind != "epoll":
+            return -EBADF
+        if op == EPOLL_CTL_DEL:
+            ep_vs.interest.pop(fd, None)
+            return 0
+        if fd not in self.fds:
+            # real (non-virtual) fds can't be multiplexed by the simulated
+            # epoll — fail loudly instead of silently never firing
+            return -EPERM
+        raw = self.mem.read(event_ptr, 12)
+        events = struct.unpack_from("<I", raw, 0)[0]
+        data = struct.unpack_from("<Q", raw, 4)[0]
+        ep_vs.interest[fd] = (events, data)
+        return 0
+
+    def _epoll_wait(self, epfd: int, events_ptr: int, maxev: int, timeout):
+        ep_vs = self.fds.get(epfd)
+        if ep_vs is None or ep_vs.kind != "epoll":
+            return -EBADF
+        n = self._epoll_scan(ep_vs, events_ptr, maxev)
+        if n:
+            return n
+        tmo = timeout if timeout < (1 << 63) else timeout - (1 << 64)
+        if tmo == 0:
+            return 0
+        timeout_ns = -1 if tmo < 0 else int(tmo) * 1_000_000
+        token = self._arm_wait_timeout(timeout_ns)
+        self._waiting = ("epoll", token, ep_vs, events_ptr, maxev)
+        return _BLOCK
+
+    # -- datagram bridge ----------------------------------------------------
+    def _dgram_bind(self, vs: VSocket):
+        try:
+            sock = self.host.udp_socket(vs.bound_port or None)
+        except ValueError:
+            return -98  # EADDRINUSE
+        vs.udp = sock
+        vs.bound_port = sock.local_port
+
+        def on_datagram(nbytes, payload, src_addr, now):
+            vs.dgram_q.append((payload, nbytes, src_addr[0], src_addr[1]))
+            w = self._waiting
+            if w and w[0] == "drecv" and w[1] is vs:
+                self._resume(self._dgram_take(vs, w[2], w[3], w[4], w[5]))
+            else:
+                self._notify()
+
+        sock.on_datagram = on_datagram
+        return 0
+
+    def _dgram_sendto(self, vs: VSocket, args):
+        if vs.udp is None:
+            r = self._dgram_bind(vs)  # auto-bind an ephemeral port
+            if r != 0:
+                return r
+        raw = self.mem.read(args[4], min(max(args[5], 16), 128))
+        port = struct.unpack_from(">H", raw, 2)[0]
+        ip = socket.inet_ntoa(raw[4:8])
+        try:
+            peer = self.host.controller.resolve(ip)
+        except KeyError:
+            return -ENETUNREACH
+        n = min(args[2], 1 << 16)
+        data = self.mem.read(args[1], n)
+        vs.udp.sendto(peer, port, payload=data)
+        return n
+
+    def _dgram_recvfrom(self, vs: VSocket, args):
+        if vs.udp is None:
+            return -ENOTCONN
+        if vs.dgram_q:
+            return self._dgram_take(vs, args[1], args[2], args[4], args[5])
+        if vs.nonblock:
+            return -EAGAIN
+        self._waiting = ("drecv", vs, args[1], args[2], args[4], args[5])
+        return _BLOCK
+
+    def _dgram_take(self, vs: VSocket, buf: int, buflen: int,
+                    src_ptr: int, srclen_ptr: int) -> int:
+        payload, nbytes, src, sport = vs.dgram_q.pop(0)
+        data = payload if payload is not None else b"\0" * nbytes
+        k = min(len(data), buflen)
+        self.mem.write(buf, data[:k])
+        if src_ptr and srclen_ptr:
+            ip = self.host.controller.hosts[src].ip
+            sa = (struct.pack("<H", socket.AF_INET) + struct.pack(">H", sport)
+                  + socket.inet_aton(ip) + b"\0" * 8)
+            self.mem.write(src_ptr, sa)
+            self.mem.write(srclen_ptr, struct.pack("<i", len(sa)))
         return k
 
     # -- stdio capture -----------------------------------------------------
