@@ -42,6 +42,8 @@ from shadow_tpu.utils.logging import SimLogger
 from shadow_tpu.utils.units import parse_bandwidth
 
 DEFAULT_BANDWIDTH = parse_bandwidth("1 Gbit")
+#: rounds between explicit gc.collect() calls while auto-GC is suspended
+_GC_EVERY_ROUNDS = 5000
 
 
 class Controller:
@@ -179,6 +181,16 @@ class Controller:
         next_hb = hb_interval if hb_interval else T_NEVER
         prog_step = max(stop // 100, 1)
         next_prog = prog_step if cfg.general.progress else T_NEVER
+        # the round loop allocates millions of short-lived objects (units,
+        # arrival closures, heap entries); generational GC scanning them
+        # costs ~40% of wall at 10k-host scale (measured, gossip config).
+        # Collect at fixed round intervals instead — reference cycles (e.g.
+        # endpoint<->sender) from closed connections still get reclaimed.
+        import gc as _gc
+
+        gc_was_enabled = _gc.isenabled()
+        _gc.disable()
+        next_gc = _GC_EVERY_ROUNDS
         t0 = _walltime.perf_counter()
         now: SimTime = 0
         while now < stop:
@@ -194,6 +206,9 @@ class Controller:
             if round_end >= next_prog:
                 self._progress(round_end, stop, t0)
                 next_prog = round_end + prog_step
+            if self.rounds >= next_gc:
+                next_gc = self.rounds + _GC_EVERY_ROUNDS
+                _gc.collect()
             if executed == 0 and not self.engine.has_immediate_work():
                 # provably idle: materialize any in-flight draw batch that
                 # could produce an event before the next queued one, then
@@ -216,6 +231,9 @@ class Controller:
                 now = max(round_end, nt)
             else:
                 now = round_end
+        if gc_was_enabled:
+            _gc.enable()
+        _gc.collect()
         self.engine.flush_all()  # finalize counters for in-flight batches
         if cfg.general.progress:
             import sys as _sys

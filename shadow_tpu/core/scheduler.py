@@ -32,6 +32,21 @@ from typing import Sequence
 from shadow_tpu.core.time import SimTime
 
 
+def _run_hosts(hosts, round_end: SimTime) -> int:
+    """Run one round for a set of hosts. The inline heap peek matters: at
+    10k+ hosts most queues are empty most rounds, and a Python call into
+    run_events per (host, round) costs more than the whole round's real
+    work (measured: ~30% of the gossip-10k wall). A cancelled head with an
+    earlier timestamp makes the peek conservatively true — run_events then
+    discards it correctly."""
+    n = 0
+    for h in hosts:
+        heap = h.equeue._heap
+        if heap and heap[0][0] < round_end:
+            n += h.run_events(round_end)
+    return n
+
+
 class SerialScheduler:
     """Hosts executed in host-id order on the calling thread."""
 
@@ -41,10 +56,7 @@ class SerialScheduler:
         self.hosts = hosts
 
     def run_round(self, round_end: SimTime) -> int:
-        n = 0
-        for h in self.hosts:
-            n += h.run_events(round_end)
-        return n
+        return _run_hosts(self.hosts, round_end)
 
     def shutdown(self) -> None:
         pass
@@ -67,10 +79,7 @@ class ThreadPerCoreScheduler:
         self.shards = [list(hosts[i :: self.nthreads]) for i in range(self.nthreads)]
 
     def _run_shard(self, shard, round_end: SimTime) -> int:
-        n = 0
-        for h in shard:
-            n += h.run_events(round_end)
-        return n
+        return _run_hosts(shard, round_end)
 
     def run_round(self, round_end: SimTime) -> int:
         futs = [
@@ -113,7 +122,7 @@ class ThreadPerHostScheduler:
             if self._stop:
                 return
             try:
-                self._counts[i] = self.hosts[i].run_events(self._round_end)
+                self._counts[i] = _run_hosts((self.hosts[i],), self._round_end)
             except BaseException as exc:  # propagate instead of hanging
                 self._errors[i] = exc
             self._done[i].set()
