@@ -151,62 +151,55 @@ time_t time(time_t *out) {
 #define JGE(v, t, f) BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, (v), (t), (f))
 
 static int install_seccomp(void) {
-  /* layout (jump targets are relative to the NEXT instruction):
-   *   35 = TRAP, 36 = ALLOW
-   *   [3]..[7]: fd-conditional families (read/write get their own checks;
-   *   close/ioctl/fcntl trap only on virtual fds)
-   *   [8]..[22]: unconditional traps — time/sleep family, getrandom,
-   *   poll/ppoll + the epoll family (I/O multiplexing over virtual fds),
-   *   accept4, clone3
-   *   [23]/[24]: nr 41..59 -> TRAP (sockets + clone/fork/vfork/execve,
-   *   which the worker fails loudly with ENOSYS — a second guest thread
-   *   would race the single IPC channel)
-   *   25..28 read:  ipc->ALLOW, stdin->TRAP, vfd->TRAP, else ALLOW
-   *   29..32 write: ipc->ALLOW, fd<3->TRAP, vfd->TRAP, else ALLOW
-   *   33..34 vfd-check (close/ioctl/fcntl): vfd->TRAP, else ALLOW
-   */
-  struct sock_filter prog[] = {
-      /* [0] */ LD(BPF_ARCHF),
-      /* [1] */ JEQ(AUDIT_ARCH_X86_64, 0, 34),          /* !x86-64 -> ALLOW */
-      /* [2] */ LD(BPF_NR),
-      /* [3] */ JEQ(SYS_read, 21, 0),                   /* -> 25            */
-      /* [4] */ JEQ(SYS_write, 24, 0),                  /* -> 29            */
-      /* [5] */ JEQ(SYS_close, 27, 0),                  /* -> 33            */
-      /* [6] */ JEQ(16 /* ioctl */, 26, 0),             /* -> 33            */
-      /* [7] */ JEQ(72 /* fcntl */, 25, 0),             /* -> 33            */
-      /* [8] */ JEQ(SYS_nanosleep, 26, 0),              /* -> TRAP          */
-      /* [9] */ JEQ(SYS_clock_nanosleep, 25, 0),
-      /* [10] */ JEQ(SYS_clock_gettime, 24, 0),
-      /* [11] */ JEQ(SYS_gettimeofday, 23, 0),
-      /* [12] */ JEQ(SYS_time, 22, 0),
-      /* [13] */ JEQ(SYS_getrandom, 21, 0),
-      /* [14] */ JEQ(7 /* poll */, 20, 0),
-      /* [15] */ JEQ(271 /* ppoll */, 19, 0),
-      /* [16] */ JEQ(213 /* epoll_create */, 18, 0),
-      /* [17] */ JEQ(291 /* epoll_create1 */, 17, 0),
-      /* [18] */ JEQ(233 /* epoll_ctl */, 16, 0),
-      /* [19] */ JEQ(232 /* epoll_wait */, 15, 0),
-      /* [20] */ JEQ(281 /* epoll_pwait */, 14, 0),
-      /* [21] */ JEQ(288 /* accept4 */, 13, 0),
-      /* [22] */ JEQ(435 /* clone3 */, 12, 0),
-      /* [23] */ JGE(SYS_socket, 0, 12),                /* nr<41 -> ALLOW   */
-      /* [24] */ JGE(60, 11, 10),                       /* 41..59 -> TRAP   */
-      /* read */
-      /* [25] */ LD(BPF_ARG0),
-      /* [26] */ JEQ(SHIM_IPC_FD, 9, 0),                /* -> ALLOW         */
-      /* [27] */ JEQ(0, 7, 0),                          /* stdin -> TRAP    */
-      /* [28] */ JGE(SHIM_VFD_BASE, 6, 7),              /* vfd->TRAP/ALLOW  */
-      /* write */
-      /* [29] */ LD(BPF_ARG0),
-      /* [30] */ JEQ(SHIM_IPC_FD, 5, 0),                /* -> ALLOW         */
-      /* [31] */ JGE(3, 0, 3),                          /* fd<3 -> TRAP     */
-      /* [32] */ JGE(SHIM_VFD_BASE, 2, 3),              /* vfd->TRAP/ALLOW  */
-      /* close/ioctl/fcntl */
-      /* [33] */ LD(BPF_ARG0),
-      /* [34] */ JGE(SHIM_VFD_BASE, 0, 1),              /* vfd->TRAP/ALLOW  */
-      /* [35] */ RET(SECCOMP_RET_TRAP),
-      /* [36] */ RET(SECCOMP_RET_ALLOW),
+  /* BEGIN GENERATED BPF (tools/gen_bpf.py) */
+  struct sock_filter prog[] = {  /* 45 instructions */
+      LD(BPF_ARCHF),
+      JEQ(AUDIT_ARCH_X86_64, 0, 42),
+      LD(BPF_NR),
+      JEQ(0, 29, 0),  /* read */
+      JEQ(1, 32, 0),  /* write */
+      JEQ(3, 35, 0),  /* close */
+      JEQ(16, 34, 0),  /* ioctl */
+      JEQ(72, 33, 0),  /* fcntl */
+      JEQ(35, 34, 0),  /* nanosleep */
+      JEQ(230, 33, 0),  /* clock_nanosleep */
+      JEQ(228, 32, 0),  /* clock_gettime */
+      JEQ(96, 31, 0),  /* gettimeofday */
+      JEQ(201, 30, 0),  /* time */
+      JEQ(318, 29, 0),  /* getrandom */
+      JEQ(7, 28, 0),  /* poll */
+      JEQ(271, 27, 0),  /* ppoll */
+      JEQ(213, 26, 0),  /* epoll_create */
+      JEQ(291, 25, 0),  /* epoll_create1 */
+      JEQ(233, 24, 0),  /* epoll_ctl */
+      JEQ(232, 23, 0),  /* epoll_wait */
+      JEQ(281, 22, 0),  /* epoll_pwait */
+      JEQ(288, 21, 0),  /* accept4 */
+      JEQ(435, 20, 0),  /* clone3 */
+      JEQ(39, 19, 0),  /* getpid */
+      JEQ(110, 18, 0),  /* getppid */
+      JEQ(186, 17, 0),  /* gettid */
+      JEQ(283, 16, 0),  /* timerfd_create */
+      JEQ(286, 15, 0),  /* timerfd_settime */
+      JEQ(287, 14, 0),  /* timerfd_gettime */
+      JEQ(284, 13, 0),  /* eventfd */
+      JEQ(290, 12, 0),  /* eventfd2 */
+      JGE(41, 0, 12),  /* socket */
+      JGE(60, 11, 10),  /* clone_end */
+      LD(BPF_ARG0),
+      JEQ(SHIM_IPC_FD, 9, 0),
+      JEQ(0, 7, 0),  /* read */
+      JGE(SHIM_VFD_BASE, 6, 7),
+      LD(BPF_ARG0),
+      JEQ(SHIM_IPC_FD, 5, 0),
+      JGE(3, 0, 3),  /* close */
+      JGE(SHIM_VFD_BASE, 2, 3),
+      LD(BPF_ARG0),
+      JGE(SHIM_VFD_BASE, 0, 1),
+      RET(SECCOMP_RET_TRAP),
+      RET(SECCOMP_RET_ALLOW),
   };
+  /* END GENERATED BPF */
   struct sock_fprog fprog = {sizeof(prog) / sizeof(prog[0]), prog};
   if (prctl(PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0) != 0) return -1;
   return (int)prctl(PR_SET_SECCOMP, SECCOMP_MODE_FILTER, &fprog);

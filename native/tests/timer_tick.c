@@ -1,0 +1,59 @@
+/* timer_tick — timerfd + eventfd + epoll event-loop test program.
+ *
+ * Arms a 100 ms periodic timerfd, epoll-waits, prints each tick with the
+ * elapsed clock time; a self-eventfd injects one extra wakeup. Also prints
+ * getpid(), which under the simulator must be a deterministic virtual pid.
+ *
+ *   usage: timer_tick <nticks>
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/timerfd.h>
+#include <time.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+  int nticks = argc > 1 ? atoi(argv[1]) : 5;
+  int tfd = timerfd_create(CLOCK_REALTIME, 0);
+  int efd = eventfd(0, 0);
+  if (tfd < 0 || efd < 0) { perror("fd create"); return 1; }
+  int ep = epoll_create1(0);
+  struct epoll_event ev = {EPOLLIN, {.fd = tfd}};
+  epoll_ctl(ep, EPOLL_CTL_ADD, tfd, &ev);
+  ev.data.fd = efd;
+  epoll_ctl(ep, EPOLL_CTL_ADD, efd, &ev);
+
+  unsigned long long one = 7;
+  if (write(efd, &one, 8) != 8) { perror("eventfd write"); return 1; }
+
+  struct timespec t0;
+  clock_gettime(CLOCK_REALTIME, &t0);
+  struct itimerspec its = {{0, 100 * 1000 * 1000}, {0, 100 * 1000 * 1000}};
+  timerfd_settime(tfd, 0, &its, NULL);
+
+  int ticks = 0, evt = 0;
+  while (ticks < nticks) {
+    struct epoll_event out[4];
+    int n = epoll_wait(ep, out, 4, 2000);
+    if (n <= 0) { fprintf(stderr, "epoll_wait %d\n", n); return 1; }
+    for (int i = 0; i < n; i++) {
+      unsigned long long val;
+      if (read(out[i].data.fd, &val, 8) != 8) { perror("read"); return 1; }
+      if (out[i].data.fd == efd) {
+        evt += (int)val;
+      } else {
+        ticks += (int)val;
+        struct timespec t1;
+        clock_gettime(CLOCK_REALTIME, &t1);
+        long ms = (t1.tv_sec - t0.tv_sec) * 1000
+                  + (t1.tv_nsec - t0.tv_nsec) / 1000000;
+        printf("tick %d at %ld ms\n", ticks, ms);
+      }
+    }
+  }
+  printf("done ticks=%d evt=%d pid=%d\n", ticks, evt, (int)getpid());
+  return 0;
+}

@@ -59,6 +59,10 @@ SYS_poll, SYS_ppoll = 7, 271
 SYS_ioctl, SYS_fcntl = 16, 72
 SYS_epoll_create, SYS_epoll_create1 = 213, 291
 SYS_epoll_ctl, SYS_epoll_wait, SYS_epoll_pwait = 233, 232, 281
+SYS_getpid, SYS_getppid, SYS_gettid = 39, 110, 186
+SYS_timerfd_create, SYS_timerfd_settime, SYS_timerfd_gettime = 283, 286, 287
+SYS_eventfd, SYS_eventfd2 = 284, 290
+TFD_TIMER_ABSTIME = 1
 
 POLLIN, POLLOUT, POLLERR, POLLHUP = 0x001, 0x004, 0x008, 0x010
 EPOLL_CTL_ADD, EPOLL_CTL_DEL, EPOLL_CTL_MOD = 1, 2, 3
@@ -123,7 +127,9 @@ class VSocket:
 
     __slots__ = ("vfd", "kind", "endpoint", "rxbuf", "peer_closed",
                  "connected", "connect_err", "bound_port", "listening",
-                 "accept_q", "nonblock", "dgram_q", "udp", "interest")
+                 "accept_q", "nonblock", "dgram_q", "udp", "interest",
+                 "expirations", "interval_ns", "deadline", "timer_handle",
+                 "evt_counter")
 
     def __init__(self, vfd: int, kind: str = "stream") -> None:
         self.vfd = vfd
@@ -140,6 +146,13 @@ class VSocket:
         self.dgram_q: list = []  # (payload bytes|b"", nbytes, src, sport)
         self.udp = None  # DatagramSocket when bound
         self.interest: dict = {}  # epoll: vfd -> (events, userdata)
+        # timerfd state
+        self.expirations = 0
+        self.interval_ns = 0
+        self.deadline = 0
+        self.timer_handle = None
+        # eventfd state
+        self.evt_counter = 0
 
 
 class ManagedProcess(ProcessLifecycle):
@@ -170,6 +183,9 @@ class ManagedProcess(ProcessLifecycle):
         self._syscall_latency = 1000 if gen.model_unblocked_syscall_latency else 0
         self._spin_t = -1  # busy-loop detector: syscalls at one sim instant
         self._spin_n = 0
+        # deterministic virtual pid (real pids would leak host scheduling
+        # nondeterminism into any guest that prints or hashes its pid)
+        self.vpid = 1000 + host.id * 64 + index
 
     # -- lifecycle ---------------------------------------------------------
     def spawn(self) -> None:
@@ -383,10 +399,26 @@ class ManagedProcess(ProcessLifecycle):
                 data = self.mem.read(addr, min(n, 1 << 20))
                 self._capture(fd).write(data)
                 return len(data)
+            vs = self.fds.get(fd)
+            if vs is not None and vs.kind == "event":
+                if n < 8:
+                    return -EINVAL
+                val = struct.unpack("<Q", self.mem.read(addr, 8))[0]
+                vs.evt_counter += val
+                w = self._waiting
+                if w and w[0] == "cread" and w[1] is vs:
+                    # (cannot happen single-threaded, but keep it sound)
+                    self._resume(self._counter_read(vs, w[2], w[3]))
+                else:
+                    self._notify()
+                return 8
             return self._vfd_send(fd, addr, n)
         if nr == SYS_read:
             if args[0] == 0:
                 return 0  # stdin: EOF
+            vs = self.fds.get(args[0])
+            if vs is not None and vs.kind in ("timer", "event"):
+                return self._counter_read(vs, args[1], args[2])
             return self._vfd_recv(args[0], args[1], args[2])
         if nr == SYS_close:
             vs = self.fds.pop(args[0], None)
@@ -535,6 +567,38 @@ class ManagedProcess(ProcessLifecycle):
                 self.mem.write(args[2], struct.pack("<i", avail))
                 return 0
             return 0
+        if nr == SYS_getpid:
+            return self.vpid
+        if nr == SYS_gettid:
+            return self.vpid
+        if nr == SYS_getppid:
+            return 1  # the "init" of the simulated world
+        if nr == SYS_timerfd_create:
+            vfd = self._next_vfd
+            self._next_vfd += 1
+            self.fds[vfd] = VSocket(vfd, "timer")
+            return vfd
+        if nr == SYS_timerfd_settime:
+            return self._timerfd_settime(args[0], args[1], args[2], args[3])
+        if nr == SYS_timerfd_gettime:
+            vs = self.fds.get(args[0])
+            if vs is None or vs.kind != "timer":
+                return -EBADF
+            left = max(vs.deadline - emulated(h.now), 0) if vs.timer_handle else 0
+            self.mem.write(args[1], struct.pack(
+                "<qqqq", vs.interval_ns // NS_PER_SEC,
+                vs.interval_ns % NS_PER_SEC,
+                left // NS_PER_SEC, left % NS_PER_SEC))
+            return 0
+        if nr in (SYS_eventfd, SYS_eventfd2):
+            vfd = self._next_vfd
+            self._next_vfd += 1
+            vs = VSocket(vfd, "event")
+            vs.evt_counter = args[0]
+            if nr == SYS_eventfd2 and args[1] & 0o4000:  # EFD_NONBLOCK
+                vs.nonblock = True
+            self.fds[vfd] = vs
+            return vfd
         if nr in (SYS_sendmsg, SYS_recvmsg):
             return -ENOSYS  # scatter-gather io: not yet
         if nr in (SYS_clone, SYS_fork, SYS_vfork, SYS_execve, SYS_clone3):
@@ -545,6 +609,10 @@ class ManagedProcess(ProcessLifecycle):
 
     # -- readiness (poll/epoll) --------------------------------------------
     def _readable(self, vs: VSocket) -> bool:
+        if vs.kind == "timer":
+            return vs.expirations > 0
+        if vs.kind == "event":
+            return vs.evt_counter > 0
         if vs.kind == "dgram":
             return bool(vs.dgram_q)
         if vs.listening:
@@ -552,7 +620,7 @@ class ManagedProcess(ProcessLifecycle):
         return bool(vs.rxbuf) or vs.peer_closed
 
     def _writable(self, vs: VSocket) -> bool:
-        if vs.kind == "dgram":
+        if vs.kind in ("dgram", "event"):
             return True
         ep = vs.endpoint
         if ep is None or not vs.connected or vs.peer_closed:
@@ -863,6 +931,67 @@ class ManagedProcess(ProcessLifecycle):
         token = self._arm_wait_timeout(timeout_ns)
         self._waiting = ("epoll", token, ep_vs, events_ptr, maxev)
         return _BLOCK
+
+    # -- timerfd / eventfd ---------------------------------------------------
+    def _counter_read(self, vs: VSocket, buf: int, buflen: int):
+        if buflen < 8:
+            return -EINVAL
+        val = vs.expirations if vs.kind == "timer" else vs.evt_counter
+        if val > 0:
+            if vs.kind == "timer":
+                vs.expirations = 0
+            else:
+                vs.evt_counter = 0
+            self.mem.write(buf, struct.pack("<Q", val))
+            return 8
+        if vs.nonblock:
+            return -EAGAIN
+        self._waiting = ("cread", vs, buf, buflen)
+        return _BLOCK
+
+    def _timerfd_settime(self, fd: int, flags: int, new_ptr: int, old_ptr: int):
+        vs = self.fds.get(fd)
+        if vs is None or vs.kind != "timer":
+            return -EBADF
+        isec, insec, vsec, vnsec = struct.unpack(
+            "<qqqq", self.mem.read(new_ptr, 32))
+        if old_ptr:
+            left = max(vs.deadline - emulated(self.host.now), 0) if vs.timer_handle else 0
+            self.mem.write(old_ptr, struct.pack(
+                "<qqqq", vs.interval_ns // NS_PER_SEC,
+                vs.interval_ns % NS_PER_SEC,
+                left // NS_PER_SEC, left % NS_PER_SEC))
+        if vs.timer_handle is not None:
+            self.host.cancel(vs.timer_handle)
+            vs.timer_handle = None
+        vs.interval_ns = isec * NS_PER_SEC + insec
+        first = vsec * NS_PER_SEC + vnsec
+        if first == 0:
+            return 0  # disarm
+        if flags & TFD_TIMER_ABSTIME:
+            delay = max(first - emulated(self.host.now), 0)
+            vs.deadline = first
+        else:
+            delay = first
+            vs.deadline = emulated(self.host.now) + first
+        vs.timer_handle = self.host.schedule_in(delay, lambda: self._timer_fire(vs))
+        return 0
+
+    def _timer_fire(self, vs: VSocket) -> None:
+        if vs.vfd not in self.fds or not self.running:
+            return
+        vs.expirations += 1
+        if vs.interval_ns > 0:
+            vs.deadline += vs.interval_ns
+            vs.timer_handle = self.host.schedule_in(
+                vs.interval_ns, lambda: self._timer_fire(vs))
+        else:
+            vs.timer_handle = None
+        w = self._waiting
+        if w and w[0] == "cread" and w[1] is vs:
+            self._resume(self._counter_read(vs, w[2], w[3]))
+        else:
+            self._notify()
 
     # -- datagram bridge ----------------------------------------------------
     def _dgram_bind(self, vs: VSocket):

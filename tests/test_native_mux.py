@@ -173,3 +173,51 @@ hosts:
     # RTT is SIMULATED: exactly 2 x 25 ms one-way latency
     for line in out.splitlines()[:4]:
         assert "rtt_ms=50" in line, line
+
+
+def test_timer_tick_native_oracle():
+    r = subprocess.run([str(BUILD / "timer_tick"), "5"], capture_output=True,
+                       text=True, timeout=30)
+    assert r.returncode == 0, r.stderr
+    assert "done ticks=5 evt=7" in r.stdout
+
+
+def test_timerfd_eventfd_managed_deterministic():
+    cfg_text = f"""
+general:
+  stop_time: 8s
+  seed: 15
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        edge [ source 0 target 0 latency "5 ms" ]
+      ]
+hosts:
+  box:
+    network_node_id: 0
+    processes:
+      - path: {BUILD}/timer_tick
+        args: ["5"]
+        start_time: 1s
+        expected_final_state: {{exited: 0}}
+"""
+    outs = []
+    for tag in ("t1", "t2"):
+        cfg = parse_config(yaml.safe_load(cfg_text), {
+            "general.data_directory": f"/tmp/st-timer-{tag}",
+        })
+        c = Controller(cfg, mirror_log=False)
+        result = c.run()
+        assert result["process_errors"] == [], result["process_errors"]
+        outs.append(Path(f"/tmp/st-timer-{tag}/hosts/box/timer_tick.0.stdout"
+                         ).read_text())
+    # simulated periodic timer: ticks at exactly 100 ms steps, and the
+    # virtual pid makes the whole output bit-deterministic across runs
+    assert "tick 1 at 100 ms" in outs[0]
+    assert "tick 5 at 500 ms" in outs[0]
+    assert "done ticks=5 evt=7 pid=" in outs[0]
+    assert outs[0] == outs[1]

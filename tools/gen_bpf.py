@@ -1,0 +1,109 @@
+"""Generate the seccomp BPF program for native/shim/shim.c.
+
+The filter grew past the point where hand-maintained relative jump offsets
+are reviewable; this script owns the layout and emits the C table between
+the GENERATED-BPF markers. Run after changing the trap sets:
+
+    python tools/gen_bpf.py        # rewrites native/shim/shim.c in place
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+SYS = dict(read=0, write=1, close=3, poll=7, ioctl=16, nanosleep=35,
+           getpid=39, socket=41, clone_end=60, fcntl=72,
+           gettimeofday=96, getppid=110, gettid=186, time=201,
+           epoll_create=213, clock_gettime=228, clock_nanosleep=230,
+           epoll_wait=232, epoll_ctl=233, ppoll=271, epoll_pwait=281,
+           timerfd_create=283, eventfd=284, timerfd_settime=286,
+           timerfd_gettime=287, accept4=288, eventfd2=290,
+           epoll_create1=291, getrandom=318, clone3=435)
+
+#: syscalls trapped unconditionally (beyond the 41..59 socket/clone range)
+UNCONDITIONAL = [
+    "nanosleep", "clock_nanosleep", "clock_gettime", "gettimeofday", "time",
+    "getrandom", "poll", "ppoll", "epoll_create", "epoll_create1",
+    "epoll_ctl", "epoll_wait", "epoll_pwait", "accept4", "clone3",
+    "getpid", "getppid", "gettid", "timerfd_create", "timerfd_settime",
+    "timerfd_gettime", "eventfd", "eventfd2",
+]
+
+#: syscalls trapped only when arg0 is a virtual fd
+VFD_CONDITIONAL = ["close", "ioctl", "fcntl"]
+
+
+def build():
+    prog: list = []
+    prog.append(("LD_ARCH",))
+    prog.append(("JEQ", "ARCH", None, "ALLOW"))
+    prog.append(("LD_NR",))
+    prog.append(("JEQ", SYS["read"], "READ", None))
+    prog.append(("JEQ", SYS["write"], "WRITE", None))
+    for name in VFD_CONDITIONAL:
+        prog.append(("JEQ", SYS[name], "VFDCHK", None))
+    for name in UNCONDITIONAL:
+        prog.append(("JEQ", SYS[name], "TRAP", None))
+    prog.append(("JGE", SYS["socket"], None, "ALLOW"))
+    prog.append(("JGE", SYS["clone_end"], "ALLOW", "TRAP"))
+    labels = {}
+    labels["READ"] = len(prog)
+    prog += [("LD_A0",), ("JEQ", "IPC", "ALLOW", None),
+             ("JEQ", 0, "TRAP", None), ("JGE", "VFD", "TRAP", "ALLOW")]
+    labels["WRITE"] = len(prog)
+    prog += [("LD_A0",), ("JEQ", "IPC", "ALLOW", None),
+             ("JGE", 3, None, "TRAP"), ("JGE", "VFD", "TRAP", "ALLOW")]
+    labels["VFDCHK"] = len(prog)
+    prog += [("LD_A0",), ("JGE", "VFD", "TRAP", "ALLOW")]
+    labels["TRAP"] = len(prog)
+    prog.append(("RET_TRAP",))
+    labels["ALLOW"] = len(prog)
+    prog.append(("RET_ALLOW",))
+
+    names = {v: k for k, v in SYS.items()}
+
+    def val(v):
+        return {"ARCH": "AUDIT_ARCH_X86_64", "IPC": "SHIM_IPC_FD",
+                "VFD": "SHIM_VFD_BASE"}.get(v, str(v))
+
+    out = []
+    for i, ins in enumerate(prog):
+        k = ins[0]
+        simple = {"LD_ARCH": "LD(BPF_ARCHF),", "LD_NR": "LD(BPF_NR),",
+                  "LD_A0": "LD(BPF_ARG0),",
+                  "RET_TRAP": "RET(SECCOMP_RET_TRAP),",
+                  "RET_ALLOW": "RET(SECCOMP_RET_ALLOW),"}
+        if k in simple:
+            out.append("      " + simple[k])
+            continue
+        _, v, t, f = ins
+
+        def off(lbl):
+            if lbl is None:
+                return 0
+            d = labels[lbl] - (i + 1)
+            assert 0 <= d < 256, (i, lbl, d)
+            return d
+
+        cmt = f"  /* {names.get(v, '')} */" if isinstance(v, int) and v in names else ""
+        op = "JEQ" if k == "JEQ" else "JGE"
+        out.append(f"      {op}({val(v)}, {off(t)}, {off(f)}),{cmt}")
+    return len(prog), "\n".join(out)
+
+
+def main():
+    shim = Path(__file__).resolve().parents[1] / "native" / "shim" / "shim.c"
+    src = shim.read_text()
+    begin = "  /* BEGIN GENERATED BPF (tools/gen_bpf.py) */\n"
+    end = "  /* END GENERATED BPF */"
+    n, table = build()
+    i, j = src.index(begin) + len(begin), src.index(end)
+    src = (src[:i]
+           + f"  struct sock_filter prog[] = {{  /* {n} instructions */\n"
+           + table + "\n  };\n" + src[j:])
+    shim.write_text(src)
+    print(f"wrote {n}-instruction filter into {shim}")
+
+
+if __name__ == "__main__":
+    main()
