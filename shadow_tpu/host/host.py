@@ -47,6 +47,7 @@ class Host:
         self._conns: dict[tuple[int, int, int], StreamEndpoint] = {}
         self._next_ephemeral = EPHEMERAL_BASE
         self._log_lines: list[str] = []
+        self._ack_eps: dict = {}  # endpoints owing a coalesced barrier ack
         self.pcap = None  # PcapWriter when hosts.<name>.pcap_enabled
         self.log_level = "info"  # per-host override (hosts.<name>.log_level)
 

@@ -184,6 +184,12 @@ class NetworkEngine:
         """The round barrier: resolve all units emitted this round."""
         units: list[Unit] = []
         for h in self.hosts:  # host-id order == src-sorted FIFO, no sort
+            if h._ack_eps:
+                # flush coalesced acks (transport.StreamReceiver._ack)
+                eps, h._ack_eps = h._ack_eps, {}
+                for ep in eps:
+                    if ep.state != 0:  # not CLOSED
+                        ep.receiver.flush_ack()
             if h.egress:
                 units.extend(h.egress)
                 h.egress = []

@@ -239,6 +239,14 @@ class StreamReceiver:
             self.ep.on_data(nbytes, payload, now)
 
     def _ack(self) -> None:
+        # round-barrier ack coalescing (the fluid analog of delayed acks):
+        # every in-round delivery marks the endpoint; the engine flushes ONE
+        # cumulative ACK per connection at the barrier. Halves unit volume
+        # on bulk transfers with identical reliability (acks are cumulative
+        # and the sender's RTO floor far exceeds a round width).
+        self.ep.host._ack_eps[self.ep] = None
+
+    def flush_ack(self) -> None:
         self.ep.emit(U.ACK, acked=self.rcv_nxt, wnd=self.window())
 
 
