@@ -1,0 +1,14 @@
+"""A real Python program run as a managed guest: fetches a URL with
+urllib over the simulated network and reports timing from the simulated
+clock. usage: http_fetch.py <url> <expect_bytes>"""
+import sys
+import time
+import urllib.request
+
+url, want = sys.argv[1], int(sys.argv[2])
+t0 = time.time()
+with urllib.request.urlopen(url, timeout=30) as r:
+    body = r.read()
+dt_ms = int((time.time() - t0) * 1000)
+assert len(body) == want, (len(body), want)
+print(f"fetched {len(body)} bytes in {dt_ms} ms status={r.status}")

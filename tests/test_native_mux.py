@@ -221,3 +221,59 @@ hosts:
     assert "tick 5 at 500 ms" in outs[0]
     assert "done ticks=5 evt=7 pid=" in outs[0]
     assert outs[0] == outs[1]
+
+
+def test_cpython_guest_fetches_http_in_sim():
+    """An unmodified CPython interpreter as a managed guest: thousands of
+    native startup syscalls pass through, then urllib's socket traffic
+    rides the simulated network. getrandom interception makes even
+    Python's hash randomization deterministic."""
+    import sys
+
+    cfg_text = f"""
+general:
+  stop_time: 30s
+  seed: 21
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 1 host_bandwidth_up "20 Mbit" host_bandwidth_down "20 Mbit" ]
+        edge [ source 0 target 1 latency "30 ms" ]
+        edge [ source 0 target 0 latency "5 ms" ]
+        edge [ source 1 target 1 latency "5 ms" ]
+      ]
+hosts:
+  web:
+    network_node_id: 0
+    ip_addr: 11.0.0.1
+    processes:
+      - path: pyapp:shadow_tpu.models.httpd:HttpServer
+        args: ["80", "250000"]
+  client:
+    network_node_id: 1
+    processes:
+      - path: {sys.executable}
+        args: ["{ROOT}/native/tests/guest/http_fetch.py", "http://11.0.0.1:80/data", "250000"]
+        start_time: 1s
+        expected_final_state: {{exited: 0}}
+"""
+    outs = []
+    for tag in ("p1", "p2"):
+        cfg = parse_config(yaml.safe_load(cfg_text), {
+            "general.data_directory": f"/tmp/st-pyguest-{tag}",
+        })
+        c = Controller(cfg, mirror_log=False)
+        result = c.run()
+        assert result["process_errors"] == [], result["process_errors"]
+        outs.append(Path(f"/tmp/st-pyguest-{tag}/hosts/client/"
+                         ).glob("*.stdout").__next__().read_text())
+    assert "fetched 250000 bytes" in outs[0], outs[0]
+    assert "status=200" in outs[0]
+    # the reported elapsed time is simulated and bit-deterministic
+    ms = int(outs[0].split(" in ")[1].split(" ms")[0])
+    assert 150 <= ms <= 3000, ms
+    assert outs[0] == outs[1]
