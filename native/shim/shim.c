@@ -152,12 +152,14 @@ time_t time(time_t *out) {
 
 static int install_seccomp(void) {
   /* BEGIN GENERATED BPF (tools/gen_bpf.py) */
-  struct sock_filter prog[] = {  /* 45 instructions */
+  struct sock_filter prog[] = {  /* 47 instructions */
       LD(BPF_ARCHF),
-      JEQ(AUDIT_ARCH_X86_64, 0, 42),
+      JEQ(AUDIT_ARCH_X86_64, 0, 44),
       LD(BPF_NR),
-      JEQ(0, 29, 0),  /* read */
-      JEQ(1, 32, 0),  /* write */
+      JEQ(0, 31, 0),  /* read */
+      JEQ(1, 34, 0),  /* write */
+      JEQ(19, 29, 0),  /* readv */
+      JEQ(20, 32, 0),  /* writev */
       JEQ(3, 35, 0),  /* close */
       JEQ(16, 34, 0),  /* ioctl */
       JEQ(72, 33, 0),  /* fcntl */

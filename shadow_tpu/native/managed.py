@@ -46,6 +46,7 @@ HELLO = 0xFFFFFFFF
 
 # x86-64 syscall numbers
 SYS_read, SYS_write, SYS_close = 0, 1, 3
+SYS_readv, SYS_writev = 19, 20
 SYS_nanosleep = 35
 SYS_socket, SYS_connect, SYS_accept, SYS_sendto, SYS_recvfrom = 41, 42, 43, 44, 45
 SYS_sendmsg, SYS_recvmsg, SYS_shutdown, SYS_bind, SYS_listen = 46, 47, 48, 49, 50
@@ -599,8 +600,14 @@ class ManagedProcess(ProcessLifecycle):
                 vs.nonblock = True
             self.fds[vfd] = vs
             return vfd
-        if nr in (SYS_sendmsg, SYS_recvmsg):
-            return -ENOSYS  # scatter-gather io: not yet
+        if nr == SYS_sendmsg:
+            return self._sendmsg(args[0], args[1])
+        if nr == SYS_recvmsg:
+            return self._recvmsg(args[0], args[1])
+        if nr == SYS_writev:
+            return self._writev(args[0], args[1], args[2])
+        if nr == SYS_readv:
+            return self._readv(args[0], args[1], args[2])
         if nr in (SYS_clone, SYS_fork, SYS_vfork, SYS_execve, SYS_clone3):
             # multi-threaded/forking guests would race the single IPC
             # channel; fail loudly until per-thread channels exist
@@ -708,6 +715,11 @@ class ManagedProcess(ProcessLifecycle):
             if accepted > 0:
                 self._resume(accepted)
             return
+        if w and w[0] == "smsg" and w[1] is vs:
+            accepted = vs.endpoint.send(payload=w[2])
+            if accepted > 0:
+                self._resume(accepted)
+            return
         self._notify()
 
     def _listen(self, fd: int):
@@ -811,12 +823,15 @@ class ManagedProcess(ProcessLifecycle):
             _, _, bufaddr, buflen = w
             self._fulfill_recv(vs, bufaddr, buflen)
             return
+        if w and w[0] == "rmsg" and w[1] is vs:
+            self._resume(self._scatter_rx(vs, w[2]))
+            return
         self._notify()
 
     def _on_net_close(self, vs: VSocket) -> None:
         vs.peer_closed = True
         w = self._waiting
-        if w and w[0] == "recv" and w[1] is vs and not vs.rxbuf:
+        if w and w[0] in ("recv", "rmsg") and w[1] is vs and not vs.rxbuf:
             self._resume(0)
             return
         self._notify()
@@ -826,7 +841,8 @@ class ManagedProcess(ProcessLifecycle):
         w = self._waiting
         if w and w[0] == "connect" and w[1] is vs:
             self._resume(-ETIMEDOUT)
-        elif w and w[0] in ("recv", "send") and w[1] is vs:
+        elif w and w[0] in ("recv", "send", "rmsg", "smsg", "dmsg") \
+                and w[1] is vs:
             self._resume(-ECONNRESET)
         else:
             self._notify()
@@ -932,6 +948,151 @@ class ManagedProcess(ProcessLifecycle):
         self._waiting = ("epoll", token, ep_vs, events_ptr, maxev)
         return _BLOCK
 
+    # -- scatter-gather (msghdr/iovec walking via guest memory) --------------
+    def _read_iovec(self, iov_ptr: int, iovcnt: int):
+        """Reads a struct iovec[] from guest memory → [(base, len)]."""
+        iovs = []
+        n = min(iovcnt, 1024)  # IOV_MAX
+        if iov_ptr and n:
+            raw = self.mem.read(iov_ptr, 16 * n)
+            for i in range(n):
+                iovs.append(struct.unpack_from("<QQ", raw, 16 * i))
+        return iovs
+
+    def _read_msghdr(self, msg_ptr: int):
+        """Returns (name_ptr, namelen, iov list[(base, len)])."""
+        raw = self.mem.read(msg_ptr, 56)  # struct msghdr on x86-64
+        # msg_namelen is a 4-byte socklen_t at offset 8 (then 4 pad bytes)
+        name, namelen, iov, iovlen = struct.unpack_from("<QIxxxxQQ", raw, 0)
+        return name, namelen, self._read_iovec(iov, iovlen)
+
+    def _stream_send(self, vs: VSocket, data: bytes):
+        """Send gathered bytes on a stream socket; park replaying the same
+        staged buffer if the send buffer is full (sendmsg/writev path)."""
+        if vs.kind != "stream" or vs.endpoint is None or not vs.connected:
+            return -ENOTCONN
+        if vs.peer_closed:
+            return -EPIPE
+        accepted = vs.endpoint.send(payload=data)
+        if accepted > 0:
+            return accepted
+        if vs.nonblock:
+            return -EAGAIN
+        self._waiting = ("smsg", vs, data)
+        return _BLOCK
+
+    def _scatter_rx(self, vs: VSocket, iovs) -> int:
+        """Move bytes from vs.rxbuf into the guest's iovecs."""
+        k = min(len(vs.rxbuf), sum(ln for _, ln in iovs))
+        self._scatter(iovs, bytes(vs.rxbuf[:k]))
+        del vs.rxbuf[:k]
+        return k
+
+    def _sendmsg(self, fd: int, msg_ptr: int):
+        vs = self.fds.get(fd)
+        if vs is None:
+            return -EBADF
+        name, namelen, iovs = self._read_msghdr(msg_ptr)
+        data = b"".join(self.mem.read(b, min(ln, 1 << 20))
+                        for b, ln in iovs if ln)
+        if vs.kind == "dgram":
+            if not name:
+                return -89  # EDESTADDRREQ: connected-dgram sendmsg unsupported
+            # reuse the sendto path with a staged buffer
+            return self._dgram_sendto(vs, (fd, 0, len(data), 0, name, namelen),
+                                      staged=data)
+        return self._stream_send(vs, data)
+
+    def _recvmsg(self, fd: int, msg_ptr: int):
+        vs = self.fds.get(fd)
+        if vs is None:
+            return -EBADF
+        name, namelen, iovs = self._read_msghdr(msg_ptr)
+        if vs.kind == "dgram":
+            if not vs.dgram_q:
+                if vs.nonblock:
+                    return -EAGAIN
+                self._waiting = ("dmsg", vs, iovs, (msg_ptr, name, namelen))
+                return _BLOCK
+            return self._recvmsg_take(vs, iovs, (msg_ptr, name, namelen))
+        if vs.rxbuf:
+            return self._scatter_rx(vs, iovs)
+        if vs.peer_closed:
+            return 0
+        if vs.nonblock:
+            return -EAGAIN
+        self._waiting = ("rmsg", vs, iovs)
+        return _BLOCK
+
+    def _recvmsg_take(self, vs: VSocket, iovs, where) -> int:
+        payload, nbytes, src, sport = vs.dgram_q.pop(0)
+        data = payload if payload is not None else b"\0" * nbytes
+        msg_ptr, name_ptr, namelen = where if where else (0, 0, 0)
+        if name_ptr and namelen:
+            ip = self.host.controller.hosts[src].ip
+            sa = (struct.pack("<H", socket.AF_INET) + struct.pack(">H", sport)
+                  + socket.inet_aton(ip) + b"\0" * 8)
+            # kernel semantics: truncate to the caller's buffer, then
+            # write the un-truncated length back into msg_namelen
+            self.mem.write(name_ptr, sa[:namelen])
+            self.mem.write(msg_ptr + 8, struct.pack("<I", len(sa)))
+        return self._scatter(iovs, data)
+
+    def _writev(self, fd: int, iov_ptr: int, iovcnt: int):
+        iovs = self._read_iovec(iov_ptr, iovcnt)
+        data = b"".join(self.mem.read(b, min(ln, 1 << 20))
+                        for b, ln in iovs if ln)
+        if fd in (1, 2):
+            self._capture(fd).write(data)
+            return len(data)
+        vs = self.fds.get(fd)
+        if vs is None:
+            return -EBADF
+        if vs.kind == "event":
+            if len(data) < 8:
+                return -EINVAL
+            vs.evt_counter += struct.unpack("<Q", data[:8])[0]
+            self._notify()
+            return 8
+        return self._stream_send(vs, data)
+
+    def _readv(self, fd: int, iov_ptr: int, iovcnt: int):
+        if fd == 0:
+            return 0  # stdin: EOF, matching the read path
+        vs = self.fds.get(fd)
+        if vs is None:
+            return -EBADF
+        iovs = self._read_iovec(iov_ptr, iovcnt)
+        if vs.kind in ("timer", "event"):
+            if not iovs:
+                return -EINVAL
+            return self._counter_read(vs, iovs[0][0], iovs[0][1])
+        if vs.kind == "dgram":
+            if not vs.dgram_q:
+                if vs.nonblock:
+                    return -EAGAIN
+                self._waiting = ("dmsg", vs, iovs, None)
+                return _BLOCK
+            return self._recvmsg_take(vs, iovs, None)
+        if vs.rxbuf:
+            return self._scatter_rx(vs, iovs)
+        if vs.peer_closed:
+            return 0
+        if vs.nonblock:
+            return -EAGAIN
+        self._waiting = ("rmsg", vs, iovs)
+        return _BLOCK
+
+    def _scatter(self, iovs, data: bytes) -> int:
+        off = 0
+        for base, ln in iovs:
+            if off >= len(data):
+                break
+            k = min(ln, len(data) - off)
+            self.mem.write(base, data[off:off + k])
+            off += k
+        return off
+
     # -- timerfd / eventfd ---------------------------------------------------
     def _counter_read(self, vs: VSocket, buf: int, buflen: int):
         if buflen < 8:
@@ -1007,13 +1168,15 @@ class ManagedProcess(ProcessLifecycle):
             w = self._waiting
             if w and w[0] == "drecv" and w[1] is vs:
                 self._resume(self._dgram_take(vs, w[2], w[3], w[4], w[5]))
+            elif w and w[0] == "dmsg" and w[1] is vs:
+                self._resume(self._recvmsg_take(vs, w[2], w[3]))
             else:
                 self._notify()
 
         sock.on_datagram = on_datagram
         return 0
 
-    def _dgram_sendto(self, vs: VSocket, args):
+    def _dgram_sendto(self, vs: VSocket, args, staged: bytes = None):
         if vs.udp is None:
             r = self._dgram_bind(vs)  # auto-bind an ephemeral port
             if r != 0:
@@ -1025,10 +1188,12 @@ class ManagedProcess(ProcessLifecycle):
             peer = self.host.controller.resolve(ip)
         except KeyError:
             return -ENETUNREACH
-        n = min(args[2], 1 << 16)
-        data = self.mem.read(args[1], n)
+        if staged is not None:
+            data = staged
+        else:
+            data = self.mem.read(args[1], min(args[2], 1 << 16))
         vs.udp.sendto(peer, port, payload=data)
-        return n
+        return len(data)
 
     def _dgram_recvfrom(self, vs: VSocket, args):
         if vs.udp is None:

@@ -11,7 +11,8 @@ from __future__ import annotations
 
 from pathlib import Path
 
-SYS = dict(read=0, write=1, close=3, poll=7, ioctl=16, nanosleep=35,
+SYS = dict(read=0, write=1, close=3, poll=7, ioctl=16, readv=19, writev=20,
+           nanosleep=35,
            getpid=39, socket=41, clone_end=60, fcntl=72,
            gettimeofday=96, getppid=110, gettid=186, time=201,
            epoll_create=213, clock_gettime=228, clock_nanosleep=230,
@@ -40,6 +41,8 @@ def build():
     prog.append(("LD_NR",))
     prog.append(("JEQ", SYS["read"], "READ", None))
     prog.append(("JEQ", SYS["write"], "WRITE", None))
+    prog.append(("JEQ", SYS["readv"], "READ", None))
+    prog.append(("JEQ", SYS["writev"], "WRITE", None))
     for name in VFD_CONDITIONAL:
         prog.append(("JEQ", SYS[name], "VFDCHK", None))
     for name in UNCONDITIONAL:
