@@ -29,6 +29,7 @@
  *   handshake: request with nr = SHIM_HELLO, arg0 = getpid()
  */
 #define _GNU_SOURCE
+#include <dlfcn.h>
 #include <errno.h>
 #include <fcntl.h>
 #include <linux/audit.h>
@@ -54,6 +55,8 @@ struct shim_req { uint64_t nr; uint64_t args[6]; };
 
 static volatile int64_t *shim_time_page; /* emulated ns since UNIX epoch */
 static int shim_active;
+static long shim_real_pid, shim_real_tid; /* cached pre-seccomp: the trapped
+                                             getpid/gettid return vpids */
 
 /* raw syscalls only — the shim must not recurse through libc wrappers */
 static long raw3(long nr, long a, long b, long c) {
@@ -107,6 +110,124 @@ static void sigsys_handler(int signo, siginfo_t *info, void *vctx) {
 }
 
 /* ---- interposed time family (catches the vDSO paths) ------------------- */
+
+static int64_t emulated_now_ns(void);
+
+/* ---- TSC virtualization (reference analog: SURVEY.md §2 "TSC emulation")
+ *
+ * prctl(PR_SET_TSC, PR_TSC_SIGSEGV) makes rdtsc/rdtscp fault; this handler
+ * decodes the two instruction forms and serves the emulated clock at a
+ * fixed nominal 1 GHz (cycles == ns), so even guests that time via the raw
+ * TSC — bypassing every syscall and vDSO path — observe simulated time.
+ *
+ * Guests that install their own SIGSEGV handler must keep working: the
+ * shim interposes sigaction()/signal() (libc PLT calls — raw rt_sigaction
+ * from a static binary bypasses this, a documented scope limit) and keeps
+ * its handler installed, recording the guest's disposition. Non-TSC
+ * SIGSEGVs are chained to the guest handler; with none registered, a
+ * hardware fault crashes via re-execution under SIG_DFL, and a
+ * software-raised SIGSEGV (raise/kill: si_code <= 0) is re-raised
+ * explicitly since nothing would re-trigger it on return. */
+
+static struct sigaction guest_segv; /* guest's requested disposition */
+
+static int real_sigaction(int sig, const struct sigaction *act,
+                          struct sigaction *old) {
+  static int (*real)(int, const struct sigaction *, struct sigaction *);
+  if (!real) {
+    union { void *p; int (*f)(int, const struct sigaction *,
+                              struct sigaction *); } u;
+    u.p = dlsym(RTLD_NEXT, "sigaction");
+    real = u.f;
+  }
+  return real(sig, act, old);
+}
+
+/* dispatch to the guest's handler under its requested signal mask */
+static void chain_guest(int signo, siginfo_t *info, void *vctx) {
+  sigset_t old;
+  sigprocmask(SIG_BLOCK, &guest_segv.sa_mask, &old);
+  if (guest_segv.sa_flags & SA_SIGINFO)
+    guest_segv.sa_sigaction(signo, info, vctx);
+  else
+    guest_segv.sa_handler(signo);
+  sigprocmask(SIG_SETMASK, &old, NULL); /* longjmp-outs restore their own */
+}
+
+static void sigsegv_handler(int signo, siginfo_t *info, void *vctx) {
+  ucontext_t *ctx = vctx;
+  greg_t *g = ctx->uc_mcontext.gregs;
+  const uint8_t *ip = (const uint8_t *)g[REG_RIP];
+  /* rdtsc = 0F 31 ; rdtscp = 0F 01 F9. A bogus RIP makes the ip[] reads
+   * fault; SIGSEGV is blocked inside its own handler, so the kernel then
+   * force-kills with the default action — the right outcome. */
+  if (ip && ip[0] == 0x0f &&
+      (ip[1] == 0x31 || (ip[1] == 0x01 && ip[2] == 0xf9))) {
+    uint64_t ns = (uint64_t)emulated_now_ns();
+    g[REG_RAX] = (greg_t)(ns & 0xffffffffu);
+    g[REG_RDX] = (greg_t)(ns >> 32);
+    if (ip[1] == 0x31) {
+      g[REG_RIP] += 2;
+    } else {
+      g[REG_RCX] = 0; /* IA32_TSC_AUX: core 0 */
+      g[REG_RIP] += 3;
+    }
+    return;
+  }
+  int hw_fault = info->si_code > 0; /* <=0: raise()/kill()/sigqueue() */
+  if ((guest_segv.sa_flags & SA_SIGINFO) ||
+      (guest_segv.sa_handler != SIG_DFL && guest_segv.sa_handler != SIG_IGN &&
+       guest_segv.sa_handler != NULL)) {
+    chain_guest(signo, info, vctx);
+    return;
+  }
+  if (guest_segv.sa_handler == SIG_IGN && !hw_fault)
+    return; /* ignoring a software-raised SIGSEGV is legal */
+  /* default action (the kernel also force-kills SIG_IGN on a hardware
+   * fault): restore the REAL kernel disposition — the interposed signal()
+   * would only record it — then let re-execution (hardware) or an explicit
+   * re-raise (software) deliver the fatal signal. */
+  struct sigaction dfl;
+  memset(&dfl, 0, sizeof dfl);
+  dfl.sa_handler = SIG_DFL;
+  real_sigaction(SIGSEGV, &dfl, NULL);
+  if (!hw_fault)
+    raw3(SYS_tgkill, shim_real_pid, shim_real_tid, SIGSEGV);
+}
+
+/* sigaction/signal interposition: SIGSEGV dispositions are recorded, not
+ * installed — the shim's handler stays first and chains (above). */
+
+int sigaction(int sig, const struct sigaction *act, struct sigaction *old) {
+  static int (*real)(int, const struct sigaction *, struct sigaction *);
+  if (!real) {
+    union { void *p; int (*f)(int, const struct sigaction *,
+                              struct sigaction *); } u;
+    u.p = dlsym(RTLD_NEXT, "sigaction");
+    real = u.f;
+  }
+  if (!shim_active || sig != SIGSEGV)
+    return real(sig, act, old);
+  if (old) *old = guest_segv;
+  if (act) guest_segv = *act;
+  return 0;
+}
+
+sighandler_t signal(int sig, sighandler_t fn) {
+  if (!shim_active || sig != SIGSEGV) {
+    struct sigaction sa, osa;
+    memset(&sa, 0, sizeof sa);
+    sa.sa_handler = fn;
+    sa.sa_flags = SA_RESTART;
+    sigemptyset(&sa.sa_mask);
+    if (sigaction(sig, &sa, &osa) != 0) return SIG_ERR;
+    return osa.sa_handler;
+  }
+  sighandler_t prev = guest_segv.sa_handler;
+  memset(&guest_segv, 0, sizeof guest_segv);
+  guest_segv.sa_handler = fn;
+  return prev;
+}
 
 static int64_t emulated_now_ns(void) {
   if (shim_time_page) return *shim_time_page;
@@ -212,6 +333,8 @@ static int install_seccomp(void) {
 __attribute__((constructor)) static void shim_init(void) {
   const char *on = getenv("SHADOW_SHIM");
   if (!on || on[0] != '1') return; /* not under the simulator */
+  shim_real_pid = raw3(SYS_getpid, 0, 0, 0); /* pre-seccomp: real ids */
+  shim_real_tid = raw3(SYS_gettid, 0, 0, 0);
 
   const char *shm = getenv("SHADOW_TIME_SHM");
   if (shm) {
@@ -229,6 +352,18 @@ __attribute__((constructor)) static void shim_init(void) {
   sa.sa_flags = SA_SIGINFO | SA_NODEFER;
   sigemptyset(&sa.sa_mask);
   if (sigaction(SIGSYS, &sa, NULL) != 0) _exit(124);
+
+  /* TSC virtualization: raw rdtsc/rdtscp fault into sigsegv_handler and
+   * read simulated time. Best-effort — PR_SET_TSC is x86-64-specific. */
+  struct sigaction tsa;
+  memset(&tsa, 0, sizeof tsa);
+  tsa.sa_sigaction = sigsegv_handler;
+  /* SA_ONSTACK: harmless without an altstack, required so guests that
+   * sigaltstack() for stack-overflow recovery still get their handler */
+  tsa.sa_flags = SA_SIGINFO | SA_ONSTACK;
+  sigemptyset(&tsa.sa_mask);
+  if (sigaction(SIGSEGV, &tsa, NULL) == 0)
+    prctl(PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0);
 
   shim_active = 1;
   /* handshake: block until the simulation's spawn event grants the turn */

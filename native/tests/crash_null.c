@@ -1,0 +1,16 @@
+/* crash_null — negative-path test program: dereferences an unmapped page
+ * with NO handler installed. Natively and under the simulator alike this
+ * must DIE with SIGSEGV (the shim's TSC-trap handler must not swallow or
+ * loop on a genuine fault it doesn't own).
+ */
+#include <stdio.h>
+
+int main(void) {
+  volatile int *bad;
+  __asm__ volatile("mov $8, %0" : "=r"(bad));
+  printf("about-to-crash\n");
+  fflush(stdout);
+  (void)*bad;
+  printf("survived\n"); /* must never print */
+  return 0;
+}

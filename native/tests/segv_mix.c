@@ -1,0 +1,66 @@
+/* segv_mix — SIGSEGV-handler coexistence test program: installs its own
+ * SIGSEGV handler (sigaction, SA_SIGINFO), recovers from a deliberate bad
+ * dereference via siglongjmp, and then reads the TSC around a 100 ms
+ * nanosleep. Natively this just works; under the simulator the shim must
+ * chain the genuine fault to this handler while KEEPING rdtsc
+ * virtualization active afterward (delta exactly 100000000 at 1 GHz).
+ */
+#include <setjmp.h>
+#include <signal.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <time.h>
+
+static sigjmp_buf env;
+static volatile int caught;
+
+static void on_segv(int sig, siginfo_t *info, void *ctx) {
+  (void)sig;
+  (void)info;
+  (void)ctx;
+  caught = 1;
+  siglongjmp(env, 1);
+}
+
+static inline uint64_t rdtsc(void) {
+  uint32_t lo, hi;
+  __asm__ volatile("rdtsc" : "=a"(lo), "=d"(hi));
+  return ((uint64_t)hi << 32) | lo;
+}
+
+int main(void) {
+  struct sigaction sa;
+  sa.sa_sigaction = on_segv;
+  sa.sa_flags = SA_SIGINFO;
+  sigemptyset(&sa.sa_mask);
+  if (sigaction(SIGSEGV, &sa, NULL) != 0) {
+    perror("sigaction");
+    return 1;
+  }
+
+  if (sigsetjmp(env, 1) == 0) {
+    /* opaque so the compiler can't prove the dereference is out of bounds */
+    volatile int *bad;
+    __asm__ volatile("mov $8, %0" : "=r"(bad));
+    (void)*bad; /* unmapped page */
+    fprintf(stderr, "fault did not fire\n");
+    return 1;
+  }
+  if (!caught) {
+    fprintf(stderr, "handler not reached\n");
+    return 1;
+  }
+  printf("fault-recovered\n");
+
+  uint64_t t0 = rdtsc();
+  struct timespec ts = {0, 100000000};
+  nanosleep(&ts, NULL);
+  uint64_t t1 = rdtsc();
+  if (t1 <= t0) {
+    fprintf(stderr, "non-monotonic tsc after recovery\n");
+    return 1;
+  }
+  printf("delta_cycles=%llu\n", (unsigned long long)(t1 - t0));
+  printf("ok\n");
+  return 0;
+}

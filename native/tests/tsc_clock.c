@@ -1,0 +1,37 @@
+/* tsc_clock — raw TSC timing test program: reads rdtsc/rdtscp around a
+ * 100 ms nanosleep and reports the cycle delta. Natively the delta is
+ * whatever the hardware counter says (positive, frequency-dependent);
+ * under the simulator PR_SET_TSC traps both instructions and the shim
+ * serves simulated nanoseconds at a nominal 1 GHz, so the delta is
+ * EXACTLY 100000000 — the definitive "even the TSC follows sim time".
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <time.h>
+
+static inline uint64_t rdtsc(void) {
+  uint32_t lo, hi;
+  __asm__ volatile("rdtsc" : "=a"(lo), "=d"(hi));
+  return ((uint64_t)hi << 32) | lo;
+}
+
+static inline uint64_t rdtscp(void) {
+  uint32_t lo, hi, aux;
+  __asm__ volatile("rdtscp" : "=a"(lo), "=d"(hi), "=c"(aux));
+  return ((uint64_t)hi << 32) | lo;
+}
+
+int main(void) {
+  uint64_t t0 = rdtsc();
+  struct timespec ts = {0, 100000000};
+  nanosleep(&ts, NULL);
+  uint64_t t1 = rdtscp();
+  if (t1 <= t0) {
+    fprintf(stderr, "non-monotonic tsc: %llu -> %llu\n",
+            (unsigned long long)t0, (unsigned long long)t1);
+    return 1;
+  }
+  printf("delta_cycles=%llu\n", (unsigned long long)(t1 - t0));
+  printf("ok\n");
+  return 0;
+}

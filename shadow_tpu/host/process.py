@@ -109,6 +109,16 @@ class ProcessLifecycle:
                 return (f"{self.host.name}/{self.name}: expected exit {want}, "
                         f"got {self.exit_code}")
             return None
+        if isinstance(exp, dict) and "signaled" in exp:
+            # native managed processes record signal deaths as -signum
+            want = -int(exp["signaled"])
+            if self.running:
+                return (f"{self.host.name}/{self.name}: expected signal "
+                        f"{-want}, still running")
+            if self.exit_code != want:
+                return (f"{self.host.name}/{self.name}: expected signal "
+                        f"{-want}, got exit code {self.exit_code}")
+            return None
         return f"{self.host.name}/{self.name}: unrecognized expected_final_state {exp!r}"
 
 

@@ -318,3 +318,83 @@ def test_iov_msg_managed_through_simulated_network():
     assert "iov-complete bytes=250000" in out, out
     for h in c.hosts:
         assert h._conns == {}, h.name
+
+
+# ---- TSC virtualization ---------------------------------------------------
+
+def test_tsc_clock_native_oracle():
+    """rdtsc/rdtscp against the real hardware counter: positive delta
+    across a 100 ms sleep (frequency-dependent, so no exact value)."""
+    r = subprocess.run([str(BUILD / "tsc_clock")], capture_output=True,
+                       text=True, timeout=30)
+    assert r.returncode == 0, r.stderr
+    assert "ok" in r.stdout
+    delta = int(r.stdout.split("delta_cycles=")[1].split()[0])
+    assert delta > 0
+
+
+def test_tsc_clock_managed_follows_sim_time():
+    """Under PR_SET_TSC trapping, raw TSC reads are served from the
+    simulated clock at a nominal 1 GHz: the delta across a 100 ms
+    simulated nanosleep is EXACTLY 100000000 cycles."""
+    cfg_text = SLEEP_CFG.replace("sleep_clock", "tsc_clock")
+    cfg = parse_config(yaml.safe_load(cfg_text), {
+        "general.data_directory": "/tmp/st-native-tsc",
+    })
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    out = Path("/tmp/st-native-tsc/hosts/box/tsc_clock.0.stdout").read_text()
+    assert "ok" in out, out
+    assert "delta_cycles=100000000\n" in out, out
+
+
+def test_segv_mix_native_oracle():
+    """The guest's own SIGSEGV handler + rdtsc against the real kernel."""
+    r = subprocess.run([str(BUILD / "segv_mix")], capture_output=True,
+                       text=True, timeout=30)
+    assert r.returncode == 0, r.stderr
+    assert "fault-recovered" in r.stdout and "ok" in r.stdout
+
+
+def test_segv_mix_managed_chains_guest_handler():
+    """A guest that installs its own SIGSEGV handler still recovers from a
+    genuine fault (the shim chains to it) AND keeps virtualized TSC
+    afterward — the exact-delta assertion proves the shim's handler
+    remained first in line."""
+    cfg_text = SLEEP_CFG.replace("sleep_clock", "segv_mix")
+    cfg = parse_config(yaml.safe_load(cfg_text), {
+        "general.data_directory": "/tmp/st-native-segvmix",
+    })
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    out = Path("/tmp/st-native-segvmix/hosts/box/segv_mix.0.stdout").read_text()
+    assert "fault-recovered" in out, out
+    assert "delta_cycles=100000000\n" in out, out
+    assert "ok" in out
+
+
+def test_crash_null_native_oracle():
+    """No handler + wild dereference dies with SIGSEGV natively."""
+    r = subprocess.run([str(BUILD / "crash_null")], capture_output=True,
+                       text=True, timeout=30)
+    assert r.returncode == -11, r.returncode
+
+
+def test_crash_null_managed_still_crashes():
+    """The shim's SIGSEGV-based TSC trap must not swallow (or spin on) a
+    genuine unhandled fault: the managed guest dies with SIGSEGV and the
+    config's {signaled: 11} expectation validates it."""
+    cfg_text = SLEEP_CFG.replace("sleep_clock", "crash_null").replace(
+        "expected_final_state: {exited: 0}",
+        "expected_final_state: {signaled: 11}")
+    cfg = parse_config(yaml.safe_load(cfg_text), {
+        "general.data_directory": "/tmp/st-native-crash",
+    })
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    out = Path("/tmp/st-native-crash/hosts/box/crash_null.0.stdout").read_text()
+    assert "about-to-crash" in out
+    assert "survived" not in out
