@@ -35,7 +35,10 @@
 #include <linux/audit.h>
 #include <linux/filter.h>
 #include <linux/seccomp.h>
+#include <pthread.h>
 #include <signal.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
 #include <stddef.h>
 #include <stdint.h>
 #include <stdlib.h>
@@ -48,8 +51,14 @@
 #include <unistd.h>
 
 #define SHIM_IPC_FD 995          /* worker dup2()s the socketpair here   */
+#define SHIM_IPC_LOW 964         /* per-thread channels live in [LOW, 995] */
 #define SHIM_VFD_BASE 0x100000   /* fds >= this are simulated sockets    */
 #define SHIM_HELLO 0xFFFFFFFFu
+/* thread-management pseudo-syscalls (worker analogs in native/managed.py) */
+#define SHIM_SPAWN_THREAD 0xFFFFFFF0u
+#define SHIM_THREAD_HELLO 0xFFFFFFF1u
+#define SHIM_THREAD_JOIN 0xFFFFFFF2u
+#define SHIM_THREAD_EXIT 0xFFFFFFF3u
 
 struct shim_req { uint64_t nr; uint64_t args[6]; };
 
@@ -57,6 +66,9 @@ static volatile int64_t *shim_time_page; /* emulated ns since UNIX epoch */
 static int shim_active;
 static long shim_real_pid, shim_real_tid; /* cached pre-seccomp: the trapped
                                              getpid/gettid return vpids */
+/* each guest thread talks to the worker over its own channel (strict
+ * turn-taking needs per-thread wakeups); main uses the spawn-time fd */
+static __thread int shim_tls_fd = SHIM_IPC_FD;
 
 /* raw syscalls only — the shim must not recurse through libc wrappers */
 static long raw3(long nr, long a, long b, long c) {
@@ -71,7 +83,7 @@ static long raw3(long nr, long a, long b, long c) {
 static int write_all(const void *buf, size_t n) {
   const char *p = buf;
   while (n) {
-    long r = raw3(SYS_write, SHIM_IPC_FD, (long)p, (long)n);
+    long r = raw3(SYS_write, shim_tls_fd, (long)p, (long)n);
     if (r < 0) { if (r == -EINTR) continue; return -1; }
     p += r; n -= (size_t)r;
   }
@@ -81,7 +93,7 @@ static int write_all(const void *buf, size_t n) {
 static int read_all(void *buf, size_t n) {
   char *p = buf;
   while (n) {
-    long r = raw3(SYS_read, SHIM_IPC_FD, (long)p, (long)n);
+    long r = raw3(SYS_read, shim_tls_fd, (long)p, (long)n);
     if (r < 0) { if (r == -EINTR) continue; return -1; }
     if (r == 0) raw3(SYS_exit_group, 125, 0, 0); /* worker vanished */
     p += r; n -= (size_t)r;
@@ -102,6 +114,29 @@ static void sigsys_handler(int signo, siginfo_t *info, void *vctx) {
   (void)signo;
   ucontext_t *ctx = vctx;
   greg_t *g = ctx->uc_mcontext.gregs;
+  if (info->si_syscall == SYS_rt_sigprocmask) {
+    /* Emulated SHIM-SIDE by editing the signal frame's uc_sigmask (the
+     * mask sigreturn restores) — never with a real syscall, which would
+     * re-trap forever. Crucially SIGSYS/SIGSEGV are ALWAYS left unblocked:
+     * glibc's pthread_create blocks every signal around clone, and a
+     * seccomp trap while SIGSYS is blocked force-kills the process. */
+    uint64_t how = g[REG_RDI], set = g[REG_RSI], old = g[REG_RDX];
+    uint64_t cur;
+    memcpy(&cur, &ctx->uc_sigmask, 8);
+    if (old) memcpy((void *)old, &cur, 8);
+    if (set) {
+      uint64_t m;
+      memcpy(&m, (const void *)set, 8);
+      if (how == SIG_BLOCK) cur |= m;
+      else if (how == SIG_UNBLOCK) cur &= ~m;
+      else if (how == SIG_SETMASK) cur = m;
+      else { g[REG_RAX] = (greg_t)-EINVAL; return; }
+      cur &= ~((1ULL << (SIGSYS - 1)) | (1ULL << (SIGSEGV - 1)));
+      memcpy(&ctx->uc_sigmask, &cur, 8);
+    }
+    g[REG_RAX] = 0;
+    return;
+  }
   int64_t ret = forward((uint64_t)info->si_syscall, (uint64_t)g[REG_RDI],
                         (uint64_t)g[REG_RSI], (uint64_t)g[REG_RDX],
                         (uint64_t)g[REG_R10], (uint64_t)g[REG_R8],
@@ -199,15 +234,8 @@ static void sigsegv_handler(int signo, siginfo_t *info, void *vctx) {
  * installed — the shim's handler stays first and chains (above). */
 
 int sigaction(int sig, const struct sigaction *act, struct sigaction *old) {
-  static int (*real)(int, const struct sigaction *, struct sigaction *);
-  if (!real) {
-    union { void *p; int (*f)(int, const struct sigaction *,
-                              struct sigaction *); } u;
-    u.p = dlsym(RTLD_NEXT, "sigaction");
-    real = u.f;
-  }
   if (!shim_active || sig != SIGSEGV)
-    return real(sig, act, old);
+    return real_sigaction(sig, act, old);
   if (old) *old = guest_segv;
   if (act) guest_segv = *act;
   return 0;
@@ -260,6 +288,124 @@ time_t time(time_t *out) {
   return t;
 }
 
+/* ---- guest threads ------------------------------------------------------
+ *
+ * Reference analog: ManagedThread (SURVEY.md §2). The worker enforces
+ * strict one-runnable-thread turn-taking, so every thread needs its own
+ * wakeup channel: pthread_create is interposed; the worker mints a fresh
+ * socketpair and hands the guest end back as SCM_RIGHTS ancillary data on
+ * the SPAWN reply; the new thread pins it at a reserved fd (995 - slot,
+ * inside the seccomp-allowed [964, 995] window), checks in with
+ * THREAD_HELLO (its reply is the first turn grant), runs the app start
+ * routine, and announces THREAD_EXIT so joiners parked at the worker wake
+ * in sim time. CLONE_THREAD clones run natively; futex is trapped and
+ * emulated worker-side so lock handoffs between parked threads cannot
+ * deadlock the turn-taking. Scope: up to 31 extra threads; raw clone(2)
+ * users and fork are still rejected loudly. */
+
+#define SHIM_MAX_THREADS 32
+struct shim_tramp { void *(*fn)(void *); void *arg; int fd; };
+static pthread_t shim_thread_ids[SHIM_MAX_THREADS]; /* slot -> pthread_t */
+
+static long shim_spawn_channel(void) {
+  struct shim_req rq = {SHIM_SPAWN_THREAD, {0, 0, 0, 0, 0, 0}};
+  if (write_all(&rq, sizeof rq) != 0) return -1;
+  int64_t slot = -1;
+  char cbuf[CMSG_SPACE(sizeof(int))];
+  struct iovec iov = {&slot, 8};
+  struct msghdr mh;
+  memset(&mh, 0, sizeof mh);
+  mh.msg_iov = &iov;
+  mh.msg_iovlen = 1;
+  mh.msg_control = cbuf;
+  mh.msg_controllen = sizeof cbuf;
+  long r = raw3(SYS_recvmsg, shim_tls_fd, (long)&mh, 0);
+  if (r != 8 || slot < 0 || slot >= SHIM_MAX_THREADS) return -1;
+  struct cmsghdr *c = CMSG_FIRSTHDR(&mh);
+  if (!c || c->cmsg_type != SCM_RIGHTS) return -1;
+  int newfd;
+  memcpy(&newfd, CMSG_DATA(c), sizeof newfd);
+  int want = SHIM_IPC_FD - (int)slot;
+  if (newfd != want) {
+    raw3(SYS_dup2, newfd, want, 0);
+    raw3(SYS_close, newfd, 0, 0);
+  }
+  return slot;
+}
+
+static void *shim_thread_tramp(void *p) {
+  struct shim_tramp t = *(struct shim_tramp *)p;
+  free(p);
+  shim_tls_fd = t.fd;
+  forward(SHIM_THREAD_HELLO, 0, 0, 0, 0, 0, 0); /* blocks for first turn */
+  void *r = t.fn(t.arg);
+  forward(SHIM_THREAD_EXIT, (uint64_t)r, 0, 0, 0, 0, 0);
+  return r;
+}
+
+int pthread_create(pthread_t *out, const pthread_attr_t *attr,
+                   void *(*fn)(void *), void *arg) {
+  static int (*real)(pthread_t *, const pthread_attr_t *,
+                     void *(*)(void *), void *);
+  if (!real) {
+    union { void *p; int (*f)(pthread_t *, const pthread_attr_t *,
+                              void *(*)(void *), void *); } u;
+    u.p = dlsym(RTLD_NEXT, "pthread_create");
+    real = u.f;
+  }
+  if (!shim_active) return real(out, attr, fn, arg);
+  long slot = shim_spawn_channel();
+  if (slot < 0) return EAGAIN;
+  struct shim_tramp *t = malloc(sizeof *t);
+  if (!t) return EAGAIN;
+  t->fn = fn;
+  t->arg = arg;
+  t->fd = SHIM_IPC_FD - (int)slot;
+  int rc = real(out, attr, shim_thread_tramp, t);
+  if (rc != 0) free(t); /* worker-side slot leaks; process is dying anyway */
+  else shim_thread_ids[slot] = *out;
+  return rc;
+}
+
+int pthread_join(pthread_t th, void **retval) {
+  static int (*real)(pthread_t, void **);
+  static int (*real_detach)(pthread_t);
+  if (!real) {
+    union { void *p; int (*f)(pthread_t, void **); } u;
+    u.p = dlsym(RTLD_NEXT, "pthread_join");
+    real = u.f;
+    union { void *p; int (*f)(pthread_t); } v;
+    v.p = dlsym(RTLD_NEXT, "pthread_detach");
+    real_detach = v.f;
+  }
+  if (!shim_active) return real(th, retval);
+  int slot = -1;
+  for (int i = 1; i < SHIM_MAX_THREADS; i++)
+    if (shim_thread_ids[i] == th) { slot = i; break; }
+  if (slot < 0) return real(th, retval);
+  int64_t rv = forward(SHIM_THREAD_JOIN, (uint64_t)slot, 0, 0, 0, 0, 0);
+  if (retval) *retval = (void *)rv;
+  shim_thread_ids[slot] = 0;
+  /* the thread has (or is about to) exit natively; detach instead of a
+   * real join — glibc's join would FUTEX_WAIT on the kernel-cleared tid,
+   * a wake our trapped-futex emulation cannot observe */
+  real_detach(th);
+  return 0;
+}
+
+void pthread_exit(void *retval) {
+  static void (*real)(void *) __attribute__((noreturn));
+  if (!real) {
+    union { void *p; void (*f)(void *) __attribute__((noreturn)); } u;
+    u.p = dlsym(RTLD_NEXT, "pthread_exit");
+    real = u.f;
+  }
+  if (shim_active && shim_tls_fd != SHIM_IPC_FD)
+    forward(SHIM_THREAD_EXIT, (uint64_t)retval, 0, 0, 0, 0, 0);
+  real(retval);
+  __builtin_unreachable();
+}
+
 /* ---- seccomp filter ----------------------------------------------------- */
 
 #define BPF_NR (offsetof(struct seccomp_data, nr))
@@ -270,53 +416,65 @@ time_t time(time_t *out) {
 #define RET(v) BPF_STMT(BPF_RET | BPF_K, (v))
 #define JEQ(v, t, f) BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, (v), (t), (f))
 #define JGE(v, t, f) BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, (v), (t), (f))
+#define JSET(v, t, f) BPF_JUMP(BPF_JMP | BPF_JSET | BPF_K, (v), (t), (f))
 
 static int install_seccomp(void) {
   /* BEGIN GENERATED BPF (tools/gen_bpf.py) */
-  struct sock_filter prog[] = {  /* 47 instructions */
+  struct sock_filter prog[] = {  /* 58 instructions */
       LD(BPF_ARCHF),
-      JEQ(AUDIT_ARCH_X86_64, 0, 44),
+      JEQ(AUDIT_ARCH_X86_64, 0, 55),
       LD(BPF_NR),
-      JEQ(0, 31, 0),  /* read */
-      JEQ(1, 34, 0),  /* write */
-      JEQ(19, 29, 0),  /* readv */
-      JEQ(20, 32, 0),  /* writev */
-      JEQ(3, 35, 0),  /* close */
-      JEQ(16, 34, 0),  /* ioctl */
-      JEQ(72, 33, 0),  /* fcntl */
-      JEQ(35, 34, 0),  /* nanosleep */
-      JEQ(230, 33, 0),  /* clock_nanosleep */
-      JEQ(228, 32, 0),  /* clock_gettime */
-      JEQ(96, 31, 0),  /* gettimeofday */
-      JEQ(201, 30, 0),  /* time */
-      JEQ(318, 29, 0),  /* getrandom */
-      JEQ(7, 28, 0),  /* poll */
-      JEQ(271, 27, 0),  /* ppoll */
-      JEQ(213, 26, 0),  /* epoll_create */
-      JEQ(291, 25, 0),  /* epoll_create1 */
-      JEQ(233, 24, 0),  /* epoll_ctl */
-      JEQ(232, 23, 0),  /* epoll_wait */
-      JEQ(281, 22, 0),  /* epoll_pwait */
-      JEQ(288, 21, 0),  /* accept4 */
-      JEQ(435, 20, 0),  /* clone3 */
-      JEQ(39, 19, 0),  /* getpid */
-      JEQ(110, 18, 0),  /* getppid */
-      JEQ(186, 17, 0),  /* gettid */
-      JEQ(283, 16, 0),  /* timerfd_create */
-      JEQ(286, 15, 0),  /* timerfd_settime */
-      JEQ(287, 14, 0),  /* timerfd_gettime */
-      JEQ(284, 13, 0),  /* eventfd */
-      JEQ(290, 12, 0),  /* eventfd2 */
-      JGE(41, 0, 12),  /* socket */
-      JGE(60, 11, 10),  /* clone_end */
+      JEQ(0, 35, 0),  /* read */
+      JEQ(1, 39, 0),  /* write */
+      JEQ(19, 33, 0),  /* readv */
+      JEQ(20, 37, 0),  /* writev */
+      JEQ(3, 46, 0),  /* close */
+      JEQ(16, 45, 0),  /* ioctl */
+      JEQ(72, 44, 0),  /* fcntl */
+      JEQ(35, 45, 0),  /* nanosleep */
+      JEQ(230, 44, 0),  /* clock_nanosleep */
+      JEQ(228, 43, 0),  /* clock_gettime */
+      JEQ(96, 42, 0),  /* gettimeofday */
+      JEQ(201, 41, 0),  /* time */
+      JEQ(318, 40, 0),  /* getrandom */
+      JEQ(7, 39, 0),  /* poll */
+      JEQ(271, 38, 0),  /* ppoll */
+      JEQ(213, 37, 0),  /* epoll_create */
+      JEQ(291, 36, 0),  /* epoll_create1 */
+      JEQ(233, 35, 0),  /* epoll_ctl */
+      JEQ(232, 34, 0),  /* epoll_wait */
+      JEQ(281, 33, 0),  /* epoll_pwait */
+      JEQ(288, 32, 0),  /* accept4 */
+      JEQ(435, 31, 0),  /* clone3 */
+      JEQ(39, 30, 0),  /* getpid */
+      JEQ(110, 29, 0),  /* getppid */
+      JEQ(186, 28, 0),  /* gettid */
+      JEQ(283, 27, 0),  /* timerfd_create */
+      JEQ(286, 26, 0),  /* timerfd_settime */
+      JEQ(287, 25, 0),  /* timerfd_gettime */
+      JEQ(284, 24, 0),  /* eventfd */
+      JEQ(290, 23, 0),  /* eventfd2 */
+      JEQ(202, 22, 0),  /* futex */
+      JEQ(14, 21, 0),  /* rt_sigprocmask */
+      JEQ(47, 13, 0),  /* recvmsg */
+      JEQ(56, 15, 0),  /* clone */
+      JGE(41, 0, 19),  /* socket */
+      JGE(60, 18, 17),  /* clone_end */
       LD(BPF_ARG0),
-      JEQ(SHIM_IPC_FD, 9, 0),
-      JEQ(0, 7, 0),  /* read */
-      JGE(SHIM_VFD_BASE, 6, 7),
+      JGE(SHIM_IPC_LOW, 0, 1),
+      JGE((SHIM_IPC_FD + 1), 0, 15),
+      JEQ(0, 13, 0),  /* read */
+      JGE(SHIM_VFD_BASE, 12, 13),
       LD(BPF_ARG0),
-      JEQ(SHIM_IPC_FD, 5, 0),
-      JGE(3, 0, 3),  /* close */
-      JGE(SHIM_VFD_BASE, 2, 3),
+      JGE(SHIM_IPC_LOW, 0, 1),
+      JGE((SHIM_IPC_FD + 1), 0, 10),
+      JGE(3, 0, 8),  /* close */
+      JGE(SHIM_VFD_BASE, 7, 8),
+      LD(BPF_ARG0),
+      JGE(SHIM_IPC_LOW, 0, 5),
+      JGE((SHIM_IPC_FD + 1), 4, 5),
+      LD(BPF_ARG0),
+      JSET(65536, 3, 2),  /* CLONE_THREAD */
       LD(BPF_ARG0),
       JGE(SHIM_VFD_BASE, 0, 1),
       RET(SECCOMP_RET_TRAP),

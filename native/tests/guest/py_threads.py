@@ -1,0 +1,21 @@
+import threading
+import time
+
+results = []
+lock = threading.Lock()
+
+def worker(i):
+    time.sleep(0.05 * (i + 1))
+    with lock:
+        results.append((i, time.monotonic()))
+
+t0 = time.monotonic()
+threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+elapsed_ms = int((time.monotonic() - t0) * 1000)
+order = [i for i, _ in sorted(results, key=lambda x: x[1])]
+print(f"order={order} n={len(results)} elapsed_ms={elapsed_ms}")
+print("ok")

@@ -43,6 +43,17 @@ from shadow_tpu.native.memory import ProcessMemory
 SHIM_IPC_FD = 995
 VFD_BASE = 0x100000
 HELLO = 0xFFFFFFFF
+# thread-management pseudo-syscalls (shim-side analogs in native/shim/shim.c)
+SPAWN_THREAD = 0xFFFFFFF0  # -> reply carries slot + SCM_RIGHTS channel fd
+THREAD_HELLO = 0xFFFFFFF1  # new thread checks in; reply is its first turn
+THREAD_JOIN = 0xFFFFFFF2   # arg0 = slot; reply is the thread's retval
+THREAD_EXIT = 0xFFFFFFF3   # arg0 = retval; thread finishes dying natively
+MAX_THREADS = 32           # slots 1..31 map to shim fds 994..964
+SYS_futex = 202
+FUTEX_WAIT, FUTEX_WAKE, FUTEX_REQUEUE, FUTEX_CMP_REQUEUE = 0, 1, 3, 4
+FUTEX_WAKE_OP, FUTEX_WAIT_BITSET, FUTEX_WAKE_BITSET = 5, 9, 10
+FUTEX_CLOCK_REALTIME = 256
+FUTEX_BITSET_ALL = 0xFFFFFFFF
 
 # x86-64 syscall numbers
 SYS_read, SYS_write, SYS_close = 0, 1, 3
@@ -78,6 +89,10 @@ ENOSYS, ENOTCONN, ECONNRESET, ETIMEDOUT, EAFNOSUPPORT, ENETUNREACH = (
     38, 107, 104, 110, 97, 101)
 
 _BLOCK = object()  # service() sentinel: no reply yet, process parked
+_DETACH = object()  # service() sentinel: reply 0, then stop reading this
+                    # thread's channel forever (it announced its exit)
+_REPLIED = object()  # service() sentinel: reply already sent inline
+_EMBRYO = object()  # ready-queue sentinel: read THREAD_HELLO before granting
 
 #: spawn serialization: the child end of the socketpair rides a FIXED fd
 #: number (the seccomp filter bakes it in), so concurrent spawns on
@@ -156,6 +171,28 @@ class VSocket:
         self.evt_counter = 0
 
 
+class GuestThread:
+    """One thread of a managed guest: its IPC channel + scheduling state.
+
+    Reference analog: ManagedThread (SURVEY.md §2 "Process / ManagedThread").
+    Exactly one thread of a process runs at a time (strict turn-taking);
+    the rest are parked either on a sim continuation (``waiting``) or in
+    the ready queue awaiting their turn grant.
+    """
+
+    __slots__ = ("slot", "sock", "waiting", "dead", "retval", "joiners",
+                 "joined")
+
+    def __init__(self, slot: int, sock: socket.socket) -> None:
+        self.slot = slot
+        self.sock = sock
+        self.waiting = None  # (kind, ...) while parked on a continuation
+        self.dead = False
+        self.retval = 0  # pthread-style exit value (int64, reply-ready)
+        self.joiners: list = []  # GuestThreads parked in join on this one
+        self.joined = False  # slot recyclable only once dead AND joined
+
+
 class ManagedProcess(ProcessLifecycle):
     """Lifecycle + syscall service for one real executable in the sim.
 
@@ -178,7 +215,13 @@ class ManagedProcess(ProcessLifecycle):
         self.fds: dict[int, VSocket] = {}
         self._next_vfd = VFD_BASE
         self._files: dict[int, object] = {}  # 1/2 -> open capture files
-        self._waiting = None  # (kind, ...) while parked
+        # threading state: slot -> GuestThread; _cur = thread being serviced
+        self.threads: dict[int, GuestThread] = {}
+        self._cur: Optional[GuestThread] = None
+        self._next_slot = 1
+        self._ready: list = []  # (thread, reply) queue awaiting turn grants
+        self._pumping = False
+        self.futexes: dict[int, list] = {}  # uaddr -> [(thread, mask), ...]
         self._strace = None  # open file when strace_logging_mode != off
         gen = host.controller.cfg.general
         self._syscall_latency = 1000 if gen.model_unblocked_syscall_latency else 0
@@ -187,6 +230,29 @@ class ManagedProcess(ProcessLifecycle):
         # deterministic virtual pid (real pids would leak host scheduling
         # nondeterminism into any guest that prints or hashes its pid)
         self.vpid = 1000 + host.id * 64 + index
+
+    # the syscall-service sites park/peek the CURRENT thread's wait state;
+    # continuations instead search all threads via _find_waiter
+    @property
+    def _waiting(self):
+        return self._cur.waiting if self._cur is not None else None
+
+    @_waiting.setter
+    def _waiting(self, value):
+        self._cur.waiting = value
+
+    def _find_waiter(self, *preds):
+        """First parked thread (slot order) whose wait matches: each pred is
+        (kinds, obj) — wait[0] in kinds and (obj is None or wait[1] is obj)."""
+        for slot in sorted(self.threads):
+            th = self.threads[slot]
+            w = th.waiting
+            if w is None or th.dead:
+                continue
+            for kinds, obj in preds:
+                if w[0] in kinds and (obj is None or w[1] is obj):
+                    return th, w
+        return None, None
 
     # -- lifecycle ---------------------------------------------------------
     def spawn(self) -> None:
@@ -229,6 +295,8 @@ class ManagedProcess(ProcessLifecycle):
                 os.dup2(devnull, SHIM_IPC_FD)  # restore the reservation
                 os.close(devnull)
         self.sock = parent
+        self.threads = {0: GuestThread(0, parent)}
+        self._cur = self.threads[0]
         self.mem = ProcessMemory(self.proc.pid)
         self.running = True
         self.host.counters.add("processes_spawned", 1)
@@ -243,9 +311,10 @@ class ManagedProcess(ProcessLifecycle):
 
         # handshake with a real-time bound: a binary the preload cannot
         # enter (static link, setuid) would otherwise hang the scheduler
+        main = self.threads[0]
         self.sock.settimeout(HANDSHAKE_TIMEOUT_S)
         try:
-            req = self._read_req()
+            req = self._read_req(main)
         finally:
             self.sock.settimeout(None)
         if req is None or req[0] != HELLO:
@@ -255,8 +324,7 @@ class ManagedProcess(ProcessLifecycle):
                 f"{self.host.name}/{self.name}: shim handshake failed — is "
                 f"{self.opts.path!r} dynamically linked? (LD_PRELOAD cannot "
                 f"enter static or setuid binaries)")
-        self._reply(0)  # grant the first turn
-        self._pump()
+        self._resume(main, 0)  # grant the first turn and pump
 
     def shutdown(self) -> None:
         if self.running and self.proc is not None:
@@ -281,11 +349,11 @@ class ManagedProcess(ProcessLifecycle):
             self._exited()
 
     # -- IPC ---------------------------------------------------------------
-    def _read_req(self):
+    def _read_req(self, th: GuestThread):
         buf = b""
         while len(buf) < 56:
             try:
-                chunk = self.sock.recv(56 - len(buf))
+                chunk = th.sock.recv(56 - len(buf))
             except socket.timeout:
                 return None
             except OSError:
@@ -297,16 +365,21 @@ class ManagedProcess(ProcessLifecycle):
         args = struct.unpack_from("<6Q", buf, 8)
         return nr, args
 
-    def _reply(self, ret: int) -> None:
+    def _reply(self, th: GuestThread, ret: int) -> None:
         self._time_map[:8] = struct.pack("<q", emulated(self.host.now))
-        self.sock.sendall(struct.pack("<q", ret))
+        th.sock.sendall(struct.pack("<q", ret))
 
-    def _pump(self) -> None:
-        """Service syscalls until the process blocks in sim time or exits."""
+    def _pump(self, th: GuestThread) -> None:
+        """Service one thread's syscalls until it blocks in sim time, yields
+        the turn, or the process exits."""
+        self._cur = th
         while True:
-            req = self._read_req()
+            req = self._read_req(th)
             if req is None:
-                self._exited()
+                if th.slot == 0:
+                    self._exited()  # main channel EOF == process death
+                else:
+                    self._thread_gone(th)
                 return
             nr, args = req
             try:
@@ -316,6 +389,20 @@ class ManagedProcess(ProcessLifecycle):
             if ret is _BLOCK:
                 self._trace(nr, args, "<blocked>")
                 return
+            if ret is _DETACH:
+                # thread announced exit: reply so it can finish dying
+                # natively, then never read its channel again
+                self._trace(nr, args, 0)
+                try:
+                    self._reply(th, 0)
+                except OSError:
+                    pass
+                return
+            if ret is _REPLIED:
+                # service sent its own (ancillary-carrying) reply inline
+                self._trace(nr, args, "<inline>")
+                self.host.counters.add("syscalls", 1)
+                continue
             self._trace(nr, args, ret)
             if self._syscall_latency == 0:
                 # livelock detector: a guest spinning on nonblocking
@@ -339,30 +426,227 @@ class ManagedProcess(ProcessLifecycle):
                 # forward in sim time instead of livelocking the round
                 self.host._now += self._syscall_latency
             try:
-                self._reply(ret)
+                self._reply(th, ret)
             except OSError:
                 self._exited()
                 return
             self.host.counters.add("syscalls", 1)
 
-    def _resume(self, ret: int) -> None:
-        """A continuation fired: reply to the parked syscall, resume pumping."""
-        if not self.running:
+    def _resume(self, th: GuestThread, ret: int) -> None:
+        """A continuation fired for a parked thread: queue its turn grant,
+        and drain the grant queue unless a thread is already being pumped
+        (then the drain happens when the active thread yields)."""
+        if not self.running or th.dead:
             return
-        self._waiting = None
-        self._trace(-1, (), f"<resumed> = {ret}")
+        th.waiting = None
+        self._ready.append((th, ret))
+        if not self._pumping:
+            self._drain_ready()
+
+    def _drain_ready(self) -> None:
+        self._pumping = True
         try:
-            self._reply(ret)
-        except OSError:
-            self._exited()
-            return
-        self.host.counters.add("syscalls", 1)
-        self._pump()
+            while self._ready and self.running:
+                th, ret = self._ready.pop(0)
+                if th.dead:
+                    continue
+                self._cur = th
+                if ret is _EMBRYO:
+                    # first grant of a freshly spawned thread: read its
+                    # THREAD_HELLO (blocks in real time only, bounded —
+                    # the guest's real pthread_create may have FAILED
+                    # after the slot was minted, and then nobody ever
+                    # speaks on this channel)
+                    th.sock.settimeout(HANDSHAKE_TIMEOUT_S)
+                    try:
+                        req = self._read_req(th)
+                    finally:
+                        if th.sock is not None:
+                            th.sock.settimeout(None)
+                    if req is None or req[0] != THREAD_HELLO:
+                        self._thread_gone(th)
+                        continue
+                    th.waiting = None
+                    ret = 0
+                self._trace(-1, (), f"<resumed> = {ret}")
+                try:
+                    self._reply(th, ret)
+                except OSError:
+                    self._exited()
+                    return
+                self.host.counters.add("syscalls", 1)
+                self._pump(th)
+        finally:
+            self._pumping = False
+
+    def _thread_gone(self, th: GuestThread) -> None:
+        """A non-main thread announced exit (or its channel died)."""
+        th.dead = True
+        for q in list(self.futexes.values()):
+            q[:] = [(t, m) for (t, m) in q if t is not th]
+        if th.joiners:
+            th.joined = True
+        for j in th.joiners:
+            self._resume(j, th.retval)
+        th.joiners = []
+
+    # -- guest threads (reference analog: Process/ManagedThread ------------
+    #    per SURVEY.md §2; strict one-runnable-thread turn-taking) ---------
+    def _spawn_thread(self):
+        slot = None
+        # recycle a dead, fully-joined slot first (its worker-side socket
+        # closes here; the guest's dup2 onto the reserved fd replaces the
+        # stale guest end) — the 31-slot window caps CONCURRENT threads,
+        # not threads-over-a-lifetime
+        for s in sorted(self.threads):
+            t = self.threads[s]
+            if s != 0 and t.dead and t.joined and not t.joiners:
+                if t.sock is not None:
+                    t.sock.close()
+                slot = s
+                break
+        if slot is None:
+            if self._next_slot >= MAX_THREADS:
+                return -EAGAIN
+            slot = self._next_slot
+            self._next_slot += 1
+        parent, child = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+        nt = GuestThread(slot, parent)
+        nt.waiting = ("embryo",)  # until its THREAD_HELLO is read
+        self.threads[slot] = nt
+        # reply carries the slot in-band plus the new channel's guest end
+        # as SCM_RIGHTS ancillary data (the shim recvmsg's this one reply)
+        self._time_map[:8] = struct.pack("<q", emulated(self.host.now))
+        socket.send_fds(self._cur.sock, [struct.pack("<q", slot)],
+                        [child.fileno()])
+        child.close()
+        # grant the embryo its first turn once the spawner yields
+        self._ready.append((nt, _EMBRYO))
+        return _REPLIED
+
+    def _join_thread(self, slot: int):
+        target = self.threads.get(slot)
+        if target is None or target is self._cur:
+            return -EINVAL
+        if target.dead:
+            target.joined = True
+            return target.retval
+        target.joiners.append(self._cur)
+        self._waiting = ("join", target)
+        return _BLOCK
+
+    # -- futex emulation (reference analog: syscall handler futex family;
+    #    required so lock handoffs between parked threads cannot deadlock
+    #    the strict turn-taking protocol) ----------------------------------
+    def _futex(self, args):
+        uaddr, val = args[0], args[2] & 0xFFFFFFFF
+        op = args[1] & 0x7F
+        abs_realtime = bool(args[1] & FUTEX_CLOCK_REALTIME)
+        if op in (FUTEX_WAIT, FUTEX_WAIT_BITSET):
+            cur = struct.unpack("<I", self.mem.read(uaddr, 4))[0]
+            if cur != val:
+                return -EAGAIN
+            mask = (args[5] & 0xFFFFFFFF if op == FUTEX_WAIT_BITSET
+                    else FUTEX_BITSET_ALL)
+            if mask == 0:
+                return -EINVAL
+            th = self._cur
+            token = object()
+            if args[3]:  # timeout pointer
+                sec, nsec = struct.unpack("<qq", self.mem.read(args[3], 16))
+                t = sec * NS_PER_SEC + nsec
+                # WAIT: relative. WAIT_BITSET: absolute (either clock maps
+                # to the one emulated timeline; see SYS_clock_gettime)
+                if op == FUTEX_WAIT_BITSET or abs_realtime:
+                    delay = max(0, t - emulated(self.host.now))
+                else:
+                    delay = max(0, t)
+
+                def fire():
+                    w = th.waiting
+                    if w and w[0] == "futex" and w[1] is token:
+                        # w[2], not the original uaddr: a REQUEUE may have
+                        # moved this waiter to another queue since parking
+                        self._futex_remove(w[2], th)
+                        self._resume(th, -ETIMEDOUT)
+
+                self.host.schedule_in(delay, fire)
+            th.waiting = ("futex", token, uaddr)
+            self.futexes.setdefault(uaddr, []).append((th, mask))
+            return _BLOCK
+        if op in (FUTEX_WAKE, FUTEX_WAKE_BITSET):
+            mask = (args[5] & 0xFFFFFFFF if op == FUTEX_WAKE_BITSET
+                    else FUTEX_BITSET_ALL)
+            return self._futex_wake(uaddr, args[2], mask)
+        if op in (FUTEX_REQUEUE, FUTEX_CMP_REQUEUE):
+            if op == FUTEX_CMP_REQUEUE:
+                cur = struct.unpack("<I", self.mem.read(uaddr, 4))[0]
+                if cur != (args[5] & 0xFFFFFFFF):
+                    return -EAGAIN
+            woken = self._futex_wake(uaddr, args[2], FUTEX_BITSET_ALL)
+            moved = 0
+            q = self.futexes.get(uaddr, [])
+            dst = self.futexes.setdefault(args[4], [])
+            while q and moved < args[3]:  # timeout slot doubles as val2
+                t, m = q.pop(0)
+                if t.waiting and t.waiting[0] == "futex":
+                    # retag so timeouts/removals target the new queue
+                    t.waiting = ("futex", t.waiting[1], args[4])
+                dst.append((t, m))
+                moved += 1
+            if not q:
+                self.futexes.pop(uaddr, None)
+            return woken + (moved if op == FUTEX_CMP_REQUEUE else 0)
+        if op == FUTEX_WAKE_OP:
+            enc, uaddr2 = args[5], args[4]
+            o, cmp = (enc >> 28) & 0xF, (enc >> 24) & 0xF
+            oparg, cmparg = (enc >> 12) & 0xFFF, enc & 0xFFF
+            if o & 8:  # FUTEX_OP_OPARG_SHIFT
+                oparg = 1 << (oparg & 31)
+            o &= 7
+            old = struct.unpack("<I", self.mem.read(uaddr2, 4))[0]
+            new = {0: oparg, 1: old + oparg, 2: old | oparg,
+                   3: old & ~oparg, 4: old ^ oparg}.get(o, old)
+            self.mem.write(uaddr2, struct.pack("<I", new & 0xFFFFFFFF))
+            woken = self._futex_wake(uaddr, args[2], FUTEX_BITSET_ALL)
+            hit = {0: old == cmparg, 1: old != cmparg, 2: old < cmparg,
+                   3: old <= cmparg, 4: old > cmparg,
+                   5: old >= cmparg}.get(cmp, False)
+            if hit:
+                woken += self._futex_wake(uaddr2, args[3], FUTEX_BITSET_ALL)
+            return woken
+        return -ENOSYS  # PI / robust futexes: not modeled
+
+    def _futex_wake(self, uaddr: int, nmax: int, mask: int) -> int:
+        q = self.futexes.get(uaddr)
+        if not q:
+            return 0
+        woken, i = 0, 0
+        while i < len(q) and woken < nmax:
+            th, m = q[i]
+            if (m & mask) and not th.dead:
+                q.pop(i)
+                woken += 1
+                self._resume(th, 0)
+            else:
+                i += 1
+        if not q:
+            self.futexes.pop(uaddr, None)
+        return woken
+
+    def _futex_remove(self, uaddr: int, th: GuestThread) -> None:
+        q = self.futexes.get(uaddr)
+        if q:
+            q[:] = [(t, m) for (t, m) in q if t is not th]
+            if not q:
+                self.futexes.pop(uaddr, None)
 
     def _trace(self, nr: int, args, ret) -> None:
         if self._strace is None:
             return
         ts = f"{self.host.now} " if self._strace_times else ""
+        if self._cur is not None and self._cur.slot:
+            ts += f"[t{self._cur.slot}] "
         if nr < 0:
             self._strace.write(f"{ts}{ret}\n")
         else:
@@ -386,6 +670,13 @@ class ManagedProcess(ProcessLifecycle):
             if vs.endpoint is not None:
                 vs.endpoint.close()
         self.fds.clear()
+        for th in self.threads.values():
+            th.dead = True
+            if th.sock is not None and th.sock is not self.sock:
+                th.sock.close()
+                th.sock = None
+        self._ready.clear()
+        self.futexes.clear()
         if self.sock is not None:
             self.sock.close()
             self.sock = None
@@ -406,10 +697,9 @@ class ManagedProcess(ProcessLifecycle):
                     return -EINVAL
                 val = struct.unpack("<Q", self.mem.read(addr, 8))[0]
                 vs.evt_counter += val
-                w = self._waiting
-                if w and w[0] == "cread" and w[1] is vs:
-                    # (cannot happen single-threaded, but keep it sound)
-                    self._resume(self._counter_read(vs, w[2], w[3]))
+                th, w = self._find_waiter((("cread",), vs))
+                if th is not None:
+                    self._resume(th, self._counter_read(vs, w[2], w[3]))
                 else:
                     self._notify()
                 return 8
@@ -454,7 +744,8 @@ class ManagedProcess(ProcessLifecycle):
             if nr == SYS_clock_nanosleep and args[1] & TIMER_ABSTIME:
                 dur = max(0, sec * NS_PER_SEC + nsec - emulated(h.now))
             self._waiting = ("sleep",)
-            h.schedule_in(max(dur, 0), lambda: self._resume(0))
+            th = self._cur
+            h.schedule_in(max(dur, 0), lambda: self._resume(th, 0))
             return _BLOCK
         if nr == SYS_getrandom:
             n = min(args[1], 1 << 16)
@@ -608,9 +899,26 @@ class ManagedProcess(ProcessLifecycle):
             return self._writev(args[0], args[1], args[2])
         if nr == SYS_readv:
             return self._readv(args[0], args[1], args[2])
+        if nr == SPAWN_THREAD:
+            return self._spawn_thread()
+        if nr == THREAD_HELLO:
+            return 0  # the reply itself is this thread's first turn grant
+        if nr == THREAD_JOIN:
+            return self._join_thread(args[0])
+        if nr == THREAD_EXIT:
+            th = self._cur
+            # retval crosses the wire as int64 (negative-encoded pointers
+            # like (void*)-1 are common); store it reply-ready
+            th.retval = (args[0] - (1 << 64) if args[0] >= (1 << 63)
+                         else args[0])
+            self._thread_gone(th)
+            return _DETACH
+        if nr == SYS_futex:
+            return self._futex(args)
         if nr in (SYS_clone, SYS_fork, SYS_vfork, SYS_execve, SYS_clone3):
-            # multi-threaded/forking guests would race the single IPC
-            # channel; fail loudly until per-thread channels exist
+            # CLONE_THREAD clones run natively (pthread_create is
+            # interposed shim-side); fork/exec-style still fail loudly
+            # until per-process channel handoff exists
             return -ENOSYS
         return -ENOSYS
 
@@ -647,18 +955,20 @@ class ManagedProcess(ProcessLifecycle):
         return r
 
     def _notify(self) -> None:
-        """Some vfd's state changed: re-evaluate a parked poll/epoll wait."""
-        w = self._waiting
-        if not w:
-            return
-        if w[0] == "poll":
-            n = self._poll_scan(w[2], w[3])
-            if n:
-                self._resume(n)
-        elif w[0] == "epoll":
-            n = self._epoll_scan(w[2], w[3], w[4])
-            if n:
-                self._resume(n)
+        """Some vfd's state changed: re-evaluate every parked poll/epoll."""
+        for slot in sorted(self.threads):
+            th = self.threads[slot]
+            w = th.waiting
+            if not w or th.dead:
+                continue
+            if w[0] == "poll":
+                n = self._poll_scan(w[2], w[3])
+                if n:
+                    self._resume(th, n)
+            elif w[0] == "epoll":
+                n = self._epoll_scan(w[2], w[3], w[4])
+                if n:
+                    self._resume(th, n)
 
     def _poll_scan(self, entries, fds_ptr) -> int:
         """Write revents for ready entries; returns the ready count."""
@@ -692,9 +1002,9 @@ class ManagedProcess(ProcessLifecycle):
         token = object()
         if timeout_ns >= 0:
             def fire():
-                w = self._waiting
-                if w and len(w) > 1 and w[1] is token:
-                    self._resume(0)
+                th, _ = self._find_waiter((("poll", "epoll"), token))
+                if th is not None:
+                    self._resume(th, 0)
 
             self.host.schedule_in(timeout_ns, fire)
         return token
@@ -708,17 +1018,15 @@ class ManagedProcess(ProcessLifecycle):
         ep.on_drain = lambda room: self._on_drain(vs)
 
     def _on_drain(self, vs: VSocket) -> None:
-        w = self._waiting
-        if w and w[0] == "send" and w[1] is vs:
-            data = self.mem.read(w[2], min(w[3], 1 << 20))
+        th, w = self._find_waiter((("send", "smsg"), vs))
+        if th is not None:
+            if w[0] == "send":
+                data = self.mem.read(w[2], min(w[3], 1 << 20))
+            else:
+                data = w[2]
             accepted = vs.endpoint.send(payload=data)
             if accepted > 0:
-                self._resume(accepted)
-            return
-        if w and w[0] == "smsg" and w[1] is vs:
-            accepted = vs.endpoint.send(payload=w[2])
-            if accepted > 0:
-                self._resume(accepted)
+                self._resume(th, accepted)
             return
         self._notify()
 
@@ -737,9 +1045,9 @@ class ManagedProcess(ProcessLifecycle):
             conn = VSocket(-1)
             conn.connected = True
             self._wire_endpoint(conn, ep)
-            w = self._waiting
-            if w and w[0] == "accept" and w[1] is vs:
-                self._finish_accept(vs, conn, w[2], w[3])
+            th, w = self._find_waiter((("accept",), vs))
+            if th is not None:
+                self._finish_accept(th, vs, conn, w[2], w[3])
             else:
                 vs.accept_q.append(conn)
                 self._notify()
@@ -777,9 +1085,9 @@ class ManagedProcess(ProcessLifecycle):
             self.mem.write(addrlen, struct.pack("<i", len(sa)))
         return conn.vfd
 
-    def _finish_accept(self, vs: VSocket, conn: VSocket, addr: int,
-                       addrlen: int) -> None:
-        self._resume(self._do_accept(vs, conn, addr, addrlen))
+    def _finish_accept(self, th: GuestThread, vs: VSocket, conn: VSocket,
+                       addr: int, addrlen: int) -> None:
+        self._resume(th, self._do_accept(vs, conn, addr, addrlen))
 
     def _connect(self, fd: int, addr: int, addrlen: int):
         vs = self.fds.get(fd)
@@ -811,40 +1119,52 @@ class ManagedProcess(ProcessLifecycle):
 
     def _on_connected(self, vs: VSocket) -> None:
         vs.connected = True
-        if self._waiting and self._waiting[0] == "connect" and self._waiting[1] is vs:
-            self._resume(0)
+        th, _ = self._find_waiter((("connect",), vs))
+        if th is not None:
+            self._resume(th, 0)
             return
         self._notify()
 
     def _on_net_data(self, vs: VSocket, n: int, payload) -> None:
         vs.rxbuf += payload if payload is not None else b"\0" * n
-        w = self._waiting
-        if w and w[0] == "recv" and w[1] is vs:
-            _, _, bufaddr, buflen = w
-            self._fulfill_recv(vs, bufaddr, buflen)
-            return
-        if w and w[0] == "rmsg" and w[1] is vs:
-            self._resume(self._scatter_rx(vs, w[2]))
+        th, w = self._find_waiter((("recv", "rmsg"), vs))
+        if th is not None:
+            if w[0] == "recv":
+                self._fulfill_recv(th, vs, w[2], w[3])
+            else:
+                self._resume(th, self._scatter_rx(vs, w[2]))
             return
         self._notify()
 
     def _on_net_close(self, vs: VSocket) -> None:
         vs.peer_closed = True
-        w = self._waiting
-        if w and w[0] in ("recv", "rmsg") and w[1] is vs and not vs.rxbuf:
-            self._resume(0)
-            return
-        self._notify()
+        woke = False
+        while not vs.rxbuf:  # terminal event: EVERY reader gets EOF
+            th, _ = self._find_waiter((("recv", "rmsg"), vs))
+            if th is None:
+                break
+            self._resume(th, 0)
+            woke = True
+        if not woke:
+            self._notify()
 
     def _on_net_error(self, vs: VSocket) -> None:
         vs.connect_err = ETIMEDOUT if not vs.connected else ECONNRESET
-        w = self._waiting
-        if w and w[0] == "connect" and w[1] is vs:
-            self._resume(-ETIMEDOUT)
-        elif w and w[0] in ("recv", "send", "rmsg", "smsg", "dmsg") \
-                and w[1] is vs:
-            self._resume(-ECONNRESET)
-        else:
+        woke = False
+        while True:  # terminal event: EVERY waiter on this socket errors
+            th, w = self._find_waiter((("connect",), vs))
+            if th is not None:
+                self._resume(th, -ETIMEDOUT)
+                woke = True
+                continue
+            th, w = self._find_waiter(
+                (("recv", "send", "rmsg", "smsg", "dmsg"), vs))
+            if th is not None:
+                self._resume(th, -ECONNRESET)
+                woke = True
+                continue
+            break
+        if not woke:
             self._notify()
 
     def _vfd_send(self, fd: int, addr: int, n: int):
@@ -880,8 +1200,9 @@ class ManagedProcess(ProcessLifecycle):
         self._waiting = ("recv", vs, bufaddr, buflen)
         return _BLOCK
 
-    def _fulfill_recv(self, vs: VSocket, bufaddr: int, buflen: int) -> None:
-        self._resume(self._take_rx(vs, bufaddr, buflen))
+    def _fulfill_recv(self, th: GuestThread, vs: VSocket, bufaddr: int,
+                      buflen: int) -> None:
+        self._resume(th, self._take_rx(vs, bufaddr, buflen))
 
     def _take_rx(self, vs: VSocket, bufaddr: int, buflen: int) -> int:
         k = min(len(vs.rxbuf), buflen)
@@ -1148,9 +1469,9 @@ class ManagedProcess(ProcessLifecycle):
                 vs.interval_ns, lambda: self._timer_fire(vs))
         else:
             vs.timer_handle = None
-        w = self._waiting
-        if w and w[0] == "cread" and w[1] is vs:
-            self._resume(self._counter_read(vs, w[2], w[3]))
+        th, w = self._find_waiter((("cread",), vs))
+        if th is not None:
+            self._resume(th, self._counter_read(vs, w[2], w[3]))
         else:
             self._notify()
 
@@ -1165,11 +1486,13 @@ class ManagedProcess(ProcessLifecycle):
 
         def on_datagram(nbytes, payload, src_addr, now):
             vs.dgram_q.append((payload, nbytes, src_addr[0], src_addr[1]))
-            w = self._waiting
-            if w and w[0] == "drecv" and w[1] is vs:
-                self._resume(self._dgram_take(vs, w[2], w[3], w[4], w[5]))
-            elif w and w[0] == "dmsg" and w[1] is vs:
-                self._resume(self._recvmsg_take(vs, w[2], w[3]))
+            th, w = self._find_waiter((("drecv", "dmsg"), vs))
+            if th is not None:
+                if w[0] == "drecv":
+                    self._resume(
+                        th, self._dgram_take(vs, w[2], w[3], w[4], w[5]))
+                else:
+                    self._resume(th, self._recvmsg_take(vs, w[2], w[3]))
             else:
                 self._notify()
 

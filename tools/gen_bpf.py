@@ -11,15 +11,18 @@ from __future__ import annotations
 
 from pathlib import Path
 
-SYS = dict(read=0, write=1, close=3, poll=7, ioctl=16, readv=19, writev=20,
-           nanosleep=35,
-           getpid=39, socket=41, clone_end=60, fcntl=72,
-           gettimeofday=96, getppid=110, gettid=186, time=201,
+SYS = dict(read=0, write=1, close=3, poll=7, rt_sigprocmask=14,
+           ioctl=16, readv=19, writev=20, nanosleep=35,
+           getpid=39, socket=41, recvmsg=47, clone=56, clone_end=60,
+           fcntl=72, gettimeofday=96, getppid=110, gettid=186, futex=202,
+           time=201,
            epoll_create=213, clock_gettime=228, clock_nanosleep=230,
            epoll_wait=232, epoll_ctl=233, ppoll=271, epoll_pwait=281,
            timerfd_create=283, eventfd=284, timerfd_settime=286,
            timerfd_gettime=287, accept4=288, eventfd2=290,
            epoll_create1=291, getrandom=318, clone3=435)
+
+CLONE_THREAD = 0x10000
 
 #: syscalls trapped unconditionally (beyond the 41..59 socket/clone range)
 UNCONDITIONAL = [
@@ -27,7 +30,8 @@ UNCONDITIONAL = [
     "getrandom", "poll", "ppoll", "epoll_create", "epoll_create1",
     "epoll_ctl", "epoll_wait", "epoll_pwait", "accept4", "clone3",
     "getpid", "getppid", "gettid", "timerfd_create", "timerfd_settime",
-    "timerfd_gettime", "eventfd", "eventfd2",
+    "timerfd_gettime", "eventfd", "eventfd2", "futex",
+    "rt_sigprocmask",
 ]
 
 #: syscalls trapped only when arg0 is a virtual fd
@@ -47,15 +51,30 @@ def build():
         prog.append(("JEQ", SYS[name], "VFDCHK", None))
     for name in UNCONDITIONAL:
         prog.append(("JEQ", SYS[name], "TRAP", None))
+    # recvmsg on a worker IPC channel runs natively (SCM_RIGHTS receive of
+    # per-thread channels); on any other fd it is emulated
+    prog.append(("JEQ", SYS["recvmsg"], "IPCRD", None))
+    # thread-style clones run natively (pthread_create is interposed);
+    # fork-style trap so the worker can reject them loudly
+    prog.append(("JEQ", SYS["clone"], "CLONECHK", None))
     prog.append(("JGE", SYS["socket"], None, "ALLOW"))
     prog.append(("JGE", SYS["clone_end"], "ALLOW", "TRAP"))
     labels = {}
     labels["READ"] = len(prog)
-    prog += [("LD_A0",), ("JEQ", "IPC", "ALLOW", None),
-             ("JEQ", 0, "TRAP", None), ("JGE", "VFD", "TRAP", "ALLOW")]
+    prog += [("LD_A0",), ("JGE", "IPCLOW", None, "READCHK"),
+             ("JGE", "IPCEND", "READCHK", "ALLOW")]
+    labels["READCHK"] = len(prog)
+    prog += [("JEQ", 0, "TRAP", None), ("JGE", "VFD", "TRAP", "ALLOW")]
     labels["WRITE"] = len(prog)
-    prog += [("LD_A0",), ("JEQ", "IPC", "ALLOW", None),
-             ("JGE", 3, None, "TRAP"), ("JGE", "VFD", "TRAP", "ALLOW")]
+    prog += [("LD_A0",), ("JGE", "IPCLOW", None, "WRITECHK"),
+             ("JGE", "IPCEND", "WRITECHK", "ALLOW")]
+    labels["WRITECHK"] = len(prog)
+    prog += [("JGE", 3, None, "TRAP"), ("JGE", "VFD", "TRAP", "ALLOW")]
+    labels["IPCRD"] = len(prog)
+    prog += [("LD_A0",), ("JGE", "IPCLOW", None, "TRAP"),
+             ("JGE", "IPCEND", "TRAP", "ALLOW")]
+    labels["CLONECHK"] = len(prog)
+    prog += [("LD_A0",), ("JSET", CLONE_THREAD, "ALLOW", "TRAP")]
     labels["VFDCHK"] = len(prog)
     prog += [("LD_A0",), ("JGE", "VFD", "TRAP", "ALLOW")]
     labels["TRAP"] = len(prog)
@@ -67,6 +86,7 @@ def build():
 
     def val(v):
         return {"ARCH": "AUDIT_ARCH_X86_64", "IPC": "SHIM_IPC_FD",
+                "IPCLOW": "SHIM_IPC_LOW", "IPCEND": "(SHIM_IPC_FD + 1)",
                 "VFD": "SHIM_VFD_BASE"}.get(v, str(v))
 
     out = []
@@ -89,7 +109,9 @@ def build():
             return d
 
         cmt = f"  /* {names.get(v, '')} */" if isinstance(v, int) and v in names else ""
-        op = "JEQ" if k == "JEQ" else "JGE"
+        if k == "JSET":
+            cmt = "  /* CLONE_THREAD */"
+        op = {"JEQ": "JEQ", "JGE": "JGE", "JSET": "JSET"}[k]
         out.append(f"      {op}({val(v)}, {off(t)}, {off(f)}),{cmt}")
     return len(prog), "\n".join(out)
 
