@@ -59,6 +59,8 @@
 #define SHIM_THREAD_HELLO 0xFFFFFFF1u
 #define SHIM_THREAD_JOIN 0xFFFFFFF2u
 #define SHIM_THREAD_EXIT 0xFFFFFFF3u
+#define SHIM_FORK_INTENT 0xFFFFFFF4u
+#define SHIM_FORK_COMMIT 0xFFFFFFF5u
 
 struct shim_req { uint64_t nr; uint64_t args[6]; };
 
@@ -76,6 +78,17 @@ static long raw3(long nr, long a, long b, long c) {
   __asm__ volatile("syscall"
                    : "=a"(ret)
                    : "a"(nr), "D"(a), "S"(b), "d"(c)
+                   : "rcx", "r11", "memory");
+  return ret;
+}
+
+static long raw5(long nr, long a, long b, long c, long d, long e) {
+  long ret;
+  register long r10 __asm__("r10") = d;
+  register long r8 __asm__("r8") = e;
+  __asm__ volatile("syscall"
+                   : "=a"(ret)
+                   : "a"(nr), "D"(a), "S"(b), "d"(c), "r"(r10), "r"(r8)
                    : "rcx", "r11", "memory");
   return ret;
 }
@@ -110,10 +123,102 @@ static int64_t forward(uint64_t nr, uint64_t a0, uint64_t a1, uint64_t a2,
   return ret;
 }
 
+/* receive one 8-byte reply carrying an SCM_RIGHTS fd on the caller's
+ * channel; returns the fd (or -1) and stores the payload in *val_out */
+static int shim_recv_fd(int64_t *val_out) {
+  char cbuf[CMSG_SPACE(sizeof(int))];
+  struct iovec iov = {val_out, 8};
+  struct msghdr mh;
+  memset(&mh, 0, sizeof mh);
+  mh.msg_iov = &iov;
+  mh.msg_iovlen = 1;
+  mh.msg_control = cbuf;
+  mh.msg_controllen = sizeof cbuf;
+  long r = raw3(SYS_recvmsg, shim_tls_fd, (long)&mh, 0);
+  if (r != 8) return -1;
+  struct cmsghdr *c = CMSG_FIRSTHDR(&mh);
+  if (!c || c->cmsg_type != SCM_RIGHTS) return -1;
+  int fd;
+  memcpy(&fd, CMSG_DATA(c), sizeof fd);
+  return fd;
+}
+
+/* the child re-reads its real pid from /proc (getpid is trapped and would
+ * return the VIRTUAL pid; the cached parent ids are wrong post-fork) */
+static void shim_refresh_real_ids(void) {
+  int fd = (int)raw3(SYS_open, (long)"/proc/self/stat", 0, 0);
+  if (fd < 0) return;
+  char buf[64];
+  long n = raw3(SYS_read, fd, (long)buf, (long)sizeof buf - 1);
+  raw3(SYS_close, fd, 0, 0);
+  if (n <= 0) return;
+  buf[n] = 0;
+  long pid = 0;
+  for (char *p = buf; *p >= '0' && *p <= '9'; p++) pid = pid * 10 + (*p - '0');
+  if (pid > 0) { shim_real_pid = pid; shim_real_tid = pid; }
+}
+
+/* Reference analog: managed-process fork (SURVEY.md §3.2 sibling path).
+ * The worker mints the child's channel (FORK_INTENT -> SCM_RIGHTS fd),
+ * the REAL fork runs here in the guest, the child rebinds the fresh
+ * channel at the main slot and parks for its first turn, and the parent
+ * reports the real child pid (FORK_COMMIT) in exchange for the child's
+ * virtual pid. */
+static long shim_do_fork(uint64_t nr, greg_t *g) {
+  struct shim_req rq = {SHIM_FORK_INTENT, {0, 0, 0, 0, 0, 0}};
+  if (write_all(&rq, sizeof rq) != 0) return -EAGAIN;
+  int64_t eid = -1;
+  int newfd = shim_recv_fd(&eid);
+  if (newfd < 0 || eid < 0) return -EAGAIN;
+  /* replay the clone with CLONE_IO or'd in: a benign marker the seccomp
+   * filter ALLOWs, so the shim's own fork doesn't re-trap (raw SYS_fork
+   * would); original ctid/ptid args are preserved for glibc's TCB fixup */
+  long child;
+  if (nr == SYS_clone)
+    child = raw5(SYS_clone, (long)(g[REG_RDI] | 0x80000000ul), (long)g[REG_RSI],
+                 (long)g[REG_RDX], (long)g[REG_R10], (long)g[REG_R8]);
+  else /* raw SYS_fork callers: synthesize fork-flavored clone flags */
+    child = raw5(SYS_clone, 0x80000000l | 17 /*SIGCHLD*/, 0, 0, 0, 0);
+  if (child < 0) {
+    raw3(SYS_close, newfd, 0, 0);
+    return child; /* worker-side embryo is reclaimed at process exit */
+  }
+  if (child == 0) {
+    /* child: own fd table — rebind the fresh channel to the main slot,
+     * drop inherited per-thread channels */
+    raw3(SYS_dup2, newfd, SHIM_IPC_FD, 0);
+    if (newfd != SHIM_IPC_FD) raw3(SYS_close, newfd, 0, 0);
+    for (int fd = SHIM_IPC_LOW; fd < SHIM_IPC_FD; fd++)
+      raw3(SYS_close, fd, 0, 0);
+    shim_tls_fd = SHIM_IPC_FD;
+    shim_refresh_real_ids();
+    forward(SHIM_THREAD_HELLO, 0, 0, 0, 0, 0, 0); /* first turn grant */
+    return 0;
+  }
+  raw3(SYS_close, newfd, 0, 0);
+  return forward(SHIM_FORK_COMMIT, (uint64_t)eid, (uint64_t)child,
+                 0, 0, 0, 0); /* -> the child's virtual pid */
+}
+
 static void sigsys_handler(int signo, siginfo_t *info, void *vctx) {
   (void)signo;
   ucontext_t *ctx = vctx;
   greg_t *g = ctx->uc_mcontext.gregs;
+  if (info->si_syscall == SYS_fork ||
+      (info->si_syscall == SYS_clone && !(g[REG_RDI] & 0x10000))) {
+    if (info->si_syscall == SYS_clone && (g[REG_RDI] & 0x100 /*CLONE_VM*/)) {
+      g[REG_RAX] = (greg_t)-ENOSYS; /* vfork-style shared-VM clone */
+      return;
+    }
+    g[REG_RAX] = (greg_t)shim_do_fork((uint64_t)info->si_syscall, g);
+    return;
+  }
+  if (info->si_syscall == SYS_exit_group) {
+    /* report the true code, then exit this thread for real; the worker
+     * SIGKILLs any remaining threads (exit_group semantics) */
+    forward(SYS_exit_group, (uint64_t)g[REG_RDI], 0, 0, 0, 0, 0);
+    raw3(SYS_exit, (long)g[REG_RDI], 0, 0);
+  }
   if (info->si_syscall == SYS_rt_sigprocmask) {
     /* Emulated SHIM-SIDE by editing the signal frame's uc_sigmask (the
      * mask sigreturn restores) — never with a real syscall, which would
@@ -311,20 +416,8 @@ static long shim_spawn_channel(void) {
   struct shim_req rq = {SHIM_SPAWN_THREAD, {0, 0, 0, 0, 0, 0}};
   if (write_all(&rq, sizeof rq) != 0) return -1;
   int64_t slot = -1;
-  char cbuf[CMSG_SPACE(sizeof(int))];
-  struct iovec iov = {&slot, 8};
-  struct msghdr mh;
-  memset(&mh, 0, sizeof mh);
-  mh.msg_iov = &iov;
-  mh.msg_iovlen = 1;
-  mh.msg_control = cbuf;
-  mh.msg_controllen = sizeof cbuf;
-  long r = raw3(SYS_recvmsg, shim_tls_fd, (long)&mh, 0);
-  if (r != 8 || slot < 0 || slot >= SHIM_MAX_THREADS) return -1;
-  struct cmsghdr *c = CMSG_FIRSTHDR(&mh);
-  if (!c || c->cmsg_type != SCM_RIGHTS) return -1;
-  int newfd;
-  memcpy(&newfd, CMSG_DATA(c), sizeof newfd);
+  int newfd = shim_recv_fd(&slot);
+  if (newfd < 0 || slot < 0 || slot >= SHIM_MAX_THREADS) return -1;
   int want = SHIM_IPC_FD - (int)slot;
   if (newfd != want) {
     raw3(SYS_dup2, newfd, want, 0);
@@ -420,61 +513,69 @@ void pthread_exit(void *retval) {
 
 static int install_seccomp(void) {
   /* BEGIN GENERATED BPF (tools/gen_bpf.py) */
-  struct sock_filter prog[] = {  /* 58 instructions */
+  struct sock_filter prog[] = {  /* 66 instructions */
       LD(BPF_ARCHF),
-      JEQ(AUDIT_ARCH_X86_64, 0, 55),
+      JEQ(AUDIT_ARCH_X86_64, 0, 63),
       LD(BPF_NR),
-      JEQ(0, 35, 0),  /* read */
-      JEQ(1, 39, 0),  /* write */
-      JEQ(19, 33, 0),  /* readv */
-      JEQ(20, 37, 0),  /* writev */
-      JEQ(3, 46, 0),  /* close */
-      JEQ(16, 45, 0),  /* ioctl */
-      JEQ(72, 44, 0),  /* fcntl */
-      JEQ(35, 45, 0),  /* nanosleep */
-      JEQ(230, 44, 0),  /* clock_nanosleep */
-      JEQ(228, 43, 0),  /* clock_gettime */
-      JEQ(96, 42, 0),  /* gettimeofday */
-      JEQ(201, 41, 0),  /* time */
-      JEQ(318, 40, 0),  /* getrandom */
-      JEQ(7, 39, 0),  /* poll */
-      JEQ(271, 38, 0),  /* ppoll */
-      JEQ(213, 37, 0),  /* epoll_create */
-      JEQ(291, 36, 0),  /* epoll_create1 */
-      JEQ(233, 35, 0),  /* epoll_ctl */
-      JEQ(232, 34, 0),  /* epoll_wait */
-      JEQ(281, 33, 0),  /* epoll_pwait */
-      JEQ(288, 32, 0),  /* accept4 */
-      JEQ(435, 31, 0),  /* clone3 */
-      JEQ(39, 30, 0),  /* getpid */
-      JEQ(110, 29, 0),  /* getppid */
-      JEQ(186, 28, 0),  /* gettid */
-      JEQ(283, 27, 0),  /* timerfd_create */
-      JEQ(286, 26, 0),  /* timerfd_settime */
-      JEQ(287, 25, 0),  /* timerfd_gettime */
-      JEQ(284, 24, 0),  /* eventfd */
-      JEQ(290, 23, 0),  /* eventfd2 */
-      JEQ(202, 22, 0),  /* futex */
-      JEQ(14, 21, 0),  /* rt_sigprocmask */
+      JEQ(0, 42, 0),  /* read */
+      JEQ(1, 46, 0),  /* write */
+      JEQ(19, 40, 0),  /* readv */
+      JEQ(20, 44, 0),  /* writev */
+      JEQ(3, 54, 0),  /* close */
+      JEQ(16, 53, 0),  /* ioctl */
+      JEQ(72, 52, 0),  /* fcntl */
+      JEQ(32, 51, 0),  /* dup */
+      JEQ(33, 50, 0),  /* dup2 */
+      JEQ(292, 49, 0),  /* dup3 */
+      JEQ(35, 50, 0),  /* nanosleep */
+      JEQ(230, 49, 0),  /* clock_nanosleep */
+      JEQ(228, 48, 0),  /* clock_gettime */
+      JEQ(96, 47, 0),  /* gettimeofday */
+      JEQ(201, 46, 0),  /* time */
+      JEQ(318, 45, 0),  /* getrandom */
+      JEQ(7, 44, 0),  /* poll */
+      JEQ(271, 43, 0),  /* ppoll */
+      JEQ(213, 42, 0),  /* epoll_create */
+      JEQ(291, 41, 0),  /* epoll_create1 */
+      JEQ(233, 40, 0),  /* epoll_ctl */
+      JEQ(232, 39, 0),  /* epoll_wait */
+      JEQ(281, 38, 0),  /* epoll_pwait */
+      JEQ(288, 37, 0),  /* accept4 */
+      JEQ(435, 36, 0),  /* clone3 */
+      JEQ(39, 35, 0),  /* getpid */
+      JEQ(110, 34, 0),  /* getppid */
+      JEQ(186, 33, 0),  /* gettid */
+      JEQ(283, 32, 0),  /* timerfd_create */
+      JEQ(286, 31, 0),  /* timerfd_settime */
+      JEQ(287, 30, 0),  /* timerfd_gettime */
+      JEQ(284, 29, 0),  /* eventfd */
+      JEQ(290, 28, 0),  /* eventfd2 */
+      JEQ(202, 27, 0),  /* futex */
+      JEQ(14, 26, 0),  /* rt_sigprocmask */
+      JEQ(22, 25, 0),  /* pipe */
+      JEQ(293, 24, 0),  /* pipe2 */
+      JEQ(61, 23, 0),  /* wait4 */
+      JEQ(231, 22, 0),  /* exit_group */
       JEQ(47, 13, 0),  /* recvmsg */
       JEQ(56, 15, 0),  /* clone */
-      JGE(41, 0, 19),  /* socket */
-      JGE(60, 18, 17),  /* clone_end */
+      JGE(41, 0, 20),  /* socket */
+      JGE(60, 19, 18),  /* clone_end */
       LD(BPF_ARG0),
       JGE(SHIM_IPC_LOW, 0, 1),
-      JGE((SHIM_IPC_FD + 1), 0, 15),
-      JEQ(0, 13, 0),  /* read */
-      JGE(SHIM_VFD_BASE, 12, 13),
+      JGE((SHIM_IPC_FD + 1), 0, 16),
+      JEQ(0, 14, 0),  /* read */
+      JGE(SHIM_VFD_BASE, 13, 14),
       LD(BPF_ARG0),
       JGE(SHIM_IPC_LOW, 0, 1),
-      JGE((SHIM_IPC_FD + 1), 0, 10),
-      JGE(3, 0, 8),  /* close */
-      JGE(SHIM_VFD_BASE, 7, 8),
+      JGE((SHIM_IPC_FD + 1), 0, 11),
+      JGE(3, 0, 9),  /* close */
+      JGE(SHIM_VFD_BASE, 8, 9),
       LD(BPF_ARG0),
-      JGE(SHIM_IPC_LOW, 0, 5),
-      JGE((SHIM_IPC_FD + 1), 4, 5),
+      JGE(SHIM_IPC_LOW, 0, 6),
+      JGE((SHIM_IPC_FD + 1), 5, 6),
       LD(BPF_ARG0),
-      JSET(65536, 3, 2),  /* CLONE_THREAD */
+      JSET(65536, 4, 0),  /* CLONE_THREAD */
+      JSET(2147483648, 3, 2),  /* CLONE_IO (shim fork replay) */
       LD(BPF_ARG0),
       JGE(SHIM_VFD_BASE, 0, 1),
       RET(SECCOMP_RET_TRAP),

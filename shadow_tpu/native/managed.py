@@ -48,6 +48,11 @@ SPAWN_THREAD = 0xFFFFFFF0  # -> reply carries slot + SCM_RIGHTS channel fd
 THREAD_HELLO = 0xFFFFFFF1  # new thread checks in; reply is its first turn
 THREAD_JOIN = 0xFFFFFFF2   # arg0 = slot; reply is the thread's retval
 THREAD_EXIT = 0xFFFFFFF3   # arg0 = retval; thread finishes dying natively
+FORK_INTENT = 0xFFFFFFF4   # -> reply carries embryo id + SCM_RIGHTS fd
+FORK_COMMIT = 0xFFFFFFF5   # args = (embryo id, real child pid) -> vpid
+SYS_wait4, SYS_exit_group, SYS_pipe, SYS_pipe2 = 61, 231, 22, 293
+SYS_dup, SYS_dup2, SYS_dup3 = 32, 33, 292
+WNOHANG, ECHILD = 1, 10
 MAX_THREADS = 32           # slots 1..31 map to shim fds 994..964
 SYS_futex = 202
 FUTEX_WAIT, FUTEX_WAKE, FUTEX_REQUEUE, FUTEX_CMP_REQUEUE = 0, 1, 3, 4
@@ -93,6 +98,8 @@ _DETACH = object()  # service() sentinel: reply 0, then stop reading this
                     # thread's channel forever (it announced its exit)
 _REPLIED = object()  # service() sentinel: reply already sent inline
 _EMBRYO = object()  # ready-queue sentinel: read THREAD_HELLO before granting
+_EXITGROUP = object()  # service() sentinel: reply, SIGKILL the whole
+                       # process (exit_group semantics), reap immediately
 
 #: spawn serialization: the child end of the socketpair rides a FIXED fd
 #: number (the seccomp filter bakes it in), so concurrent spawns on
@@ -145,11 +152,11 @@ class VSocket:
                  "connected", "connect_err", "bound_port", "listening",
                  "accept_q", "nonblock", "dgram_q", "udp", "interest",
                  "expirations", "interval_ns", "deadline", "timer_handle",
-                 "evt_counter")
+                 "evt_counter", "refs", "pipe")
 
     def __init__(self, vfd: int, kind: str = "stream") -> None:
         self.vfd = vfd
-        self.kind = kind  # stream | dgram | epoll
+        self.kind = kind  # stream | dgram | epoll | timer | event | pipe_r/w
         self.endpoint = None
         self.rxbuf = bytearray()
         self.peer_closed = False
@@ -169,6 +176,48 @@ class VSocket:
         self.timer_handle = None
         # eventfd state
         self.evt_counter = 0
+        # fork support: open-file-description refcount (a forked child's fd
+        # table shares VSocket objects; the backing object closes when the
+        # LAST table entry referencing it closes, like the kernel's)
+        self.refs = 1
+        self.pipe = None  # PipeBuf when kind is pipe_r/pipe_w
+
+
+class PipeBuf:
+    """The shared buffer behind a pipe's two ends — usable from EITHER
+    process of a forked pair (reference analog: cross-process pipes of the
+    descriptor table, SURVEY.md §2 row 12). Readers/writers park with their
+    owning (process, thread) recorded here so wakeups cross processes."""
+
+    CAP = 65536
+
+    __slots__ = ("buf", "r_end", "w_end", "waiting", "procs")
+
+    def __init__(self) -> None:
+        self.buf = bytearray()
+        self.r_end = None  # the pipe_r VSocket (refs==0 -> no readers)
+        self.w_end = None  # the pipe_w VSocket (refs==0 -> EOF)
+        self.waiting: list = []  # (proc, thread) parked on this pipe
+        self.procs: set = set()  # processes holding an end (poll wakeups)
+
+    @property
+    def readers(self) -> int:
+        return self.r_end.refs if self.r_end is not None else 0
+
+    @property
+    def writers(self) -> int:
+        return self.w_end.refs if self.w_end is not None else 0
+
+    def wake(self) -> None:
+        parked, self.waiting = self.waiting, []
+        for proc, th in parked:
+            w = th.waiting
+            if not w or th.dead or w[0] not in ("pipe_r", "pipe_w"):
+                continue
+            proc._pipe_retry(th, w)
+        for proc in list(self.procs):
+            if proc.running:
+                proc._notify()  # pollers (possibly in the other process)
 
 
 class GuestThread:
@@ -230,6 +279,13 @@ class ManagedProcess(ProcessLifecycle):
         # deterministic virtual pid (real pids would leak host scheduling
         # nondeterminism into any guest that prints or hashes its pid)
         self.vpid = 1000 + host.id * 64 + index
+        # fork support
+        self._exit_hint = None  # true exit code captured from exit_group
+        self.children: list = []  # forked ManagedProcess records
+        self.parent_proc = None
+        self.reaped = False  # consumed by the parent's wait4
+        self.real_pid = None  # adopted children: kernel pid (no Popen)
+        self._embryos: dict = {}  # embryo id -> worker-side channel sock
 
     # the syscall-service sites park/peek the CURRENT thread's wait state;
     # continuations instead search all threads via _find_waiter
@@ -253,6 +309,18 @@ class ManagedProcess(ProcessLifecycle):
                 if w[0] in kinds and (obj is None or w[1] is obj):
                     return th, w
         return None, None
+
+    def _open_strace(self) -> None:
+        # reference analog: strace_logging (SURVEY.md §5.1): every
+        # emulated syscall with args and result. "deterministic" omits
+        # the sim timestamp so logs diff clean across configs whose
+        # timing legitimately differs.
+        mode = self.host.controller.cfg.experimental.strace_logging_mode
+        if mode != "off":
+            ddir = Path(self.host.controller.data_dir) / "hosts" / self.host.name
+            ddir.mkdir(parents=True, exist_ok=True)
+            self._strace = open(ddir / f"{self.name}.strace", "w")
+            self._strace_times = mode != "deterministic"
 
     # -- lifecycle ---------------------------------------------------------
     def spawn(self) -> None:
@@ -300,14 +368,7 @@ class ManagedProcess(ProcessLifecycle):
         self.mem = ProcessMemory(self.proc.pid)
         self.running = True
         self.host.counters.add("processes_spawned", 1)
-        mode = self.host.controller.cfg.experimental.strace_logging_mode
-        if mode != "off":
-            # reference analog: strace_logging (SURVEY.md §5.1): every
-            # emulated syscall with args and result. "deterministic" omits
-            # the sim timestamp so logs diff clean across configs whose
-            # timing legitimately differs.
-            self._strace = open(ddir / f"{self.name}.strace", "w")
-            self._strace_times = mode != "deterministic"
+        self._open_strace()
 
         # handshake with a real-time bound: a binary the preload cannot
         # enter (static link, setuid) would otherwise hang the scheduler
@@ -346,6 +407,12 @@ class ManagedProcess(ProcessLifecycle):
         """Sim over (reference §3.5): kill and reap a still-running child."""
         if self.proc is not None and self.proc.poll() is None:
             self.proc.kill()
+            self._exited()
+        elif self.proc is None and self.real_pid is not None and self.running:
+            try:
+                os.kill(self.real_pid, 9)
+            except ProcessLookupError:
+                pass
             self._exited()
 
     # -- IPC ---------------------------------------------------------------
@@ -403,6 +470,15 @@ class ManagedProcess(ProcessLifecycle):
                 self._trace(nr, args, "<inline>")
                 self.host.counters.add("syscalls", 1)
                 continue
+            if ret is _EXITGROUP:
+                self._trace(nr, args, 0)
+                try:
+                    self._reply(th, 0)
+                except OSError:
+                    pass
+                self._kill_now()  # before any reap: the pid is still ours
+                self._exited()
+                return
             self._trace(nr, args, ret)
             if self._syscall_latency == 0:
                 # livelock detector: a guest spinning on nonblocking
@@ -479,6 +555,19 @@ class ManagedProcess(ProcessLifecycle):
         finally:
             self._pumping = False
 
+    def _close_vs(self, vs: VSocket) -> None:
+        """Drop one fd-table reference; tear down the backing object only
+        when the last reference (across forked processes) goes away."""
+        vs.refs -= 1
+        if vs.refs > 0:
+            return
+        if vs.listening:
+            self.host.unlisten(vs.bound_port)
+        if vs.endpoint is not None:
+            vs.endpoint.close()
+        if vs.pipe is not None:
+            vs.pipe.wake()  # refs hit 0: EOF readers / EPIPE writers
+
     def _thread_gone(self, th: GuestThread) -> None:
         """A non-main thread announced exit (or its channel died)."""
         th.dead = True
@@ -534,6 +623,228 @@ class ManagedProcess(ProcessLifecycle):
         target.joiners.append(self._cur)
         self._waiting = ("join", target)
         return _BLOCK
+
+    # -- fork (reference analog: Process::spawn's sibling path — a managed
+    #    guest forking a managed child, SURVEY.md §3.2; the real fork runs
+    #    SHIM-side, the worker mints the child's channel and adopts it) ----
+    def _fork_intent(self):
+        eid = len(self._embryos)
+        parent_sock, child_sock = socket.socketpair(
+            socket.AF_UNIX, socket.SOCK_STREAM)
+        self._embryos[eid] = parent_sock
+        self._time_map[:8] = struct.pack("<q", emulated(self.host.now))
+        socket.send_fds(self._cur.sock, [struct.pack("<q", eid)],
+                        [child_sock.fileno()])
+        child_sock.close()
+        return _REPLIED
+
+    def _fork_commit(self, eid: int, real_pid: int):
+        sock = self._embryos.pop(eid, None)
+        if sock is None:
+            return -EINVAL
+        child = ManagedProcess.adopt(self, sock, real_pid)
+        self.children.append(child)
+        # grant the child its first turn once the parent yields; the child
+        # parks in THREAD_HELLO inside its (copied) SIGSYS frame
+        child._ready.append((child.threads[0], _EMBRYO))
+        self.host.schedule_in(0, child._kick)
+        return child.vpid
+
+    @classmethod
+    def adopt(cls, parent: "ManagedProcess", sock, real_pid: int):
+        """Register a forked guest as a managed process of the same host.
+
+        Built on __init__ (which is side-effect-free) so new runtime fields
+        never need mirroring here; only the fork-specific identity and the
+        fd-table snapshot are overridden."""
+        import copy
+        from dataclasses import replace as dc_replace
+
+        host = parent.host
+        ctl = host.controller
+        seq = getattr(ctl, "_fork_seq", 0)
+        ctl._fork_seq = seq + 1
+        opts = dc_replace(copy.copy(parent.opts), expected_final_state=None)
+        self = cls(host, opts, 0)
+        self.name = f"{Path(parent.opts.path).name}.f{seq}"
+        seqv = getattr(ctl, "_vpid_seq", 40000)
+        ctl._vpid_seq = seqv + 1
+        self.vpid = seqv  # deterministic: fork order is deterministic
+        self.proc = None  # not our OS child — the guest parent's
+        self.real_pid = real_pid
+        self.mem = ProcessMemory(real_pid)
+        self.sock = sock
+        self._time_map = parent._time_map  # same mapped clock page
+        # fork semantics: the fd table is a snapshot sharing open file
+        # descriptions (refcounted); per-process capture files stay fresh
+        self.fds = dict(parent.fds)
+        for vs in self.fds.values():
+            vs.refs += 1
+            if vs.pipe is not None:
+                vs.pipe.procs.add(self)
+        self._next_vfd = parent._next_vfd
+        self.threads = {0: GuestThread(0, sock)}
+        self._cur = self.threads[0]
+        self.parent_proc = parent
+        self.running = True
+        self._open_strace()
+        host.processes.append(self)
+        ctl.processes.append(self)
+        host.counters.add("processes_spawned", 1)
+        return self
+
+    def _kick(self) -> None:
+        if self.running and not self._pumping:
+            self._drain_ready()
+
+    def _kill_now(self) -> None:
+        """SIGKILL the guest synchronously (exit_group: sibling threads
+        must die too). Safe against pid reuse: called before the pid is
+        reaped, so it is at worst a zombie that still belongs to us."""
+        pid = self.proc.pid if self.proc is not None else self.real_pid
+        if pid is not None:
+            try:
+                os.kill(pid, 9)
+            except ProcessLookupError:
+                pass
+
+    def _wait4(self, args):
+        pid = args[0] - (1 << 64) if args[0] >= (1 << 63) else args[0]
+        status_ptr, options = args[1], args[2]
+        matches = [c for c in self.children
+                   if not c.reaped and (pid in (-1, 0) or c.vpid == pid)]
+        if not matches:
+            return -ECHILD
+        dead = [c for c in matches if not c.running]
+        if dead:
+            return self._reap_child(dead[0], status_ptr)
+        if options & WNOHANG:
+            return 0
+        self._waiting = ("waitchild", pid, status_ptr)
+        return _BLOCK
+
+    def _reap_child(self, c: "ManagedProcess", status_ptr: int) -> int:
+        c.reaped = True
+        code = c.exit_code if c.exit_code is not None else 0
+        status = (-code if code < 0 else (code & 0xFF) << 8)  # signal|exit
+        if status_ptr:
+            self.mem.write(status_ptr, struct.pack("<i", status))
+        return c.vpid
+
+    def _child_exited(self, c: "ManagedProcess") -> None:
+        """A forked child died: wake a parked wait4 if it matches."""
+        for slot in sorted(self.threads):
+            th = self.threads[slot]
+            w = th.waiting
+            if (w and not th.dead and w[0] == "waitchild"
+                    and (w[1] in (-1, 0) or w[1] == c.vpid)):
+                self._resume(th, self._reap_child(c, w[2]))
+                return
+
+    # -- pipes + dup (descriptor-table breadth; pipes work across fork) ----
+    def _pipe(self, fds_ptr: int, flags: int):
+        pb = PipeBuf()
+        pb.procs.add(self)
+        r = VSocket(self._next_vfd, "pipe_r")
+        w = VSocket(self._next_vfd + 1, "pipe_w")
+        self._next_vfd += 2
+        r.pipe = w.pipe = pb
+        pb.r_end, pb.w_end = r, w
+        if flags & 0o4000:  # O_NONBLOCK
+            r.nonblock = w.nonblock = True
+        self.fds[r.vfd] = r
+        self.fds[w.vfd] = w
+        self.mem.write(fds_ptr, struct.pack("<ii", r.vfd, w.vfd))
+        return 0
+
+    def _dup(self, oldfd: int, newfd):
+        vs = self.fds.get(oldfd)
+        if vs is None:
+            return -EBADF
+        if newfd is None:
+            newfd = self._next_vfd
+            self._next_vfd += 1
+        else:
+            old = self.fds.pop(newfd, None)
+            if old is not None:
+                self._close_vs(old)
+        vs.refs += 1
+        self.fds[newfd] = vs
+        return newfd
+
+    def _pipe_read(self, vs: VSocket, iovs):
+        pb = vs.pipe
+        if pb.buf:
+            k = min(len(pb.buf), sum(ln for _, ln in iovs))
+            self._scatter(iovs, bytes(pb.buf[:k]))
+            del pb.buf[:k]
+            pb.wake()  # writers may have room now
+            return k
+        if pb.writers == 0:
+            return 0  # EOF
+        if vs.nonblock:
+            return -EAGAIN
+        self._cur.waiting = ("pipe_r", vs, iovs)
+        pb.waiting.append((self, self._cur))
+        return _BLOCK
+
+    PIPE_BUF = 4096  # POSIX atomicity bound for pipe writes
+
+    def _pipe_write(self, vs: VSocket, data: bytes):
+        pb = vs.pipe
+        if pb.readers == 0:
+            return -EPIPE
+        room = PipeBuf.CAP - len(pb.buf)
+        atomic = len(data) <= self.PIPE_BUF  # never split small writes
+        if room <= 0 or (atomic and room < len(data)):
+            if vs.nonblock:
+                return -EAGAIN
+            self._cur.waiting = ("pipe_w", vs, data, 0)
+            pb.waiting.append((self, self._cur))
+            return _BLOCK
+        k = min(room, len(data))
+        pb.buf += data[:k]
+        pb.wake()
+        if k == len(data) or vs.nonblock:
+            return k  # nonblocking large writes may be short, as on Linux
+        # blocking write(2) returns only once ALL bytes are transferred
+        self._cur.waiting = ("pipe_w", vs, data[k:], k)
+        pb.waiting.append((self, self._cur))
+        return _BLOCK
+
+    def _pipe_retry(self, th: GuestThread, w) -> None:
+        """Re-attempt a parked pipe op (called from PipeBuf.wake)."""
+        vs = w[1]
+        pb = vs.pipe
+        if w[0] == "pipe_r":
+            if pb.buf:
+                k = min(len(pb.buf), sum(ln for _, ln in w[2]))
+                self._scatter(w[2], bytes(pb.buf[:k]))
+                del pb.buf[:k]
+                self._resume(th, k)
+                pb.wake()
+            elif pb.writers == 0:
+                self._resume(th, 0)
+            else:
+                pb.waiting.append((self, th))
+            return
+        data, done = w[2], w[3]
+        if pb.readers == 0:
+            self._resume(th, done if done else -EPIPE)
+            return
+        room = PipeBuf.CAP - len(pb.buf)
+        atomic = done == 0 and len(data) <= self.PIPE_BUF
+        if room <= 0 or (atomic and room < len(data)):
+            pb.waiting.append((self, th))
+            return
+        k = min(room, len(data))
+        pb.buf += data[:k]
+        if k == len(data):
+            self._resume(th, done + k)
+        else:
+            th.waiting = ("pipe_w", vs, data[k:], done + k)
+            pb.waiting.append((self, th))
+        pb.wake()
 
     # -- futex emulation (reference analog: syscall handler futex family;
     #    required so lock handoffs between parked threads cannot deadlock
@@ -656,9 +967,21 @@ class ManagedProcess(ProcessLifecycle):
             self._strace.write(f"{ts}syscall_{nr}({a}) = {ret}\n")
 
     def _exited(self) -> None:
-        if self.proc is None:
+        if self.proc is None and self.real_pid is None:
             return
-        code = self.proc.wait()
+        if not self.running:
+            return
+        if self.proc is not None:
+            code = self.proc.wait()
+            if code < 0 and self._exit_hint is not None:
+                # exit_group path: the shim raw-exits / worker SIGKILLs,
+                # but the TRUE code was captured at the trap
+                code = self._exit_hint
+        else:
+            # adopted (forked) guest: not our OS child, no waitpid — the
+            # captured exit_group code is authoritative; EOF without it
+            # means a signal death we cannot attribute precisely
+            code = self._exit_hint if self._exit_hint is not None else -9
         if self._strace is not None:
             self._strace.write(f"+++ exited with {code} +++\n")
             self._strace.close()
@@ -666,9 +989,8 @@ class ManagedProcess(ProcessLifecycle):
         for f in self._files.values():
             f.close()
         self._files.clear()
-        for vs in self.fds.values():
-            if vs.endpoint is not None:
-                vs.endpoint.close()
+        for vs in list(self.fds.values()):  # one ref per table entry
+            self._close_vs(vs)
         self.fds.clear()
         for th in self.threads.values():
             th.dead = True
@@ -677,17 +999,23 @@ class ManagedProcess(ProcessLifecycle):
                 th.sock = None
         self._ready.clear()
         self.futexes.clear()
+        for s in self._embryos.values():  # forks that never committed
+            s.close()
+        self._embryos.clear()
         if self.sock is not None:
             self.sock.close()
             self.sock = None
         self.finish(code)
+        if (self.parent_proc is not None and self.parent_proc.running):
+            self.parent_proc._child_exited(self)
 
     # -- syscall emulation -------------------------------------------------
     def _service(self, nr: int, args):
         h = self.host
         if nr == SYS_write:
             fd, addr, n = args[0], args[1], args[2]
-            if fd in (1, 2):
+            # a dup2'd vfd on 0/1/2 takes precedence over stdio capture
+            if fd in (1, 2) and fd not in self.fds:
                 data = self.mem.read(addr, min(n, 1 << 20))
                 self._capture(fd).write(data)
                 return len(data)
@@ -703,22 +1031,27 @@ class ManagedProcess(ProcessLifecycle):
                 else:
                     self._notify()
                 return 8
+            if vs is not None and vs.kind == "pipe_w":
+                return self._pipe_write(vs, self.mem.read(addr, min(n, 1 << 20)))
+            if vs is not None and vs.kind == "pipe_r":
+                return -EBADF  # write on the read end
             return self._vfd_send(fd, addr, n)
         if nr == SYS_read:
-            if args[0] == 0:
-                return 0  # stdin: EOF
+            if args[0] == 0 and 0 not in self.fds:
+                return 0  # stdin: EOF (unless a vfd was dup2'd onto it)
             vs = self.fds.get(args[0])
             if vs is not None and vs.kind in ("timer", "event"):
                 return self._counter_read(vs, args[1], args[2])
+            if vs is not None and vs.kind == "pipe_r":
+                return self._pipe_read(vs, [(args[1], args[2])])
+            if vs is not None and vs.kind == "pipe_w":
+                return -EBADF  # read on the write end
             return self._vfd_recv(args[0], args[1], args[2])
         if nr == SYS_close:
             vs = self.fds.pop(args[0], None)
             if vs is None:
                 return -EBADF
-            if vs.listening:
-                self.host.unlisten(vs.bound_port)
-            if vs.endpoint is not None:
-                vs.endpoint.close()
+            self._close_vs(vs)
             return 0
         if nr == SYS_clock_gettime:
             if args[0] == 2**64 - 1:  # shim slow-path sentinel: raw ns
@@ -915,10 +1248,30 @@ class ManagedProcess(ProcessLifecycle):
             return _DETACH
         if nr == SYS_futex:
             return self._futex(args)
+        if nr == FORK_INTENT:
+            return self._fork_intent()
+        if nr == FORK_COMMIT:
+            return self._fork_commit(args[0], args[1])
+        if nr == SYS_wait4:
+            return self._wait4(args)
+        if nr == SYS_exit_group:
+            # record the true exit code; _pump then replies, SIGKILLs the
+            # process synchronously (sibling threads must not outlive an
+            # exit_group, and the pid is still ours — unreaped), and reaps
+            self._exit_hint = args[0] & 0xFF
+            return _EXITGROUP
+        if nr in (SYS_pipe, SYS_pipe2):
+            return self._pipe(args[0], args[1] if nr == SYS_pipe2 else 0)
+        if nr == SYS_dup:
+            return self._dup(args[0], None)
+        if nr in (SYS_dup2, SYS_dup3):
+            if args[0] == args[1]:
+                return args[1] if args[0] in self.fds else -EBADF
+            return self._dup(args[0], args[1])
         if nr in (SYS_clone, SYS_fork, SYS_vfork, SYS_execve, SYS_clone3):
-            # CLONE_THREAD clones run natively (pthread_create is
-            # interposed shim-side); fork/exec-style still fail loudly
-            # until per-process channel handoff exists
+            # CLONE_THREAD clones run natively; fork-style clones are
+            # executed SHIM-side (FORK_INTENT/COMMIT protocol) and never
+            # reach here; vfork (shared-VM) and execve stay rejected
             return -ENOSYS
         return -ENOSYS
 
@@ -928,6 +1281,10 @@ class ManagedProcess(ProcessLifecycle):
             return vs.expirations > 0
         if vs.kind == "event":
             return vs.evt_counter > 0
+        if vs.kind == "pipe_r":
+            return bool(vs.pipe.buf) or vs.pipe.writers == 0
+        if vs.kind == "pipe_w":
+            return False
         if vs.kind == "dgram":
             return bool(vs.dgram_q)
         if vs.listening:
@@ -937,6 +1294,10 @@ class ManagedProcess(ProcessLifecycle):
     def _writable(self, vs: VSocket) -> bool:
         if vs.kind in ("dgram", "event"):
             return True
+        if vs.kind == "pipe_w":
+            return (len(vs.pipe.buf) < PipeBuf.CAP) or vs.pipe.readers == 0
+        if vs.kind == "pipe_r":
+            return False
         ep = vs.endpoint
         if ep is None or not vs.connected or vs.peer_closed:
             return bool(vs.connect_err)  # error state is "writable" (POLLERR)
@@ -1363,7 +1724,7 @@ class ManagedProcess(ProcessLifecycle):
         iovs = self._read_iovec(iov_ptr, iovcnt)
         data = b"".join(self.mem.read(b, min(ln, 1 << 20))
                         for b, ln in iovs if ln)
-        if fd in (1, 2):
+        if fd in (1, 2) and fd not in self.fds:
             self._capture(fd).write(data)
             return len(data)
         vs = self.fds.get(fd)
@@ -1375,10 +1736,12 @@ class ManagedProcess(ProcessLifecycle):
             vs.evt_counter += struct.unpack("<Q", data[:8])[0]
             self._notify()
             return 8
+        if vs.kind == "pipe_w":
+            return self._pipe_write(vs, data)
         return self._stream_send(vs, data)
 
     def _readv(self, fd: int, iov_ptr: int, iovcnt: int):
-        if fd == 0:
+        if fd == 0 and 0 not in self.fds:
             return 0  # stdin: EOF, matching the read path
         vs = self.fds.get(fd)
         if vs is None:
@@ -1388,6 +1751,8 @@ class ManagedProcess(ProcessLifecycle):
             if not iovs:
                 return -EINVAL
             return self._counter_read(vs, iovs[0][0], iovs[0][1])
+        if vs.kind == "pipe_r":
+            return self._pipe_read(vs, iovs)
         if vs.kind == "dgram":
             if not vs.dgram_q:
                 if vs.nonblock:

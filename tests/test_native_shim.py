@@ -466,3 +466,36 @@ def test_cpython_threading_managed():
     out = Path(f"/tmp/st-pythreads/hosts/box/{name}.0.stdout").read_text()
     assert "order=[0, 1, 2, 3] n=4 elapsed_ms=200" in out, out
     assert "ok" in out
+
+
+# ---- multi-process guests (fork + pipes + wait) ---------------------------
+
+def test_fork_pipe_native_oracle():
+    r = subprocess.run([str(BUILD / "fork_pipe")], capture_output=True,
+                       text=True, timeout=30)
+    assert r.returncode == 0, r.stderr
+    assert "fork-complete" in r.stdout and "ok" in r.stdout
+
+
+def test_fork_pipe_managed_and_deterministic():
+    """A managed guest forks: the shim replays the clone (CLONE_IO-marked
+    past seccomp), the worker adopts the child as a managed process with a
+    snapshot fd table, the child's 50 ms sleep runs on SIM time, the pipe
+    crosses processes, wait4 is emulated, and exit_group's code 7 is
+    captured. Twice, bit-identically (including the deterministic child
+    vpid in the output)."""
+    cfg_text = SLEEP_CFG.replace("sleep_clock", "fork_pipe")
+    outs = []
+    for tag in ("a", "b"):
+        cfg = parse_config(yaml.safe_load(cfg_text), {
+            "general.data_directory": f"/tmp/st-forkp-{tag}",
+        })
+        c = Controller(cfg, mirror_log=False)
+        result = c.run()
+        assert result["process_errors"] == [], result["process_errors"]
+        out = Path(f"/tmp/st-forkp-{tag}/hosts/box/fork_pipe.0.stdout"
+                   ).read_text()
+        assert "fork-complete child=40000" in out, out
+        assert "elapsed_ms=50" in out, out
+        outs.append(out)
+    assert outs[0] == outs[1]

@@ -12,7 +12,8 @@ from __future__ import annotations
 from pathlib import Path
 
 SYS = dict(read=0, write=1, close=3, poll=7, rt_sigprocmask=14,
-           ioctl=16, readv=19, writev=20, nanosleep=35,
+           ioctl=16, readv=19, writev=20, pipe=22, dup=32, dup2=33,
+           nanosleep=35,
            getpid=39, socket=41, recvmsg=47, clone=56, clone_end=60,
            fcntl=72, gettimeofday=96, getppid=110, gettid=186, futex=202,
            time=201,
@@ -20,9 +21,12 @@ SYS = dict(read=0, write=1, close=3, poll=7, rt_sigprocmask=14,
            epoll_wait=232, epoll_ctl=233, ppoll=271, epoll_pwait=281,
            timerfd_create=283, eventfd=284, timerfd_settime=286,
            timerfd_gettime=287, accept4=288, eventfd2=290,
-           epoll_create1=291, getrandom=318, clone3=435)
+           epoll_create1=291, dup3=292, pipe2=293, getrandom=318,
+           wait4=61, exit_group=231, clone3=435)
 
 CLONE_THREAD = 0x10000
+CLONE_IO = 0x80000000  # shim's own fork-replay marker: benign, lets the
+# handler's raw clone through the filter without re-trapping
 
 #: syscalls trapped unconditionally (beyond the 41..59 socket/clone range)
 UNCONDITIONAL = [
@@ -31,11 +35,11 @@ UNCONDITIONAL = [
     "epoll_ctl", "epoll_wait", "epoll_pwait", "accept4", "clone3",
     "getpid", "getppid", "gettid", "timerfd_create", "timerfd_settime",
     "timerfd_gettime", "eventfd", "eventfd2", "futex",
-    "rt_sigprocmask",
+    "rt_sigprocmask", "pipe", "pipe2", "wait4", "exit_group",
 ]
 
 #: syscalls trapped only when arg0 is a virtual fd
-VFD_CONDITIONAL = ["close", "ioctl", "fcntl"]
+VFD_CONDITIONAL = ["close", "ioctl", "fcntl", "dup", "dup2", "dup3"]
 
 
 def build():
@@ -74,7 +78,8 @@ def build():
     prog += [("LD_A0",), ("JGE", "IPCLOW", None, "TRAP"),
              ("JGE", "IPCEND", "TRAP", "ALLOW")]
     labels["CLONECHK"] = len(prog)
-    prog += [("LD_A0",), ("JSET", CLONE_THREAD, "ALLOW", "TRAP")]
+    prog += [("LD_A0",), ("JSET", CLONE_THREAD, "ALLOW", None),
+             ("JSET", CLONE_IO, "ALLOW", "TRAP")]
     labels["VFDCHK"] = len(prog)
     prog += [("LD_A0",), ("JGE", "VFD", "TRAP", "ALLOW")]
     labels["TRAP"] = len(prog)
@@ -110,7 +115,8 @@ def build():
 
         cmt = f"  /* {names.get(v, '')} */" if isinstance(v, int) and v in names else ""
         if k == "JSET":
-            cmt = "  /* CLONE_THREAD */"
+            cmt = ("  /* CLONE_THREAD */" if v == CLONE_THREAD
+                   else "  /* CLONE_IO (shim fork replay) */")
         op = {"JEQ": "JEQ", "JGE": "JGE", "JSET": "JSET"}[k]
         out.append(f"      {op}({val(v)}, {off(t)}, {off(f)}),{cmt}")
     return len(prog), "\n".join(out)
