@@ -76,6 +76,10 @@ class ExperimentalOptions:
     strace_logging_mode: str = "off"  # off | standard | deterministic
     interface_qdisc: str = "fifo"
     max_unapplied_cpu_latency: SimTime = 0
+    #: fluid quantum width in MTUs (1..64). Wider units mean fewer events
+    #: per byte (faster at scale) at coarser loss/scheduling granularity;
+    #: congestion control is byte-counted, so dynamics are size-invariant.
+    unit_mtus: int = 10
     # tpu_batch knobs (ours):
     tpu_max_batch: int = 65536  # max units per device draw dispatch
     tpu_device_floor: int = 0  # min batch to engage the device; 0 = calibrate
@@ -216,6 +220,9 @@ def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
         cfg.warnings.append(
             "experimental.max_unapplied_cpu_latency accepted but not "
             "implemented (unblocked-syscall latency is a fixed 1 us)")
+    e.unit_mtus = int(exp.get("unit_mtus", 10))
+    _require(1 <= e.unit_mtus <= 64,
+             "experimental.unit_mtus must be in [1, 64]")
     e.tpu_max_batch = int(exp.get("tpu_max_batch", 65536))
     e.tpu_device_floor = int(exp.get("tpu_device_floor", 0))
     e.tpu_mesh_shards = int(exp.get("tpu_mesh_shards", 0))

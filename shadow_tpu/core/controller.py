@@ -24,6 +24,8 @@ exactly, so results are identical to grinding through empty rounds).
 
 from __future__ import annotations
 
+from functools import partial
+
 import time as _walltime
 from pathlib import Path
 
@@ -44,6 +46,10 @@ from shadow_tpu.utils.units import parse_bandwidth
 DEFAULT_BANDWIDTH = parse_bandwidth("1 Gbit")
 #: rounds between explicit gc.collect() calls while auto-GC is suspended
 _GC_EVERY_ROUNDS = 5000
+
+
+def _host_id(h):
+    return h.id
 
 
 class Controller:
@@ -103,6 +109,8 @@ class Controller:
             rate_down[hid] = down
             host_node[hid] = node
 
+        from shadow_tpu.network.fluid import MTU
+
         params = NetParams.build(
             host_node=host_node,
             rate_up=rate_up,
@@ -111,6 +119,7 @@ class Controller:
             reliability=self.graph.reliability,
             seed=cfg.general.seed,
             round_ns=self.round_ns,
+            max_unit=cfg.experimental.unit_mtus * MTU,
         )
         policy = cfg.experimental.scheduler_policy
         backend = "tpu" if policy == "tpu_batch" else "numpy"
@@ -119,8 +128,13 @@ class Controller:
             tpu_options=cfg.experimental,
             bootstrap_end=cfg.general.bootstrap_end_time,
         )
+        # active-host tracking: per-round work is O(hosts with pending
+        # events), not O(all hosts) — the difference at 10k mostly-idle
+        # hosts. A host (re)activates on its queue's empty->nonempty edge.
+        self._active: set = set()
         for h in self.hosts:
             h.engine = self.engine
+            h.equeue.on_first = partial(self._active.add, h)
         self.scheduler = make_scheduler(policy, self.hosts, cfg.general.parallelism)
 
         # processes: pyapp: plugins run in-process; any other path is a real
@@ -196,7 +210,11 @@ class Controller:
         while now < stop:
             round_end = min(now + w, stop)
             self.engine.start_of_round(now, round_end)
-            executed = self.scheduler.run_round(round_end)
+            active = sorted(self._active, key=_host_id)
+            executed = self.scheduler.run_round(round_end, active)
+            for h in active:
+                if not h.equeue._heap:
+                    self._active.discard(h)
             self.engine.end_of_round(now, round_end)
             self.rounds += 1
             self.events += executed
@@ -217,10 +235,11 @@ class Controller:
                 # hence 'rounds' and bucket rebase instants — identical to a
                 # run whose flags were computed inline (test_bitmatch.py::
                 # test_device_floor_cannot_change_results).
-                nt = min((h.equeue.next_time() for h in self.hosts), default=T_NEVER)
+                nt = min((h.equeue.next_time() for h in self._active),
+                         default=T_NEVER)
                 while self.engine.earliest_outstanding() < nt:
                     self.engine.flush_due(nt)
-                    nt = min((h.equeue.next_time() for h in self.hosts),
+                    nt = min((h.equeue.next_time() for h in self._active),
                              default=T_NEVER)
                 if nt >= T_NEVER:
                     self.log.info(

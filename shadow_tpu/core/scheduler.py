@@ -55,8 +55,8 @@ class SerialScheduler:
     def __init__(self, hosts: Sequence) -> None:
         self.hosts = hosts
 
-    def run_round(self, round_end: SimTime) -> int:
-        return _run_hosts(self.hosts, round_end)
+    def run_round(self, round_end: SimTime, active: Sequence = None) -> int:
+        return _run_hosts(self.hosts if active is None else active, round_end)
 
     def shutdown(self) -> None:
         pass
@@ -81,12 +81,21 @@ class ThreadPerCoreScheduler:
     def _run_shard(self, shard, round_end: SimTime) -> int:
         return _run_hosts(shard, round_end)
 
-    def run_round(self, round_end: SimTime) -> int:
-        futs = [
-            self.pool.submit(self._run_shard, shard, round_end)
-            for shard in self.shards
-            if shard
-        ]
+    def run_round(self, round_end: SimTime, active: Sequence = None) -> int:
+        if active is not None:
+            # shard only the hosts that can have work this round; a single
+            # populated shard runs inline (no pool round trip)
+            shards = [[] for _ in range(self.nthreads)]
+            for h in active:
+                shards[h.id % self.nthreads].append(h)
+            shards = [s for s in shards if s]
+            if not shards:
+                return 0
+            if len(shards) == 1:
+                return _run_hosts(shards[0], round_end)
+        else:
+            shards = [s for s in self.shards if s]
+        futs = [self.pool.submit(self._run_shard, s, round_end) for s in shards]
         return sum(f.result() for f in futs)
 
     def shutdown(self) -> None:
@@ -106,6 +115,7 @@ class ThreadPerHostScheduler:
         self._stop = False
         self._counts = [0] * len(hosts)
         self._errors: list = [None] * len(hosts)
+        self._index = {h.id: i for i, h in enumerate(hosts)}
         self._threads = [
             threading.Thread(
                 target=self._loop, args=(i,), name=f"shadow-host-{h.name}", daemon=True
@@ -127,18 +137,22 @@ class ThreadPerHostScheduler:
                 self._errors[i] = exc
             self._done[i].set()
 
-    def run_round(self, round_end: SimTime) -> int:
+    def run_round(self, round_end: SimTime, active: Sequence = None) -> int:
+        idx = (list(range(len(self.hosts))) if active is None
+               else [self._index[h.id] for h in active])
         self._round_end = round_end
-        self._errors = [None] * len(self.hosts)
-        for ev in self._go:
-            ev.set()
-        for ev in self._done:
-            ev.wait()
-            ev.clear()
-        for exc in self._errors:
-            if exc is not None:
-                raise exc
-        return sum(self._counts)
+        for i in idx:
+            self._errors[i] = None
+            self._counts[i] = 0
+            self._go[i].set()
+        total = 0
+        for i in idx:
+            self._done[i].wait()
+            self._done[i].clear()
+            if self._errors[i] is not None:
+                raise self._errors[i]
+            total += self._counts[i]
+        return total
 
     def shutdown(self) -> None:
         self._stop = True

@@ -33,6 +33,12 @@ class Host:
         self.node_id = node_id
         self.controller = controller
         self.engine = None  # set by controller after engine construction
+        from shadow_tpu.network.fluid import HEADER, MTU
+
+        # fluid quantum (experimental.unit_mtus): max stream payload bytes
+        # per unit on this host's connections
+        self.unit_chunk = (
+            controller.cfg.experimental.unit_mtus * MTU - HEADER)
         self.rng = host_rng(seed, host_id)
         self.equeue = EventQueue()
         self.counters = Counters()

@@ -91,7 +91,9 @@ class NetworkEngine:
         self.fault_filter = None
         self.fault_silent = False
 
+        self._deferred: set = set()  # hosts with ingress backlog
         self.max_batch = int(getattr(tpu_options, "tpu_max_batch", 65536) or 65536)
+        self.max_pkts = int(getattr(tpu_options, "unit_mtus", 10) or 10)
         self.device = None
         self.device_floor = float("inf")
         if backend == "tpu":
@@ -101,7 +103,8 @@ class NetworkEngine:
                 from shadow_tpu.ops.propagate import DeviceDrawPlane
 
                 self.device = DeviceDrawPlane(params.seed, self.max_batch,
-                                              n_shards=n_shards)
+                                              n_shards=n_shards,
+                                              max_pkts=self.max_pkts)
                 self.device_floor = floor
             else:
                 # auto mode: device attach (~seconds on a tunneled chip),
@@ -121,7 +124,8 @@ class NetworkEngine:
         try:
             from shadow_tpu.ops.propagate import DeviceDrawPlane
 
-            plane = DeviceDrawPlane(seed, self.max_batch, n_shards=n_shards)
+            plane = DeviceDrawPlane(seed, self.max_batch, n_shards=n_shards,
+                                    max_pkts=self.max_pkts)
             dev_s, np_per_unit = plane.calibrate()
             if np_per_unit > 0:
                 self.device_floor = max(512, min(
@@ -145,7 +149,7 @@ class NetworkEngine:
     def has_immediate_work(self) -> bool:
         """True if the next round must run even with empty event queues
         (deferred ingress backlog waiting on token refill)."""
-        return any(h.ingress_deferred for h in self.hosts)
+        return bool(self._deferred)
 
     def earliest_outstanding(self) -> SimTime:
         """Earliest event time any in-flight draw batch can produce."""
@@ -162,8 +166,9 @@ class NetworkEngine:
             p = self.params
             add_down = clamped_refill(p.rate_down, p.cap_down, dt)
             self.tokens_down += np.minimum(add_down, p.cap_down - self.tokens_down)
-        for host in self.hosts:
-            if host.ingress_deferred:
+        if self._deferred:
+            drain, self._deferred = self._deferred, set()
+            for host in sorted(drain, key=lambda h: h.id):
                 backlog, host.ingress_deferred = host.ingress_deferred, []
                 for u in backlog:
                     self.ingress_arrival(u, round_start)
@@ -178,7 +183,9 @@ class NetworkEngine:
             self.tokens_down[u.dst] -= u.size
             self.hosts[u.dst].deliver(u, now)
         else:
-            self.hosts[u.dst].ingress_deferred.append(u)
+            h = self.hosts[u.dst]
+            h.ingress_deferred.append(u)
+            self._deferred.add(h)
 
     def end_of_round(self, round_start: SimTime, round_end: SimTime) -> None:
         """The round barrier: resolve all units emitted this round."""

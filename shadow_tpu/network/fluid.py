@@ -36,7 +36,8 @@ them; there is exactly ONE implementation of the bucket math, host-side):
 All quantities are integers (bytes, ns). The only floats anywhere are the
 float64 loss-threshold precompute at startup (quantize_loss).
 
-Unit sizes are bounded by MAX_UNIT (a handful of MTUs): streams are chunked
+Unit sizes are bounded by the configured quantum (experimental.unit_mtus,
+default MAX_UNIT): streams are chunked
 by the transport (shadow_tpu/network/transport.py), datagrams are fragmented
 by the socket layer. Loss probability scales with unit size exactly the same
 way on both backends with pure integer compares.
@@ -53,9 +54,14 @@ from shadow_tpu.ops.prng import threefry2x32, quantize_loss
 
 MTU = 1500  # bytes on the wire per packet
 HEADER = 40  # modeled header overhead per unit and per ack
-MAX_UNIT = 10 * MTU  # max wire bytes per transmission unit
-MAX_PKTS = 10  # = MAX_UNIT / MTU, loss draws per unit
-MIN_CAP = 16384  # token bucket capacity floor: one MAX_UNIT + headroom
+MAX_UNIT = 10 * MTU  # DEFAULT max wire bytes per transmission unit
+MAX_PKTS = 10  # = MAX_UNIT / MTU, loss draws per unit (default quantum)
+#: experimental.unit_mtus can widen the fluid quantum up to this bound;
+#: the per-packet counter packing (PKT_SHIFT) reserves 6 bits, and uid
+#: packing then caps host ids at 2**18 (enforced in NetParams.build)
+HARD_MAX_PKTS = 64
+PKT_SHIFT = 26  # packet-lane index position inside the threefry counter
+MIN_CAP = 16384  # token bucket capacity floor: one default MAX_UNIT + room
 #: per-host rate ceiling (bytes/sec) keeping rate * 1e9 within uint64
 #: (the closed-form math runs its two sub-second products in uint64)
 MAX_RATE = 16_000_000_000  # 128 Gbit/s
@@ -84,18 +90,26 @@ class NetParams:
         reliability: np.ndarray,
         seed: int,
         round_ns: SimTime,
+        max_unit: int = MAX_UNIT,
     ) -> "NetParams":
         rate_up = np.asarray(rate_up, dtype=np.int64)
         rate_down = np.asarray(rate_down, dtype=np.int64)
         if (rate_up <= 0).any() or (rate_down <= 0).any():
             raise ValueError("host bandwidths must be > 0")
+        if len(host_node) >= (1 << 18):
+            # uid packing: host id occupies uid_hi bits 8.., the packet
+            # lane occupies bits PKT_SHIFT.. — they must not overlap
+            raise ValueError("host count exceeds 2**18 (uid packing bound)")
         if (rate_up > MAX_RATE).any() or (rate_down > MAX_RATE).any():
             raise ValueError(
                 f"host bandwidth exceeds {MAX_RATE} B/s (~72 Gbit/s), the "
                 "integer-exact ceiling of the closed-form bucket math"
             )
-        cap_up = np.maximum(rate_up * round_ns // NS_PER_SEC, MIN_CAP)
-        cap_down = np.maximum(rate_down * round_ns // NS_PER_SEC, MIN_CAP)
+        # capacity floor: at least one full unit (+ header) must fit, or a
+        # max-size unit could never clear the bucket
+        floor = max(MIN_CAP, max_unit + HEADER)
+        cap_up = np.maximum(rate_up * round_ns // NS_PER_SEC, floor)
+        cap_down = np.maximum(rate_down * round_ns // NS_PER_SEC, floor)
         limit = (np.int64(1) << np.int64(31)) - 1
         # capacities stay int32-safe so offsets fit device-side arrays
         cap_up = np.minimum(cap_up, limit - 1)
@@ -210,7 +224,7 @@ def loss_flags(seed: int, uid_lo: np.ndarray, uid_hi: np.ndarray,
     k = int(npk.max())
     pkt = np.arange(k, dtype=np.uint32)[None, :]
     c0 = np.broadcast_to(lo[:, None], (lo.shape[0], k))
-    c1 = hi[:, None] | (pkt << np.uint32(28))
+    c1 = hi[:, None] | (pkt << np.uint32(PKT_SHIFT))
     k0 = np.uint32(seed & 0xFFFFFFFF)
     k1 = np.uint32((seed >> 32) & 0xFFFFFFFF)
     draws, _ = threefry2x32(k0, k1, c0, c1, xp=np)

@@ -45,12 +45,11 @@ from collections import deque
 from typing import Callable, Optional
 
 from shadow_tpu.core.time import NS_PER_MS, SimTime
-from shadow_tpu.network.fluid import HEADER, MAX_UNIT
+from shadow_tpu.network.fluid import HEADER
 from shadow_tpu.network import unit as U
 from shadow_tpu.network.unit import Unit
 
 MSS = 1460  # cwnd growth quantum (classic ethernet MSS)
-CHUNK = MAX_UNIT - HEADER  # max stream payload bytes per unit
 INIT_CWND = 10 * MSS  # RFC 6928
 MIN_CWND = 2 * MSS
 RTO_MIN_NS = 200 * NS_PER_MS
@@ -64,6 +63,7 @@ class StreamSender:
 
     def __init__(self, endpoint: "StreamEndpoint", send_buffer: int):
         self.ep = endpoint
+        self.chunk = endpoint.host.unit_chunk  # fluid quantum payload size
         self.cwnd = INIT_CWND
         self.ssthresh = 1 << 62
         self.send_buffer = send_buffer
@@ -105,9 +105,9 @@ class StreamSender:
             # chunks or the final tail of the app buffer; sub-chunk window
             # remainders wait for more acks — except when idle, where
             # sending something is what restarts the ack clock
-            if usable < CHUNK and usable < self.buffered and self.inflight > 0:
+            if usable < self.chunk and usable < self.buffered and self.inflight > 0:
                 break
-            budget = min(usable, CHUNK)
+            budget = min(usable, self.chunk)
             nbytes, payload = self.sendbuf[0]
             if nbytes <= budget:
                 self.sendbuf.popleft()
@@ -478,11 +478,12 @@ class DatagramSocket:
             nbytes = max(nbytes, len(payload))
         dgram = self._next_dgram
         self._next_dgram += 1
-        nfrags = max(1, -(-nbytes // CHUNK))
+        chunk = self.host.unit_chunk
+        nfrags = max(1, -(-nbytes // chunk))
         self.host.counters.add("dgrams_sent", 1)
         for i in range(nfrags):
-            lo = i * CHUNK
-            hi = min(nbytes, lo + CHUNK)
+            lo = i * chunk
+            hi = min(nbytes, lo + chunk)
             u = Unit(
                 uid=self.host.next_uid(),
                 src=self.host.id,

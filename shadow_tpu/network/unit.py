@@ -1,7 +1,8 @@
 """Transmission units — the simulator's wire-level quantum.
 
 The reference models individual packets (SURVEY.md §2 "Packet"); we batch at
-a slightly coarser quantum called a *unit*: up to MAX_PKTS MTU-sized packets
+a slightly coarser quantum called a *unit*: up to experimental.unit_mtus
+MTU-sized packets
 that travel together (loss is still sampled per MTU packet inside the unit,
 see shadow_tpu/network/fluid.py). Streams are chunked into units by the
 transport; datagrams are fragmented into units by the socket layer. This
@@ -19,7 +20,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from shadow_tpu.core.time import SimTime
-from shadow_tpu.network.fluid import HEADER, MAX_PKTS, MTU
+from shadow_tpu.network.fluid import HARD_MAX_PKTS, HEADER, MTU
 
 # unit kinds
 SYN, SYNACK, DATA, ACK, FIN, FINACK, DGRAM = range(7)
@@ -50,7 +51,7 @@ class Unit:
 
     @property
     def npkts(self) -> int:
-        return min(max(1, -(-self.size // MTU)), MAX_PKTS)
+        return min(max(1, -(-self.size // MTU)), HARD_MAX_PKTS)
 
 
 def wire_size(payload_bytes: int) -> int:

@@ -35,28 +35,34 @@ def threefry2x32(k0, k1, c0, c1, xp=np):
     def as_u32(v):
         return xp.asarray(v, dtype=xp.uint32)
 
+    def cast(v):
+        # uint32-op-uint32 already yields uint32: skip the copying astype
+        # (same bits either way; this is the numpy hot path's biggest cost)
+        return v if getattr(v, "dtype", None) == np.uint32 \
+            else v.astype(xp.uint32)
+
     k0, k1, c0, c1 = as_u32(k0), as_u32(k1), as_u32(c0), as_u32(c1)
     ks = (k0, k1, xp.bitwise_xor(xp.bitwise_xor(k0, k1), u32(_PARITY)))
 
     def rotl(x, r):
-        return xp.bitwise_or(
+        return cast(xp.bitwise_or(
             (x << u32(r)) & u32(0xFFFFFFFF), x >> u32(32 - r)
-        ).astype(xp.uint32)
+        ))
 
     # uint32 wraparound is intended; numpy warns on scalar overflow only.
     ctx = np.errstate(over="ignore") if xp is np else contextlib.nullcontext()
     with ctx:
-        x0 = (c0 + ks[0]).astype(xp.uint32)
-        x1 = (c1 + ks[1]).astype(xp.uint32)
+        x0 = cast(c0 + ks[0])
+        x1 = cast(c1 + ks[1])
         for group in range(5):
             rots = _ROT_A if group % 2 == 0 else _ROT_B
             for r in rots:
-                x0 = (x0 + x1).astype(xp.uint32)
+                x0 = cast(x0 + x1)
                 x1 = rotl(x1, r)
                 x1 = xp.bitwise_xor(x0, x1)
             j = group + 1
-            x0 = (x0 + ks[j % 3]).astype(xp.uint32)
-            x1 = (x1 + ks[(j + 1) % 3] + u32(j)).astype(xp.uint32)
+            x0 = cast(x0 + ks[j % 3])
+            x1 = cast(x1 + ks[(j + 1) % 3] + u32(j))
     return x0, x1
 
 

@@ -41,7 +41,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from shadow_tpu.network.fluid import MAX_PKTS, loss_flags
+from shadow_tpu.network.fluid import MAX_PKTS, PKT_SHIFT, loss_flags
 
 #: padded-bucket floor; buckets are powers of two between MIN and the cap
 MIN_BUCKET = 256
@@ -55,8 +55,8 @@ def _bucket(n: int, cap: int) -> int:
     return b
 
 
-@functools.partial(jax.jit, static_argnames=("seed",))
-def _draw_kernel(packed, seed):
+@functools.partial(jax.jit, static_argnames=("seed", "width"))
+def _draw_kernel(packed, seed, width=MAX_PKTS):
     """packed: (4, P) uint32 rows [uid_lo, uid_hi, npkts, thresh]; returns
     (P,) bool dropped flags. Mirrors fluid.loss_flags exactly: a unit drops
     iff any of its first npkts threefry draws is below its q24 threshold.
@@ -65,9 +65,9 @@ def _draw_kernel(packed, seed):
 
     uid_lo, uid_hi, npkts, thresh = packed
     p = uid_lo.shape[0]
-    pkt = jnp.arange(MAX_PKTS, dtype=jnp.uint32)[None, :]
-    c0 = jnp.broadcast_to(uid_lo[:, None], (p, MAX_PKTS))
-    c1 = uid_hi[:, None] | (pkt << jnp.uint32(28))
+    pkt = jnp.arange(width, dtype=jnp.uint32)[None, :]
+    c0 = jnp.broadcast_to(uid_lo[:, None], (p, width))
+    c1 = uid_hi[:, None] | (pkt << jnp.uint32(PKT_SHIFT))
     k0 = jnp.uint32(seed & 0xFFFFFFFF)
     k1 = jnp.uint32((seed >> 32) & 0xFFFFFFFF)
     draws, _ = threefry2x32(k0, k1, c0, c1, xp=jnp)
@@ -102,7 +102,7 @@ class DeviceDrawPlane:
     name = "tpu"
 
     def __init__(self, seed: int, max_batch: int = 65536,
-                 n_shards: int = 0) -> None:
+                 n_shards: int = 0, max_pkts: int = MAX_PKTS) -> None:
         """n_shards > 1 shards each batch over that many local devices
         (experimental.tpu_mesh_shards; 0 = all local devices). The kernel
         is elementwise along the unit axis, so XLA partitions it with no
@@ -112,6 +112,7 @@ class DeviceDrawPlane:
         configure()
         self.seed = int(seed)
         self.max_batch = int(max_batch)
+        self.max_pkts = int(max_pkts)  # kernel packet-lane width
         self._sharding = None
         devs = jax.devices()
         n = n_shards if n_shards > 0 else len(devs)
@@ -139,7 +140,7 @@ class DeviceDrawPlane:
         packed[3, :n] = thresh
         dev_in = (jax.device_put(packed, self._sharding)
                   if self._sharding is not None else jnp.asarray(packed))
-        out = _draw_kernel(dev_in, seed=self.seed)
+        out = _draw_kernel(dev_in, seed=self.seed, width=self.max_pkts)
         try:
             out.copy_to_host_async()
         except AttributeError:  # some backends lack the hint; read() suffices
@@ -153,7 +154,7 @@ class DeviceDrawPlane:
         rng = np.random.default_rng(0)
         lo = rng.integers(0, 1 << 32, n_probe, dtype=np.uint64).astype(np.uint32)
         hi = rng.integers(0, 1 << 32, n_probe, dtype=np.uint64).astype(np.uint32)
-        npk = np.full(n_probe, MAX_PKTS, np.uint32)
+        npk = np.full(n_probe, self.max_pkts, np.uint32)
         th = np.full(n_probe, 1 << 10, np.uint32)
         self.dispatch(lo, hi, npk, th).read()  # compile + warm
         t0 = _walltime.perf_counter()

@@ -43,7 +43,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from shadow_tpu.core.time import NS_PER_SEC
-from shadow_tpu.network.fluid import MAX_PKTS, MIN_CAP, MTU, NetParams
+from shadow_tpu.network.fluid import MAX_PKTS, MIN_CAP, MTU, PKT_SHIFT, NetParams
 from shadow_tpu.ops.jaxcfg import configure
 from shadow_tpu.ops.prng import threefry2x32
 
@@ -69,7 +69,7 @@ def _ceil_ns(need, rate):
     return q * NS_PER_SEC + frac
 
 
-def _round_step(n_shards, seed, state, units, tables, t_now):
+def _round_step(n_shards, seed, max_pkts, state, units, tables, t_now):
     """One shard's view of the round. All ``units`` arrays are (1, C) blocks
     (shard_map splits the global (N, C)); state is (1, Hs). tables
     (host_node, lat, thresh, rate, cap) are replicated."""
@@ -122,10 +122,10 @@ def _round_step(n_shards, seed, state, units, tables, t_now):
     # per-packet threefry draws — identical integer math to fluid.loss_flags
     uid_lo = (uid & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
     uid_hi = ((uid >> jnp.int64(32)) & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
-    npkts = jnp.minimum(jnp.maximum(1, -(-size // MTU)), MAX_PKTS)
-    pkt = jnp.arange(MAX_PKTS, dtype=jnp.uint32)[None, :]
-    c0 = jnp.broadcast_to(uid_lo[:, None], (c, MAX_PKTS))
-    c1 = uid_hi[:, None] | (pkt << jnp.uint32(28))
+    npkts = jnp.minimum(jnp.maximum(1, -(-size // MTU)), max_pkts)
+    pkt = jnp.arange(max_pkts, dtype=jnp.uint32)[None, :]
+    c0 = jnp.broadcast_to(uid_lo[:, None], (c, max_pkts))
+    c1 = uid_hi[:, None] | (pkt << jnp.uint32(PKT_SHIFT))
     draws, _ = threefry2x32(jnp.uint32(seed & 0xFFFFFFFF),
                             jnp.uint32((seed >> 32) & 0xFFFFFFFF),
                             c0, c1, xp=jnp)
@@ -169,7 +169,8 @@ class MeshDataPlane:
     """
 
     def __init__(self, params: NetParams, n_shards: int | None = None,
-                 units_per_shard: int = 1024, devices=None) -> None:
+                 units_per_shard: int = 1024, devices=None,
+                 max_pkts: int = MAX_PKTS) -> None:
         configure()
         import jax as _jax
 
@@ -218,7 +219,7 @@ class MeshDataPlane:
 
         self._step = jax.jit(
             jax.shard_map(
-                partial(_round_step, n, int(params.seed)),
+                partial(_round_step, n, int(params.seed), int(max_pkts)),
                 mesh=self.mesh,
                 in_specs=((P(AXIS), P(AXIS), P(AXIS)),
                           (P(AXIS),) * 5,
