@@ -48,10 +48,6 @@ DEFAULT_BANDWIDTH = parse_bandwidth("1 Gbit")
 _GC_EVERY_ROUNDS = 5000
 
 
-def _host_id(h):
-    return h.id
-
-
 class Controller:
     def __init__(self, cfg: ConfigOptions, mirror_log: bool = True) -> None:
         self.cfg = cfg
@@ -131,10 +127,10 @@ class Controller:
         # active-host tracking: per-round work is O(hosts with pending
         # events), not O(all hosts) — the difference at 10k mostly-idle
         # hosts. A host (re)activates on its queue's empty->nonempty edge.
-        self._active: set = set()
+        self._active: set = set()  # host IDS (ints sort at C speed)
         for h in self.hosts:
             h.engine = self.engine
-            h.equeue.on_first = partial(self._active.add, h)
+            h.equeue.on_first = partial(self._active.add, h.id)
         self.scheduler = make_scheduler(policy, self.hosts, cfg.general.parallelism)
 
         # processes: pyapp: plugins run in-process; any other path is a real
@@ -210,11 +206,12 @@ class Controller:
         while now < stop:
             round_end = min(now + w, stop)
             self.engine.start_of_round(now, round_end)
-            active = sorted(self._active, key=_host_id)
+            hosts = self.hosts
+            active = [hosts[i] for i in sorted(self._active)]
             executed = self.scheduler.run_round(round_end, active)
             for h in active:
                 if not h.equeue._heap:
-                    self._active.discard(h)
+                    self._active.discard(h.id)
             self.engine.end_of_round(now, round_end)
             self.rounds += 1
             self.events += executed
@@ -235,12 +232,12 @@ class Controller:
                 # hence 'rounds' and bucket rebase instants — identical to a
                 # run whose flags were computed inline (test_bitmatch.py::
                 # test_device_floor_cannot_change_results).
-                nt = min((h.equeue.next_time() for h in self._active),
-                         default=T_NEVER)
+                nt = min((hosts[i].equeue.next_time()
+                          for i in self._active), default=T_NEVER)
                 while self.engine.earliest_outstanding() < nt:
                     self.engine.flush_due(nt)
-                    nt = min((h.equeue.next_time() for h in self._active),
-                             default=T_NEVER)
+                    nt = min((hosts[i].equeue.next_time()
+                              for i in self._active), default=T_NEVER)
                 if nt >= T_NEVER:
                     self.log.info(
                         f"no further events at {format_time(round_end)}; ending early"
@@ -296,6 +293,7 @@ class Controller:
             if reap is not None:
                 reap()
         for h in self.hosts:  # merge AFTER reaping so its counters land
+            h.fold_counters()
             self.counters.merge(h.counters)
         sim_sec = end_time / NS_PER_SEC
         rate = sim_sec / self.wall_seconds if self.wall_seconds > 0 else float("inf")

@@ -45,6 +45,12 @@ class Host:
         self._now: SimTime = 0
         self._uid_counter = 0
         self.egress: list[Unit] = []  # units emitted this round (FIFO)
+        # hot-path counters kept as plain ints (Counter.__getitem__ per
+        # unit measurably drags at 1M+ units); folded in fold_counters()
+        self._n_emitted = 0
+        self._n_delivered = 0
+        self._n_dgrams = 0
+        self._n_dgrams_recv = 0
         self.ingress_deferred: list[Unit] = []  # ingress-bucket backlog
         self.processes: list = []
         # sockets
@@ -72,6 +78,20 @@ class Host:
     def cancel(self, handle: int) -> None:
         self.equeue.cancel(handle)
 
+    def fold_counters(self) -> None:
+        """Fold the int-attribute hot counters into the Counter object
+        (called once at finalize, before the controller merges)."""
+        if self._n_emitted:
+            self.counters.add("units_emitted", self._n_emitted)
+        if self._n_delivered:
+            self.counters.add("units_delivered", self._n_delivered)
+        if self._n_dgrams:
+            self.counters.add("dgrams_sent", self._n_dgrams)
+        if self._n_dgrams_recv:
+            self.counters.add("dgrams_received", self._n_dgrams_recv)
+        self._n_emitted = self._n_delivered = self._n_dgrams = 0
+        self._n_dgrams_recv = 0
+
     def run_events(self, end: SimTime) -> int:
         """Execute all pending events with time < end (one round's worth)."""
         n = 0
@@ -90,7 +110,7 @@ class Host:
 
     def emit_unit(self, u: Unit) -> None:
         self.egress.append(u)
-        self.counters.add("units_emitted", 1)
+        self._n_emitted += 1
         if self.pcap is not None:
             ctl = self.controller
             self.pcap.capture(u, u.t_emit, self.ip, ctl.hosts[u.dst].ip)
@@ -98,7 +118,7 @@ class Host:
     def deliver(self, u: Unit, now: SimTime) -> None:
         """A unit cleared the ingress token bucket: dispatch to a socket."""
         self._now = max(self._now, now)
-        self.counters.add("units_delivered", 1)
+        self._n_delivered += 1
         if self.pcap is not None:
             self.pcap.capture(u, now, self.controller.hosts[u.src].ip, self.ip)
         if u.kind == U.DGRAM:

@@ -480,7 +480,7 @@ class DatagramSocket:
         self._next_dgram += 1
         chunk = self.host.unit_chunk
         nfrags = max(1, -(-nbytes // chunk))
-        self.host.counters.add("dgrams_sent", 1)
+        self.host._n_dgrams += 1
         for i in range(nfrags):
             lo = i * chunk
             hi = min(nbytes, lo + chunk)
@@ -522,6 +522,6 @@ class DatagramSocket:
             self._partial.pop(next(iter(self._partial)))
 
     def _deliver(self, nbytes, payload, src_addr, now) -> None:
-        self.host.counters.add("dgrams_received", 1)
+        self.host._n_dgrams_recv += 1
         if self.on_datagram is not None:
             self.on_datagram(nbytes, payload, src_addr, now)
