@@ -40,6 +40,7 @@
 #include <sys/socket.h>
 #include <sys/uio.h>
 #include <stddef.h>
+#include <stdio.h>
 #include <stdint.h>
 #include <stdlib.h>
 #include <string.h>
@@ -158,6 +159,64 @@ static void shim_refresh_real_ids(void) {
   if (pid > 0) { shim_real_pid = pid; shim_real_tid = pid; }
 }
 
+/* ---- execve -------------------------------------------------------------
+ *
+ * Reference analog: managed processes exec'ing other binaries (SURVEY.md
+ * §3.2 — Shadow keeps children managed across exec). The seccomp filter
+ * traps every execve EXCEPT one whose envp pointer is exactly
+ * ``shim_exec_envp`` (the address is compiled into the filter at install
+ * time): the handler rewrites the environment there — dropping any
+ * inherited shim vars, appending authoritative copies — and re-issues the
+ * exec natively. The fresh image loads the shim again (fds survive exec;
+ * the old filter persists and simply stacks under the new one) and
+ * re-handshakes on the same channel; the worker treats a mid-life HELLO
+ * as an exec. Scope: exec from the main thread (the fork+exec idiom) —
+ * the kernel kills sibling threads at exec, and the worker reaps their
+ * records at the HELLO. */
+
+/* The exec-gate envp array lives at a FIXED address mmap'd by every shim
+ * instance: stacked filters from previous images (which persist across
+ * exec, each compiled with its own idea of the gate address) must all
+ * agree, or an exec'd image could never exec again. 4 pages = 2044
+ * entries + the 3 shim vars + NULL. */
+#define SHIM_EXEC_ADDR ((void *)0x5D5D00000000ul)
+#define SHIM_EXEC_PAGES 4
+#define SHIM_EXEC_MAX_ENV \
+    ((int)(SHIM_EXEC_PAGES * 4096 / sizeof(char *)) - 4)
+static char **shim_exec_envp; /* == SHIM_EXEC_ADDR once mapped */
+static char shim_env_preload[1024];
+static char shim_env_active[16];
+static char shim_env_shm[1024];
+static int shim_env_ok; /* 0: truncated paths or no gate page — exec off */
+
+static long shim_do_exec(const char *path, char **argv, char **envp) {
+  if (!shim_env_ok || shim_exec_envp == NULL)
+    return -ENOMEM; /* injected env unusable: fail loudly, never silently */
+  int n = 0;
+  if (envp)
+    for (char **e = envp; *e; e++) {
+      if (!strncmp(*e, "LD_PRELOAD=", 11) ||
+          !strncmp(*e, "SHADOW_SHIM=", 12) ||
+          !strncmp(*e, "SHADOW_TIME_SHM=", 16))
+        continue;
+      if (n >= SHIM_EXEC_MAX_ENV)
+        return -E2BIG; /* never silently drop guest environment */
+      shim_exec_envp[n++] = *e;
+    }
+  shim_exec_envp[n++] = shim_env_preload;
+  shim_exec_envp[n++] = shim_env_active;
+  shim_exec_envp[n++] = shim_env_shm;
+  shim_exec_envp[n] = NULL;
+  /* PR_SET_TSC persists across exec but the SIGSEGV handler does not:
+   * ld.so executes rdtsc during startup and would die on a GPF. Disarm;
+   * the new image's ctor re-arms. */
+  raw3(SYS_prctl, PR_SET_TSC, PR_TSC_ENABLE, 0);
+  long r = raw3(SYS_execve, (long)path, (long)argv, (long)shim_exec_envp);
+  /* exec failed: restore TSC virtualization for the current image */
+  raw3(SYS_prctl, PR_SET_TSC, PR_TSC_SIGSEGV, 0);
+  return r;
+}
+
 /* Reference analog: managed-process fork (SURVEY.md §3.2 sibling path).
  * The worker mints the child's channel (FORK_INTENT -> SCM_RIGHTS fd),
  * the REAL fork runs here in the guest, the child rebinds the fresh
@@ -185,11 +244,17 @@ static long shim_do_fork(uint64_t nr, greg_t *g) {
   }
   if (child == 0) {
     /* child: own fd table — rebind the fresh channel to the main slot,
-     * drop inherited per-thread channels */
+     * and sever inherited per-thread channels by dup2'ing /dev/null over
+     * them (close() on the IPC window is trapped — the worker must not
+     * see channel traffic from this thread before its HELLO) */
     raw3(SYS_dup2, newfd, SHIM_IPC_FD, 0);
     if (newfd != SHIM_IPC_FD) raw3(SYS_close, newfd, 0, 0);
-    for (int fd = SHIM_IPC_LOW; fd < SHIM_IPC_FD; fd++)
-      raw3(SYS_close, fd, 0, 0);
+    int nullfd = (int)raw3(SYS_open, (long)"/dev/null", 2 /*O_RDWR*/, 0);
+    if (nullfd >= 0) {
+      for (int fd = SHIM_IPC_LOW; fd < SHIM_IPC_FD; fd++)
+        raw3(SYS_dup2, nullfd, fd, 0);
+      raw3(SYS_close, nullfd, 0, 0);
+    }
     shim_tls_fd = SHIM_IPC_FD;
     shim_refresh_real_ids();
     forward(SHIM_THREAD_HELLO, 0, 0, 0, 0, 0, 0); /* first turn grant */
@@ -211,6 +276,12 @@ static void sigsys_handler(int signo, siginfo_t *info, void *vctx) {
       return;
     }
     g[REG_RAX] = (greg_t)shim_do_fork((uint64_t)info->si_syscall, g);
+    return;
+  }
+  if (info->si_syscall == SYS_execve) {
+    g[REG_RAX] = (greg_t)shim_do_exec((const char *)g[REG_RDI],
+                                      (char **)g[REG_RSI],
+                                      (char **)g[REG_RDX]);
     return;
   }
   if (info->si_syscall == SYS_exit_group) {
@@ -338,11 +409,20 @@ static void sigsegv_handler(int signo, siginfo_t *info, void *vctx) {
 /* sigaction/signal interposition: SIGSEGV dispositions are recorded, not
  * installed — the shim's handler stays first and chains (above). */
 
+static struct sigaction guest_sys; /* guest's requested SIGSYS disposition
+                                      (recorded only — the shim's handler
+                                      IS the syscall mechanism and must
+                                      never be uninstalled; guests bulk-
+                                      resetting handlers, e.g. CPython's
+                                      subprocess child, would otherwise
+                                      die on their next trapped call) */
+
 int sigaction(int sig, const struct sigaction *act, struct sigaction *old) {
-  if (!shim_active || sig != SIGSEGV)
+  if (!shim_active || (sig != SIGSEGV && sig != SIGSYS))
     return real_sigaction(sig, act, old);
-  if (old) *old = guest_segv;
-  if (act) guest_segv = *act;
+  struct sigaction *slot = (sig == SIGSEGV) ? &guest_segv : &guest_sys;
+  if (old) *old = *slot;
+  if (act) *slot = *act;
   return 0;
 }
 
@@ -436,6 +516,20 @@ static void *shim_thread_tramp(void *p) {
   return r;
 }
 
+pid_t vfork(void) {
+  /* vfork-as-fork: POSIX permits it, and the fork path (trapped clone ->
+   * shim_do_fork) keeps the child managed; the parent just continues
+   * instead of suspending. CPython's subprocess and shell spawn idioms
+   * land here. */
+  static pid_t (*realfork)(void);
+  if (!realfork) {
+    union { void *p; pid_t (*f)(void); } u;
+    u.p = dlsym(RTLD_NEXT, "fork");
+    realfork = u.f;
+  }
+  return realfork();
+}
+
 int pthread_create(pthread_t *out, const pthread_attr_t *attr,
                    void *(*fn)(void *), void *arg) {
   static int (*real)(pthread_t *, const pthread_attr_t *,
@@ -503,6 +597,8 @@ void pthread_exit(void *retval) {
 
 #define BPF_NR (offsetof(struct seccomp_data, nr))
 #define BPF_ARG0 (offsetof(struct seccomp_data, args[0]))
+#define BPF_ARG2LO (offsetof(struct seccomp_data, args[2]))
+#define BPF_ARG2HI (offsetof(struct seccomp_data, args[2]) + 4)
 #define BPF_ARCHF (offsetof(struct seccomp_data, arch))
 
 #define LD(off) BPF_STMT(BPF_LD | BPF_W | BPF_ABS, (off))
@@ -513,71 +609,84 @@ void pthread_exit(void *retval) {
 
 static int install_seccomp(void) {
   /* BEGIN GENERATED BPF (tools/gen_bpf.py) */
-  struct sock_filter prog[] = {  /* 66 instructions */
+  struct sock_filter prog[] = {  /* 79 instructions */
       LD(BPF_ARCHF),
-      JEQ(AUDIT_ARCH_X86_64, 0, 63),
+      JEQ(AUDIT_ARCH_X86_64, 0, 76),
       LD(BPF_NR),
-      JEQ(0, 42, 0),  /* read */
-      JEQ(1, 46, 0),  /* write */
-      JEQ(19, 40, 0),  /* readv */
-      JEQ(20, 44, 0),  /* writev */
-      JEQ(3, 54, 0),  /* close */
-      JEQ(16, 53, 0),  /* ioctl */
-      JEQ(72, 52, 0),  /* fcntl */
-      JEQ(32, 51, 0),  /* dup */
-      JEQ(33, 50, 0),  /* dup2 */
-      JEQ(292, 49, 0),  /* dup3 */
-      JEQ(35, 50, 0),  /* nanosleep */
-      JEQ(230, 49, 0),  /* clock_nanosleep */
-      JEQ(228, 48, 0),  /* clock_gettime */
-      JEQ(96, 47, 0),  /* gettimeofday */
-      JEQ(201, 46, 0),  /* time */
-      JEQ(318, 45, 0),  /* getrandom */
-      JEQ(7, 44, 0),  /* poll */
-      JEQ(271, 43, 0),  /* ppoll */
-      JEQ(213, 42, 0),  /* epoll_create */
-      JEQ(291, 41, 0),  /* epoll_create1 */
-      JEQ(233, 40, 0),  /* epoll_ctl */
-      JEQ(232, 39, 0),  /* epoll_wait */
-      JEQ(281, 38, 0),  /* epoll_pwait */
-      JEQ(288, 37, 0),  /* accept4 */
-      JEQ(435, 36, 0),  /* clone3 */
-      JEQ(39, 35, 0),  /* getpid */
-      JEQ(110, 34, 0),  /* getppid */
-      JEQ(186, 33, 0),  /* gettid */
-      JEQ(283, 32, 0),  /* timerfd_create */
-      JEQ(286, 31, 0),  /* timerfd_settime */
-      JEQ(287, 30, 0),  /* timerfd_gettime */
-      JEQ(284, 29, 0),  /* eventfd */
-      JEQ(290, 28, 0),  /* eventfd2 */
-      JEQ(202, 27, 0),  /* futex */
-      JEQ(14, 26, 0),  /* rt_sigprocmask */
-      JEQ(22, 25, 0),  /* pipe */
-      JEQ(293, 24, 0),  /* pipe2 */
-      JEQ(61, 23, 0),  /* wait4 */
-      JEQ(231, 22, 0),  /* exit_group */
-      JEQ(47, 13, 0),  /* recvmsg */
-      JEQ(56, 15, 0),  /* clone */
-      JGE(41, 0, 20),  /* socket */
-      JGE(60, 19, 18),  /* clone_end */
+      JEQ(0, 47, 0),  /* read */
+      JEQ(1, 51, 0),  /* write */
+      JEQ(3, 65, 0),  /* close */
+      JEQ(19, 44, 0),  /* readv */
+      JEQ(20, 48, 0),  /* writev */
+      JEQ(16, 65, 0),  /* ioctl */
+      JEQ(72, 64, 0),  /* fcntl */
+      JEQ(32, 63, 0),  /* dup */
+      JEQ(33, 62, 0),  /* dup2 */
+      JEQ(292, 61, 0),  /* dup3 */
+      JEQ(5, 60, 0),  /* fstat */
+      JEQ(8, 59, 0),  /* lseek */
+      JEQ(262, 58, 0),  /* newfstatat */
+      JEQ(35, 60, 0),  /* nanosleep */
+      JEQ(230, 59, 0),  /* clock_nanosleep */
+      JEQ(228, 58, 0),  /* clock_gettime */
+      JEQ(96, 57, 0),  /* gettimeofday */
+      JEQ(201, 56, 0),  /* time */
+      JEQ(318, 55, 0),  /* getrandom */
+      JEQ(7, 54, 0),  /* poll */
+      JEQ(271, 53, 0),  /* ppoll */
+      JEQ(213, 52, 0),  /* epoll_create */
+      JEQ(291, 51, 0),  /* epoll_create1 */
+      JEQ(233, 50, 0),  /* epoll_ctl */
+      JEQ(232, 49, 0),  /* epoll_wait */
+      JEQ(281, 48, 0),  /* epoll_pwait */
+      JEQ(288, 47, 0),  /* accept4 */
+      JEQ(435, 46, 0),  /* clone3 */
+      JEQ(39, 45, 0),  /* getpid */
+      JEQ(110, 44, 0),  /* getppid */
+      JEQ(186, 43, 0),  /* gettid */
+      JEQ(283, 42, 0),  /* timerfd_create */
+      JEQ(286, 41, 0),  /* timerfd_settime */
+      JEQ(287, 40, 0),  /* timerfd_gettime */
+      JEQ(284, 39, 0),  /* eventfd */
+      JEQ(290, 38, 0),  /* eventfd2 */
+      JEQ(202, 37, 0),  /* futex */
+      JEQ(14, 36, 0),  /* rt_sigprocmask */
+      JEQ(22, 35, 0),  /* pipe */
+      JEQ(293, 34, 0),  /* pipe2 */
+      JEQ(61, 33, 0),  /* wait4 */
+      JEQ(231, 32, 0),  /* exit_group */
+      JEQ(436, 31, 0),  /* close_range */
+      JEQ(47, 14, 0),  /* recvmsg */
+      JEQ(56, 16, 0),  /* clone */
+      JEQ(59, 18, 0),  /* execve */
+      JGE(41, 0, 28),  /* socket */
+      JGE(60, 27, 26),  /* clone_end */
       LD(BPF_ARG0),
       JGE(SHIM_IPC_LOW, 0, 1),
-      JGE((SHIM_IPC_FD + 1), 0, 16),
-      JEQ(0, 14, 0),  /* read */
-      JGE(SHIM_VFD_BASE, 13, 14),
+      JGE((SHIM_IPC_FD + 1), 0, 24),
+      JEQ(0, 22, 0),  /* read */
+      JGE(SHIM_VFD_BASE, 21, 22),
       LD(BPF_ARG0),
       JGE(SHIM_IPC_LOW, 0, 1),
-      JGE((SHIM_IPC_FD + 1), 0, 11),
-      JGE(3, 0, 9),  /* close */
-      JGE(SHIM_VFD_BASE, 8, 9),
+      JGE((SHIM_IPC_FD + 1), 0, 19),
+      JGE(3, 0, 17),  /* close */
+      JGE(SHIM_VFD_BASE, 16, 17),
       LD(BPF_ARG0),
-      JGE(SHIM_IPC_LOW, 0, 6),
-      JGE((SHIM_IPC_FD + 1), 5, 6),
+      JGE(SHIM_IPC_LOW, 0, 14),
+      JGE((SHIM_IPC_FD + 1), 13, 14),
       LD(BPF_ARG0),
-      JSET(65536, 4, 0),  /* CLONE_THREAD */
-      JSET(2147483648, 3, 2),  /* CLONE_IO (shim fork replay) */
+      JSET(65536, 12, 0),  /* CLONE_THREAD */
+      JSET(2147483648, 11, 10),  /* CLONE_IO (shim fork replay) */
+      LD(BPF_ARG2LO),
+      JEQ((uint32_t)(uintptr_t)SHIM_EXEC_ADDR, 0, 8),
+      LD(BPF_ARG2HI),
+      JEQ((uint32_t)((uintptr_t)SHIM_EXEC_ADDR >> 32), 7, 6),
       LD(BPF_ARG0),
-      JGE(SHIM_VFD_BASE, 0, 1),
+      JGE(SHIM_IPC_LOW, 0, 2),
+      JGE((SHIM_IPC_FD + 1), 1, 3),
+      LD(BPF_ARG0),
+      JGE(SHIM_VFD_BASE, 0, 2),
+      JGE(4294963200, 1, 0),
       RET(SECCOMP_RET_TRAP),
       RET(SECCOMP_RET_ALLOW),
   };
@@ -592,10 +701,28 @@ static int install_seccomp(void) {
 __attribute__((constructor)) static void shim_init(void) {
   const char *on = getenv("SHADOW_SHIM");
   if (!on || on[0] != '1') return; /* not under the simulator */
-  shim_real_pid = raw3(SYS_getpid, 0, 0, 0); /* pre-seccomp: real ids */
-  shim_real_tid = raw3(SYS_gettid, 0, 0, 0);
+  /* real ids from /proc, NOT raw getpid: after an execve the previous
+   * image's seccomp filter is already live and would trap it */
+  shim_refresh_real_ids();
 
+  const char *pl = getenv("LD_PRELOAD");
+  int k1 = snprintf(shim_env_preload, sizeof shim_env_preload,
+                    "LD_PRELOAD=%s", pl ? pl : "");
+  memcpy(shim_env_active, "SHADOW_SHIM=1", 14);
   const char *shm = getenv("SHADOW_TIME_SHM");
+  int k2 = snprintf(shim_env_shm, sizeof shim_env_shm,
+                    "SHADOW_TIME_SHM=%s", shm ? shm : "");
+  /* the exec gate page at its fixed address (shared convention across
+   * exec'd images — see shim_do_exec); truncated shim vars or a collided
+   * mapping disable exec support instead of corrupting it */
+  void *page = mmap(SHIM_EXEC_ADDR, SHIM_EXEC_PAGES * 4096,
+                    PROT_READ | PROT_WRITE,
+                    MAP_PRIVATE | MAP_ANONYMOUS | MAP_FIXED_NOREPLACE, -1, 0);
+  if (page == SHIM_EXEC_ADDR)
+    shim_exec_envp = (char **)page;
+  shim_env_ok = (k1 > 0 && k1 < (int)sizeof shim_env_preload &&
+                 k2 > 0 && k2 < (int)sizeof shim_env_shm &&
+                 shim_exec_envp != NULL);
   if (shm) {
     int fd = open(shm, O_RDONLY);
     if (fd >= 0) {

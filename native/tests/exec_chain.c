@@ -1,0 +1,37 @@
+/* exec_chain — fork+exec test program: forks, the child execve's the
+ * given command (argv[1..]), the parent waits and reports the child's
+ * exit status. The classic process-spawning idiom, run unmodified.
+ *
+ *   usage: exec_chain <path> [args...]
+ */
+#include <stdio.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <path> [args...]\n", argv[0]);
+    return 2;
+  }
+  pid_t child = fork();
+  if (child < 0) {
+    perror("fork");
+    return 1;
+  }
+  if (child == 0) {
+    execv(argv[1], argv + 1);
+    perror("execv");
+    _exit(127);
+  }
+  int status = 0;
+  if (waitpid(child, &status, 0) != child) {
+    perror("waitpid");
+    return 1;
+  }
+  if (!WIFEXITED(status)) {
+    fprintf(stderr, "child not exited: %x\n", status);
+    return 1;
+  }
+  printf("exec-chain child=%d status=%d\n", (int)child, WEXITSTATUS(status));
+  return WEXITSTATUS(status);
+}

@@ -1,0 +1,12 @@
+import subprocess
+import time
+
+t0 = time.monotonic()
+r = subprocess.run(["/root/repo/native/build/sleep_clock"],
+                   capture_output=True, text=True, timeout=300)
+elapsed_ms = int((time.monotonic() - t0) * 1000)
+assert r.returncode == 0, (r.returncode, r.stderr)
+assert "ok" in r.stdout, r.stdout
+lines = [l for l in r.stdout.splitlines() if "elapsed_ms=250" in l]
+print(f"child-lines={len(lines)} parent_elapsed_ms={elapsed_ms}")
+print("ok")

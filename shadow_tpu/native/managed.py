@@ -41,6 +41,7 @@ from shadow_tpu.host.process import ProcessLifecycle
 from shadow_tpu.native.memory import ProcessMemory
 
 SHIM_IPC_FD = 995
+IPC_LOW = 964  # per-thread channel window [IPC_LOW, SHIM_IPC_FD]
 VFD_BASE = 0x100000
 HELLO = 0xFFFFFFFF
 # thread-management pseudo-syscalls (shim-side analogs in native/shim/shim.c)
@@ -52,6 +53,8 @@ FORK_INTENT = 0xFFFFFFF4   # -> reply carries embryo id + SCM_RIGHTS fd
 FORK_COMMIT = 0xFFFFFFF5   # args = (embryo id, real child pid) -> vpid
 SYS_wait4, SYS_exit_group, SYS_pipe, SYS_pipe2 = 61, 231, 22, 293
 SYS_dup, SYS_dup2, SYS_dup3 = 32, 33, 292
+SYS_fstat, SYS_lseek, SYS_newfstatat = 5, 8, 262
+SYS_close_range = 436
 WNOHANG, ECHILD = 1, 10
 MAX_THREADS = 32           # slots 1..31 map to shim fds 994..964
 SYS_futex = 202
@@ -86,6 +89,7 @@ EPOLL_CTL_ADD, EPOLL_CTL_DEL, EPOLL_CTL_MOD = 1, 2, 3
 EPOLLIN, EPOLLOUT, EPOLLERR, EPOLLHUP = 0x001, 0x004, 0x008, 0x010
 F_GETFD, F_SETFD, F_GETFL, F_SETFL = 1, 2, 3, 4
 O_NONBLOCK = 0o4000
+O_CLOEXEC = 0o2000000
 FIONREAD, FIONBIO = 0x541B, 0x5421
 SYS_clone, SYS_fork, SYS_vfork, SYS_execve, SYS_clone3 = 56, 57, 58, 59, 435
 
@@ -271,6 +275,7 @@ class ManagedProcess(ProcessLifecycle):
         self._ready: list = []  # (thread, reply) queue awaiting turn grants
         self._pumping = False
         self.futexes: dict[int, list] = {}  # uaddr -> [(thread, mask), ...]
+        self.fd_cloexec: set[int] = set()  # vfds closed at execve
         self._strace = None  # open file when strace_logging_mode != off
         gen = host.controller.cfg.general
         self._syscall_latency = 1000 if gen.model_unblocked_syscall_latency else 0
@@ -678,6 +683,7 @@ class ManagedProcess(ProcessLifecycle):
         # fork semantics: the fd table is a snapshot sharing open file
         # descriptions (refcounted); per-process capture files stay fresh
         self.fds = dict(parent.fds)
+        self.fd_cloexec = set(parent.fd_cloexec)
         for vs in self.fds.values():
             vs.refs += 1
             if vs.pipe is not None:
@@ -741,6 +747,24 @@ class ManagedProcess(ProcessLifecycle):
                 self._resume(th, self._reap_child(c, w[2]))
                 return
 
+    def _fstat(self, fd: int, buf: int):
+        """struct stat for a virtual descriptor: enough for stdio/io.open
+        (st_mode by kind, st_blksize, zero size)."""
+        vs = self.fds.get(fd)
+        if vs is None:
+            return -EBADF
+        mode = {"pipe_r": 0o010600, "pipe_w": 0o010600,  # S_IFIFO
+                "stream": 0o140777, "dgram": 0o140777,   # S_IFSOCK
+                }.get(vs.kind, 0o0600)  # epoll/timer/event: anon inode
+        st = bytearray(144)  # struct stat, x86-64 layout
+        struct.pack_into("<QQQIII", st, 0, 0, fd, 1, mode, 0, 0)
+        struct.pack_into("<qq", st, 48, 0, 4096)  # st_size, st_blksize
+        sec = emulated(self.host.now) // NS_PER_SEC
+        for off in (72, 88, 104):  # st_atime / st_mtime / st_ctime .tv_sec
+            struct.pack_into("<q", st, off, sec)
+        self.mem.write(buf, bytes(st))
+        return 0
+
     # -- pipes + dup (descriptor-table breadth; pipes work across fork) ----
     def _pipe(self, fds_ptr: int, flags: int):
         pb = PipeBuf()
@@ -752,6 +776,8 @@ class ManagedProcess(ProcessLifecycle):
         pb.r_end, pb.w_end = r, w
         if flags & 0o4000:  # O_NONBLOCK
             r.nonblock = w.nonblock = True
+        if flags & O_CLOEXEC:
+            self.fd_cloexec.update((r.vfd, w.vfd))
         self.fds[r.vfd] = r
         self.fds[w.vfd] = w
         self.mem.write(fds_ptr, struct.pack("<ii", r.vfd, w.vfd))
@@ -770,6 +796,7 @@ class ManagedProcess(ProcessLifecycle):
                 self._close_vs(old)
         vs.refs += 1
         self.fds[newfd] = vs
+        self.fd_cloexec.discard(newfd)  # dup/dup2 clear FD_CLOEXEC
         return newfd
 
     def _pipe_read(self, vs: VSocket, iovs):
@@ -1048,9 +1075,14 @@ class ManagedProcess(ProcessLifecycle):
                 return -EBADF  # read on the write end
             return self._vfd_recv(args[0], args[1], args[2])
         if nr == SYS_close:
+            if IPC_LOW <= args[0] <= SHIM_IPC_FD:
+                # a guest sweeping "all fds" (subprocess close_fds) must
+                # not sever its own management channels; pretend success
+                return 0
             vs = self.fds.pop(args[0], None)
             if vs is None:
                 return -EBADF
+            self.fd_cloexec.discard(args[0])
             self._close_vs(vs)
             return 0
         if nr == SYS_clock_gettime:
@@ -1095,6 +1127,8 @@ class ManagedProcess(ProcessLifecycle):
             vs = VSocket(vfd, kind)
             if args[1] & 0o4000:  # SOCK_NONBLOCK
                 vs.nonblock = True
+            if args[1] & O_CLOEXEC:  # SOCK_CLOEXEC
+                self.fd_cloexec.add(vfd)
             self.fds[vfd] = vs
             return vfd
         if nr == SYS_connect:
@@ -1155,13 +1189,16 @@ class ManagedProcess(ProcessLifecycle):
         if nr == SYS_listen:
             return self._listen(args[0])
         if nr in (SYS_accept, SYS_accept4):
-            return self._accept(args[0], args[1], args[2])
+            flags = args[3] if nr == SYS_accept4 else 0
+            return self._accept(args[0], args[1], args[2], flags)
         if nr in (SYS_poll, SYS_ppoll):
             return self._poll(args[0], args[1], args[2], nr == SYS_ppoll)
         if nr in (SYS_epoll_create, SYS_epoll_create1):
             vfd = self._next_vfd
             self._next_vfd += 1
             self.fds[vfd] = VSocket(vfd, "epoll")
+            if nr == SYS_epoll_create1 and args[0] & O_CLOEXEC:
+                self.fd_cloexec.add(vfd)
             return vfd
         if nr == SYS_epoll_ctl:
             return self._epoll_ctl(args[0], args[1], args[2], args[3])
@@ -1177,7 +1214,20 @@ class ManagedProcess(ProcessLifecycle):
             if cmd == F_SETFL:
                 vs.nonblock = bool(args[2] & O_NONBLOCK)
                 return 0
-            return 0  # F_GETFD/F_SETFD/etc: benign
+            if cmd == F_GETFD:
+                return 1 if args[0] in self.fd_cloexec else 0
+            if cmd == F_SETFD:
+                if args[2] & 1:  # FD_CLOEXEC
+                    self.fd_cloexec.add(args[0])
+                else:
+                    self.fd_cloexec.discard(args[0])
+                return 0
+            if cmd in (0, 1030):  # F_DUPFD / F_DUPFD_CLOEXEC
+                newfd = self._dup(args[0], None)
+                if newfd >= 0 and cmd == 1030:
+                    self.fd_cloexec.add(newfd)
+                return newfd
+            return 0  # other fcntl cmds: benign
         if nr == SYS_ioctl:
             vs = self.fds.get(args[0])
             if vs is None:
@@ -1202,6 +1252,8 @@ class ManagedProcess(ProcessLifecycle):
             vfd = self._next_vfd
             self._next_vfd += 1
             self.fds[vfd] = VSocket(vfd, "timer")
+            if args[1] & 0o2000000:  # TFD_CLOEXEC
+                self.fd_cloexec.add(vfd)
             return vfd
         if nr == SYS_timerfd_settime:
             return self._timerfd_settime(args[0], args[1], args[2], args[3])
@@ -1222,6 +1274,8 @@ class ManagedProcess(ProcessLifecycle):
             vs.evt_counter = args[0]
             if nr == SYS_eventfd2 and args[1] & 0o4000:  # EFD_NONBLOCK
                 vs.nonblock = True
+            if nr == SYS_eventfd2 and args[1] & O_CLOEXEC:  # EFD_CLOEXEC
+                self.fd_cloexec.add(vfd)
             self.fds[vfd] = vs
             return vfd
         if nr == SYS_sendmsg:
@@ -1232,6 +1286,25 @@ class ManagedProcess(ProcessLifecycle):
             return self._writev(args[0], args[1], args[2])
         if nr == SYS_readv:
             return self._readv(args[0], args[1], args[2])
+        if nr == HELLO:
+            # mid-life HELLO == the guest execve'd a new image: same process
+            # record and channel, fresh shim. The kernel killed any sibling
+            # threads at exec; reap their records. vfds survive (exec keeps
+            # fds), as do stdout/stderr captures and the strace stream.
+            cur = self._cur
+            for t in list(self.threads.values()):
+                if t is not cur and not t.dead:
+                    t.retval = 0
+                    self._thread_gone(t)
+                if t is not cur:
+                    t.joined = True  # kernel-reaped at exec: recyclable
+            for fd in sorted(self.fd_cloexec):  # FD_CLOEXEC semantics
+                vs = self.fds.pop(fd, None)
+                if vs is not None:
+                    self._close_vs(vs)
+            self.fd_cloexec.clear()
+            self.host.counters.add("execs", 1)
+            return 0  # the reply is the new image's first turn grant
         if nr == SPAWN_THREAD:
             return self._spawn_thread()
         if nr == THREAD_HELLO:
@@ -1262,12 +1335,37 @@ class ManagedProcess(ProcessLifecycle):
             return _EXITGROUP
         if nr in (SYS_pipe, SYS_pipe2):
             return self._pipe(args[0], args[1] if nr == SYS_pipe2 else 0)
+        if nr == SYS_close_range:
+            # close the range's VFDS only; real fds — including the shim's
+            # reserved IPC window — survive (the guest can't be allowed to
+            # sever its own management channel; leaked real fds are benign
+            # under the sim). CLOSE_RANGE_CLOEXEC degrades to close.
+            lo, hi = args[0], min(args[1], 1 << 62)
+            if args[2] & 4:  # CLOSE_RANGE_CLOEXEC: mark, don't close
+                self.fd_cloexec.update(
+                    f for f in self.fds if lo <= f <= hi)
+                return 0
+            for fd in [f for f in self.fds if lo <= f <= hi]:
+                self.fd_cloexec.discard(fd)
+                self._close_vs(self.fds.pop(fd))
+            return 0
+        if nr == SYS_fstat:
+            return self._fstat(args[0], args[1])
+        if nr == SYS_newfstatat:
+            # only reachable with a vfd dirfd: the glibc fstat path
+            # (AT_EMPTY_PATH with an empty pathname)
+            return self._fstat(args[0], args[2])
+        if nr == SYS_lseek:
+            return -29 if args[0] in self.fds else -EBADF  # ESPIPE
         if nr == SYS_dup:
             return self._dup(args[0], None)
         if nr in (SYS_dup2, SYS_dup3):
             if args[0] == args[1]:
                 return args[1] if args[0] in self.fds else -EBADF
-            return self._dup(args[0], args[1])
+            r = self._dup(args[0], args[1])
+            if r >= 0 and nr == SYS_dup3 and args[2] & O_CLOEXEC:
+                self.fd_cloexec.add(r)
+            return r
         if nr in (SYS_clone, SYS_fork, SYS_vfork, SYS_execve, SYS_clone3):
             # CLONE_THREAD clones run natively; fork-style clones are
             # executed SHIM-side (FORK_INTENT/COMMIT protocol) and never
@@ -1408,7 +1506,8 @@ class ManagedProcess(ProcessLifecycle):
             self._wire_endpoint(conn, ep)
             th, w = self._find_waiter((("accept",), vs))
             if th is not None:
-                self._finish_accept(th, vs, conn, w[2], w[3])
+                self._finish_accept(th, vs, conn, w[2], w[3],
+                                    w[4] if len(w) > 4 else 0)
             else:
                 vs.accept_q.append(conn)
                 self._notify()
@@ -1420,23 +1519,29 @@ class ManagedProcess(ProcessLifecycle):
         vs.listening = True
         return 0
 
-    def _accept(self, fd: int, addr: int, addrlen: int):
+    def _accept(self, fd: int, addr: int, addrlen: int, flags: int = 0):
         vs = self.fds.get(fd)
         if vs is None:
             return -EBADF
         if not vs.listening:
             return -EINVAL
         if vs.accept_q:
-            return self._do_accept(vs, vs.accept_q.pop(0), addr, addrlen)
+            return self._do_accept(vs, vs.accept_q.pop(0), addr, addrlen,
+                                   flags)
         if vs.nonblock:
             return -EAGAIN
-        self._waiting = ("accept", vs, addr, addrlen)
+        self._waiting = ("accept", vs, addr, addrlen, flags)
         return _BLOCK
 
-    def _do_accept(self, vs: VSocket, conn: VSocket, addr: int, addrlen: int):
+    def _do_accept(self, vs: VSocket, conn: VSocket, addr: int,
+                   addrlen: int, flags: int = 0):
         conn.vfd = self._next_vfd
         self._next_vfd += 1
         self.fds[conn.vfd] = conn
+        if flags & 0o4000:  # SOCK_NONBLOCK
+            conn.nonblock = True
+        if flags & O_CLOEXEC:  # SOCK_CLOEXEC
+            self.fd_cloexec.add(conn.vfd)
         if addr and addrlen:
             peer = self.host.controller.hosts[conn.endpoint.remote_host]
             sa = (struct.pack("<H", socket.AF_INET)
@@ -1447,8 +1552,8 @@ class ManagedProcess(ProcessLifecycle):
         return conn.vfd
 
     def _finish_accept(self, th: GuestThread, vs: VSocket, conn: VSocket,
-                       addr: int, addrlen: int) -> None:
-        self._resume(th, self._do_accept(vs, conn, addr, addrlen))
+                       addr: int, addrlen: int, flags: int = 0) -> None:
+        self._resume(th, self._do_accept(vs, conn, addr, addrlen, flags))
 
     def _connect(self, fd: int, addr: int, addrlen: int):
         vs = self.fds.get(fd)

@@ -558,3 +558,78 @@ def test_curl_fetches_through_simulated_network():
         assert 0.1 <= t <= 5.0, out  # simulated transfer time, not wall
         outs.append(out)
     assert outs[0] == outs[1]
+
+
+# ---- execve + process chains ----------------------------------------------
+
+def test_exec_chain_native_oracle():
+    r = subprocess.run([str(BUILD / "exec_chain"), str(BUILD / "sleep_clock")],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "exec-chain" in r.stdout and "status=0" in r.stdout
+
+
+def test_exec_chain_managed():
+    """fork + execve of another managed binary: the shim re-injects its
+    environment through the magic-envp seccomp gate, the new image
+    re-handshakes on the inherited channel, and its sleeps run on SIM
+    time (exact 250 ms lines in the exec'd child's capture)."""
+    cfg_text = SLEEP_CFG.replace(
+        f"path: {BUILD}/sleep_clock",
+        f"path: {BUILD}/exec_chain\n        args: [\"{BUILD}/sleep_clock\"]")
+    cfg = parse_config(yaml.safe_load(cfg_text), {
+        "general.data_directory": "/tmp/st-execchain",
+    })
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    parent = Path("/tmp/st-execchain/hosts/box/exec_chain.0.stdout").read_text()
+    assert "exec-chain child=40000 status=0" in parent, parent
+    child = Path("/tmp/st-execchain/hosts/box/exec_chain.f0.stdout").read_text()
+    assert child.count("elapsed_ms=250") == 3, child
+    assert "ok" in child
+
+
+def test_cpython_subprocess_managed():
+    """The full stack: a CPython guest uses subprocess.run to fork+exec a
+    real C binary, capturing its stdout through emulated CLOEXEC pipes and
+    reaping it with emulated wait4 — deterministic, on simulated time."""
+    import sys
+
+    cfg_text = SLEEP_CFG.replace(
+        f"path: {BUILD}/sleep_clock",
+        f"path: {sys.executable}\n        args: "
+        f"[\"{ROOT}/native/tests/guest/py_subproc.py\"]")
+    outs = []
+    for tag in ("a", "b"):
+        cfg = parse_config(yaml.safe_load(cfg_text), {
+            "general.data_directory": f"/tmp/st-pysub-{tag}",
+        })
+        c = Controller(cfg, mirror_log=False)
+        result = c.run()
+        assert result["process_errors"] == [], result["process_errors"]
+        name = Path(sys.executable).name
+        out = Path(f"/tmp/st-pysub-{tag}/hosts/box/{name}.0.stdout").read_text()
+        assert "child-lines=3" in out, out
+        assert "ok" in out
+        outs.append(out)
+    assert outs[0] == outs[1]
+
+
+def test_exec_chain_depth2_managed():
+    """Exec chains survive stacked seccomp filters (the exec gate lives at
+    a fixed address every generation agrees on): exec_chain forks+execs
+    exec_chain, which forks+execs sleep_clock — three managed
+    generations, all on simulated time."""
+    cfg_text = SLEEP_CFG.replace(
+        f"path: {BUILD}/sleep_clock",
+        f"path: {BUILD}/exec_chain\n        args: "
+        f"[\"{BUILD}/exec_chain\", \"{BUILD}/sleep_clock\"]")
+    cfg = parse_config(yaml.safe_load(cfg_text), {
+        "general.data_directory": "/tmp/st-execd2",
+    })
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    g2 = Path("/tmp/st-execd2/hosts/box/exec_chain.f1.stdout").read_text()
+    assert g2.count("elapsed_ms=250") == 3, g2

@@ -11,7 +11,8 @@ from __future__ import annotations
 
 from pathlib import Path
 
-SYS = dict(read=0, write=1, close=3, poll=7, rt_sigprocmask=14,
+SYS = dict(read=0, write=1, close=3, fstat=5, poll=7, lseek=8,
+           rt_sigprocmask=14,
            ioctl=16, readv=19, writev=20, pipe=22, dup=32, dup2=33,
            nanosleep=35,
            getpid=39, socket=41, recvmsg=47, clone=56, clone_end=60,
@@ -22,7 +23,9 @@ SYS = dict(read=0, write=1, close=3, poll=7, rt_sigprocmask=14,
            timerfd_create=283, eventfd=284, timerfd_settime=286,
            timerfd_gettime=287, accept4=288, eventfd2=290,
            epoll_create1=291, dup3=292, pipe2=293, getrandom=318,
-           wait4=61, exit_group=231, clone3=435)
+           newfstatat=262,
+           wait4=61, execve=59, exit_group=231, clone3=435,
+           close_range=436)
 
 CLONE_THREAD = 0x10000
 CLONE_IO = 0x80000000  # shim's own fork-replay marker: benign, lets the
@@ -36,10 +39,12 @@ UNCONDITIONAL = [
     "getpid", "getppid", "gettid", "timerfd_create", "timerfd_settime",
     "timerfd_gettime", "eventfd", "eventfd2", "futex",
     "rt_sigprocmask", "pipe", "pipe2", "wait4", "exit_group",
+    "close_range",
 ]
 
 #: syscalls trapped only when arg0 is a virtual fd
-VFD_CONDITIONAL = ["close", "ioctl", "fcntl", "dup", "dup2", "dup3"]
+VFD_CONDITIONAL = ["ioctl", "fcntl", "dup", "dup2", "dup3",
+                   "fstat", "lseek", "newfstatat"]
 
 
 def build():
@@ -49,6 +54,9 @@ def build():
     prog.append(("LD_NR",))
     prog.append(("JEQ", SYS["read"], "READ", None))
     prog.append(("JEQ", SYS["write"], "WRITE", None))
+    # close traps for vfds AND the reserved IPC window: guests sweeping
+    # "all fds" (subprocess close_fds) must not sever their own channels
+    prog.append(("JEQ", SYS["close"], "CLOSECHK", None))
     prog.append(("JEQ", SYS["readv"], "READ", None))
     prog.append(("JEQ", SYS["writev"], "WRITE", None))
     for name in VFD_CONDITIONAL:
@@ -61,6 +69,10 @@ def build():
     # thread-style clones run natively (pthread_create is interposed);
     # fork-style trap so the worker can reject them loudly
     prog.append(("JEQ", SYS["clone"], "CLONECHK", None))
+    # execve runs natively ONLY when envp is the shim's own patched array
+    # (the shim re-injects LD_PRELOAD/SHADOW_* and re-execs); any other
+    # execve traps so the worker can reject it
+    prog.append(("JEQ", SYS["execve"], "EXECCHK", None))
     prog.append(("JGE", SYS["socket"], None, "ALLOW"))
     prog.append(("JGE", SYS["clone_end"], "ALLOW", "TRAP"))
     labels = {}
@@ -80,8 +92,19 @@ def build():
     labels["CLONECHK"] = len(prog)
     prog += [("LD_A0",), ("JSET", CLONE_THREAD, "ALLOW", None),
              ("JSET", CLONE_IO, "ALLOW", "TRAP")]
+    labels["EXECCHK"] = len(prog)
+    prog += [("LD_A2LO",), ("JEQ", "EXECLO", None, "TRAP"),
+             ("LD_A2HI",), ("JEQ", "EXECHI", "ALLOW", "TRAP")]
+    labels["CLOSECHK"] = len(prog)
+    prog += [("LD_A0",), ("JGE", "IPCLOW", None, "VFDTAIL"),
+             ("JGE", "IPCEND", "VFDTAIL", "TRAP")]
     labels["VFDCHK"] = len(prog)
-    prog += [("LD_A0",), ("JGE", "VFD", "TRAP", "ALLOW")]
+    # negative fds (AT_FDCWD = -100 as a newfstatat dirfd) wrap to huge
+    # unsigned values: let them through natively
+    prog += [("LD_A0",)]
+    labels["VFDTAIL"] = len(prog)
+    prog += [("JGE", "VFD", None, "ALLOW"),
+             ("JGE", 0xFFFFF000, "ALLOW", "TRAP")]
     labels["TRAP"] = len(prog)
     prog.append(("RET_TRAP",))
     labels["ALLOW"] = len(prog)
@@ -92,6 +115,8 @@ def build():
     def val(v):
         return {"ARCH": "AUDIT_ARCH_X86_64", "IPC": "SHIM_IPC_FD",
                 "IPCLOW": "SHIM_IPC_LOW", "IPCEND": "(SHIM_IPC_FD + 1)",
+                "EXECLO": "(uint32_t)(uintptr_t)SHIM_EXEC_ADDR",
+                "EXECHI": "(uint32_t)((uintptr_t)SHIM_EXEC_ADDR >> 32)",
                 "VFD": "SHIM_VFD_BASE"}.get(v, str(v))
 
     out = []
@@ -99,6 +124,7 @@ def build():
         k = ins[0]
         simple = {"LD_ARCH": "LD(BPF_ARCHF),", "LD_NR": "LD(BPF_NR),",
                   "LD_A0": "LD(BPF_ARG0),",
+                  "LD_A2LO": "LD(BPF_ARG2LO),", "LD_A2HI": "LD(BPF_ARG2HI),",
                   "RET_TRAP": "RET(SECCOMP_RET_TRAP),",
                   "RET_ALLOW": "RET(SECCOMP_RET_ALLOW),"}
         if k in simple:
