@@ -355,14 +355,31 @@ class ManagedProcess(ProcessLifecycle):
             os.dup2(child.fileno(), SHIM_IPC_FD)
             child.close()
             try:
-                self.proc = subprocess.Popen(
-                    [self.opts.path] + list(self.opts.args),
-                    env=env,
-                    pass_fds=(SHIM_IPC_FD,),
-                    stdout=subprocess.DEVNULL,
-                    stderr=subprocess.DEVNULL,
-                    cwd=str(ddir),
-                )
+                # the guest's REAL stdio points at the capture files,
+                # unbuffered (the shared file description means worker-
+                # trapped writes and any native slip-throughs interleave
+                # at one offset). Not /dev/null: tools fstat their stdout
+                # and change behavior on it — GNU grep goes quiet-mode on
+                # a devnull stdout. Opened into _files as each succeeds so
+                # a failing spawn cannot leak handles.
+                self._files[1] = open(ddir / f"{self.name}.stdout", "wb",
+                                      buffering=0)
+                self._files[2] = open(ddir / f"{self.name}.stderr", "wb",
+                                      buffering=0)
+                try:
+                    self.proc = subprocess.Popen(
+                        [self.opts.path] + list(self.opts.args),
+                        env=env,
+                        pass_fds=(SHIM_IPC_FD,),
+                        stdout=self._files[1],
+                        stderr=self._files[2],
+                        cwd=str(ddir),
+                    )
+                except BaseException:
+                    for f in self._files.values():
+                        f.close()
+                    self._files.clear()
+                    raise
             finally:
                 devnull = os.open(os.devnull, os.O_RDWR)
                 os.dup2(devnull, SHIM_IPC_FD)  # restore the reservation
@@ -715,7 +732,11 @@ class ManagedProcess(ProcessLifecycle):
                 pass
 
     def _wait4(self, args):
-        pid = args[0] - (1 << 64) if args[0] >= (1 << 63) else args[0]
+        # pid is a C int: only the low 32 bits are defined (the ABI leaves
+        # the upper half of the register unspecified for int args)
+        pid = args[0] & 0xFFFFFFFF
+        if pid >= (1 << 31):
+            pid -= 1 << 32
         status_ptr, options = args[1], args[2]
         matches = [c for c in self.children
                    if not c.reaped and (pid in (-1, 0) or c.vpid == pid)]

@@ -633,3 +633,30 @@ def test_exec_chain_depth2_managed():
     assert result["process_errors"] == [], result["process_errors"]
     g2 = Path("/tmp/st-execd2/hosts/box/exec_chain.f1.stdout").read_text()
     assert g2.count("elapsed_ms=250") == 3, g2
+
+
+def test_shell_pipeline_managed():
+    """/bin/sh runs a real pipeline: it forks sleep_clock and grep wired
+    by an emulated pipe, waits (waitpid(-1) with the C-int ABI's 32-bit
+    pid), and the && branch runs — deterministic, sleeps on sim time.
+    (Each process's stdout is captured per-process, so grep's count lands
+    in its own file.)"""
+    cfg_text = SLEEP_CFG.replace(
+        f"path: {BUILD}/sleep_clock",
+        f"path: /bin/sh\n        args: [\"-c\", \"{BUILD}/sleep_clock | "
+        f"grep -c elapsed && echo pipeline-done\"]")
+    outs = []
+    for tag in ("a", "b"):
+        cfg = parse_config(yaml.safe_load(cfg_text), {
+            "general.data_directory": f"/tmp/st-shellpipe-{tag}",
+        })
+        c = Controller(cfg, mirror_log=False)
+        result = c.run()
+        assert result["process_errors"] == [], result["process_errors"]
+        d = Path(f"/tmp/st-shellpipe-{tag}/hosts/box")
+        sh_out = (d / "sh.0.stdout").read_text()
+        assert "pipeline-done" in sh_out, sh_out
+        grep_out = (d / "sh.f1.stdout").read_text()
+        assert grep_out == "3\n", grep_out  # the exact count, from grep
+        outs.append(sh_out + grep_out)
+    assert outs[0] == outs[1]
