@@ -1,0 +1,51 @@
+/* sel_pipe — select(2) test program: parent pipes+forks; the child sleeps
+ * 100 ms then writes; the parent dup2's the read end to fd 0 and selects
+ * on it with a 1 s timeout — select must wake on data (not timeout), and
+ * the measured wait is SIMULATED time under the shim.
+ */
+#include <stdio.h>
+#include <string.h>
+#include <sys/select.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+int main(void) {
+  int pfd[2];
+  if (pipe(pfd) != 0) { perror("pipe"); return 1; }
+  pid_t child = fork();
+  if (child < 0) { perror("fork"); return 1; }
+  if (child == 0) {
+    close(pfd[0]);
+    struct timespec ts = {0, 100000000};
+    nanosleep(&ts, NULL);
+    if (write(pfd[1], "ping\n", 5) != 5) _exit(9);
+    _exit(0);
+  }
+  close(pfd[1]);
+  dup2(pfd[0], 0);
+  close(pfd[0]);
+  struct timespec t0, t1;
+  clock_gettime(CLOCK_REALTIME, &t0);
+  fd_set rfds;
+  FD_ZERO(&rfds);
+  FD_SET(0, &rfds);
+  struct timeval tv = {1, 0};
+  int n = select(1, &rfds, NULL, NULL, &tv);
+  clock_gettime(CLOCK_REALTIME, &t1);
+  long ms = (t1.tv_sec - t0.tv_sec) * 1000 + (t1.tv_nsec - t0.tv_nsec) / 1000000;
+  if (n != 1 || !FD_ISSET(0, &rfds)) {
+    fprintf(stderr, "select: n=%d\n", n);
+    return 1;
+  }
+  char buf[16];
+  long r = read(0, buf, sizeof buf);
+  if (r != 5 || memcmp(buf, "ping\n", 5) != 0) {
+    fprintf(stderr, "read: %ld\n", r);
+    return 1;
+  }
+  int status;
+  waitpid(child, &status, 0);
+  printf("select-ok waited_ms=%ld\n", ms);
+  return 0;
+}

@@ -55,6 +55,7 @@ SYS_wait4, SYS_exit_group, SYS_pipe, SYS_pipe2 = 61, 231, 22, 293
 SYS_dup, SYS_dup2, SYS_dup3 = 32, 33, 292
 SYS_fstat, SYS_lseek, SYS_newfstatat = 5, 8, 262
 SYS_close_range = 436
+SYS_select, SYS_pselect6 = 23, 270
 WNOHANG, ECHILD = 1, 10
 MAX_THREADS = 32           # slots 1..31 map to shim fds 994..964
 SYS_futex = 202
@@ -96,6 +97,11 @@ SYS_clone, SYS_fork, SYS_vfork, SYS_execve, SYS_clone3 = 56, 57, 58, 59, 435
 EPERM, EBADF, EAGAIN, EFAULT, EINVAL, EPIPE = 1, 9, 11, 14, 22, 32
 ENOSYS, ENOTCONN, ECONNRESET, ETIMEDOUT, EAFNOSUPPORT, ENETUNREACH = (
     38, 107, 104, 110, 97, 101)
+
+def _zeroed_sets(sets, nbytes: int):
+    """Fresh all-zero fd_set buffers shaped like ``sets``."""
+    return [bytearray(nbytes) if s is not None else None for s in sets]
+
 
 _BLOCK = object()  # service() sentinel: no reply yet, process parked
 _DETACH = object()  # service() sentinel: reply 0, then stop reading this
@@ -1214,6 +1220,8 @@ class ManagedProcess(ProcessLifecycle):
             return self._accept(args[0], args[1], args[2], flags)
         if nr in (SYS_poll, SYS_ppoll):
             return self._poll(args[0], args[1], args[2], nr == SYS_ppoll)
+        if nr in (SYS_select, SYS_pselect6):
+            return self._select(args, nr == SYS_pselect6)
         if nr in (SYS_epoll_create, SYS_epoll_create1):
             vfd = self._next_vfd
             self._next_vfd += 1
@@ -1449,6 +1457,11 @@ class ManagedProcess(ProcessLifecycle):
                 n = self._epoll_scan(w[2], w[3], w[4])
                 if n:
                     self._resume(th, n)
+            elif w[0] == "select":
+                n = self._select_scan(w[2], w[3], w[4], w[5])
+                if n:
+                    self._select_timeleft(w)
+                    self._resume(th, n)
 
     def _poll_scan(self, entries, fds_ptr) -> int:
         """Write revents for ready entries; returns the ready count."""
@@ -1482,8 +1495,13 @@ class ManagedProcess(ProcessLifecycle):
         token = object()
         if timeout_ns >= 0:
             def fire():
-                th, _ = self._find_waiter((("poll", "epoll"), token))
+                th, w = self._find_waiter((("poll", "epoll", "select"),
+                                           token))
                 if th is not None:
+                    if w[0] == "select":  # Linux zeroes the sets on timeout
+                        self._select_write(w[3], _zeroed_sets(w[4], w[5]),
+                                           w[5])
+                        self._select_timeleft(w)
                     self._resume(th, 0)
 
             self.host.schedule_in(timeout_ns, fire)
@@ -1696,6 +1714,86 @@ class ManagedProcess(ProcessLifecycle):
         self.mem.write(bufaddr, bytes(vs.rxbuf[:k]))
         del vs.rxbuf[:k]
         return k
+
+    # -- select -------------------------------------------------------------
+    def _select(self, args, is_pselect: bool):
+        """select/pselect6 over fd_set bitmaps. Only reachable for fds the
+        guest can legally FD_SET (< FD_SETSIZE): vfds land there via dup2
+        (shell redirections, inetd-style servers). Real fds in the sets
+        count as always-ready, like regular files."""
+        nfds = min(args[0] & 0xFFFFFFFF, 1024)
+        nbytes = (nfds + 7) // 8
+        sets = []
+        for ptr in (args[1], args[2], args[3]):
+            if ptr:
+                sets.append(bytearray(self.mem.read(ptr, nbytes)))
+            else:
+                sets.append(None)
+        want_of = (POLLIN, POLLOUT, 0)  # exceptfds: never signaled here
+        entries = []  # (fd, set_index, want_mask)
+        for si, bits in enumerate(sets):
+            if bits is None:
+                continue
+            for fd in range(nfds):
+                if bits[fd >> 3] & (1 << (fd & 7)):
+                    entries.append((fd, si, want_of[si]))
+        n = self._select_scan(entries, args, sets, nbytes)
+        if n:
+            return n
+        if args[4] == 0:  # NULL timeout pointer = infinite
+            timeout_ns = -1
+        else:
+            # timespec (pselect) and timeval (select) are both two int64s
+            sec, frac = struct.unpack("<qq", self.mem.read(args[4], 16))
+            timeout_ns = sec * NS_PER_SEC + (frac if is_pselect
+                                             else frac * 1000)
+            if sec < 0 or frac < 0:
+                return -EINVAL  # Linux rejects negative timeouts
+        if timeout_ns == 0:
+            # nothing ready and a zero timeout: clear every set and return
+            self._select_write(args, _zeroed_sets(sets, nbytes), nbytes)
+            return 0
+        token = self._arm_wait_timeout(timeout_ns)
+        # select (not pselect) updates the guest's timeval with the time
+        # remaining; remember the deadline for the writeback
+        deadline = (None if is_pselect or timeout_ns < 0
+                    else emulated(self.host.now) + timeout_ns)
+        self._waiting = ("select", token, entries, args, sets, nbytes,
+                        deadline)
+        return _BLOCK
+
+    def _select_timeleft(self, w) -> None:
+        """Linux select(2) semantics: write the unslept remainder back
+        into the guest's timeval on every blocking return."""
+        deadline = w[6] if len(w) > 6 else None
+        if deadline is None:
+            return
+        left = max(0, deadline - emulated(self.host.now))
+        self.mem.write(w[3][4], struct.pack(
+            "<qq", left // NS_PER_SEC, (left % NS_PER_SEC) // 1000))
+
+    def _select_scan(self, entries, args, sets, nbytes: int) -> int:
+        out = _zeroed_sets(sets, nbytes)
+        n = 0
+        for fd, si, want in entries:
+            vs = self.fds.get(fd)
+            if vs is None:
+                ready = si != 2  # real fd (file-like): always read/write-ready
+            elif si == 2:
+                ready = False
+            else:
+                ready = bool(self._revents(vs, want) & want)
+            if ready:
+                out[si][fd >> 3] |= 1 << (fd & 7)
+                n += 1
+        if n:
+            self._select_write(args, out, nbytes)
+        return n
+
+    def _select_write(self, args, out, nbytes: int) -> None:
+        for ptr, bits in zip((args[1], args[2], args[3]), out):
+            if ptr and bits is not None:
+                self.mem.write(ptr, bytes(bits))
 
     # -- poll / epoll -------------------------------------------------------
     def _poll(self, fds_ptr: int, nfds: int, timeout, is_ppoll: bool):

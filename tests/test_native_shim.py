@@ -660,3 +660,26 @@ def test_shell_pipeline_managed():
         assert grep_out == "3\n", grep_out  # the exact count, from grep
         outs.append(sh_out + grep_out)
     assert outs[0] == outs[1]
+
+
+# ---- select ---------------------------------------------------------------
+
+def test_sel_pipe_native_oracle():
+    r = subprocess.run([str(BUILD / "sel_pipe")], capture_output=True,
+                       text=True, timeout=30)
+    assert r.returncode == 0, r.stderr
+    assert "select-ok" in r.stdout
+
+
+def test_sel_pipe_managed():
+    """select(2) over a dup2'd emulated pipe: wakes on the forked child's
+    write after EXACTLY 100 simulated ms (not the 1 s timeout)."""
+    cfg_text = SLEEP_CFG.replace("sleep_clock", "sel_pipe")
+    cfg = parse_config(yaml.safe_load(cfg_text), {
+        "general.data_directory": "/tmp/st-selpipe",
+    })
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    out = Path("/tmp/st-selpipe/hosts/box/sel_pipe.0.stdout").read_text()
+    assert "select-ok waited_ms=100" in out, out
