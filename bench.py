@@ -99,11 +99,16 @@ def main() -> None:
     detail: dict = {"machine_note": "tpu_batch uses the local JAX default "
                     "device; thread_per_core is the CPU baseline policy"}
 
-    # best-of-2 per policy: single runs vary ~±10% on a shared machine
-    base = max((run_config(args.config, "thread_per_core", "tpc")
-                for _ in range(2)), key=lambda r: r["sim_sec_per_wall_sec"])
-    tpu = max((run_config(args.config, "tpu_batch", "tpu")
-               for _ in range(2)), key=lambda r: r["sim_sec_per_wall_sec"])
+    # best-of-2 per policy, INTERLEAVED: shared-machine load drifts on the
+    # scale of one run, so grouping a policy's repetitions correlates the
+    # noise with the policy and corrupts the ratio
+    runs = {"thread_per_core": [], "tpu_batch": []}
+    for _ in range(2):
+        for pol, tag in (("thread_per_core", "tpc"), ("tpu_batch", "tpu")):
+            runs[pol].append(run_config(args.config, pol, tag))
+    base = max(runs["thread_per_core"],
+               key=lambda r: r["sim_sec_per_wall_sec"])
+    tpu = max(runs["tpu_batch"], key=lambda r: r["sim_sec_per_wall_sec"])
     headline = {
         "metric": "sim_sec_per_wall_sec_tgen1k_tpu_batch",
         "value": round(tpu["sim_sec_per_wall_sec"], 4),

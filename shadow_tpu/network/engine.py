@@ -31,6 +31,7 @@ counted, then silently discarded — matching IP semantics for no-route.
 
 from __future__ import annotations
 
+import time as _walltime
 from collections import deque
 from dataclasses import dataclass
 from functools import partial
@@ -96,6 +97,16 @@ class NetworkEngine:
         self.max_pkts = int(getattr(tpu_options, "unit_mtus", 10) or 10)
         self.device = None
         self.device_floor = float("inf")
+        # adaptive guard: a tunneled/contended device can stall readbacks
+        # far beyond the calibrated estimate; when realized stalls are
+        # high, raise the routing floor so batches fall back to numpy
+        # (results are bit-identical either way — this is pure wall time)
+        self._dev_stall = 0.0
+        self._dev_reads = 0
+        self._dev_units = 0
+        self._dev_warm = False  # first read (compile/attach) is excluded
+        self._np_per_unit = 4e-6  # refined by calibration when available
+        self._floor0 = float("inf")  # calibrated floor: decay lower bound
         if backend == "tpu":
             n_shards = int(getattr(tpu_options, "tpu_mesh_shards", 0) or 0)
             floor = int(getattr(tpu_options, "tpu_device_floor", 0) or 0)
@@ -128,8 +139,10 @@ class NetworkEngine:
                                     max_pkts=self.max_pkts)
             dev_s, np_per_unit = plane.calibrate()
             if np_per_unit > 0:
+                self._np_per_unit = np_per_unit
                 self.device_floor = max(512, min(
                     int(dev_s / np_per_unit), self.max_batch))
+                self._floor0 = self.device_floor
             self.device = plane  # publish last (reads are GIL-atomic)
         except Exception:
             pass  # no usable device: the numpy twin serves everything
@@ -280,8 +293,31 @@ class NetworkEngine:
             return
         self.outstanding = deque(b for b in self.outstanding if b.deadline >= limit)
         for b in due:
+            t0 = _walltime.perf_counter()
+            flags = b.handle.read()
+            dt = _walltime.perf_counter() - t0
+            if not self._dev_warm:
+                self._dev_warm = True  # compile/attach stall: not signal
+            else:
+                self._dev_stall += dt
+                self._dev_reads += 1
+                self._dev_units += len(b.units)
             self._schedule_batch(b.units, b.arrival, b.notify,
-                                 b.handle.read(), b.keys, b.round_end)
+                                 flags, b.keys, b.round_end)
+        if self._dev_reads >= 8:
+            # compare realized stalls against what the numpy twin would
+            # have cost for the same units: back off only when the device
+            # is clearly LOSING, decay back toward the calibrated floor
+            # when it stops (results are identical either way)
+            np_cost = self._np_per_unit * self._dev_units
+            if self._dev_stall > 4 * np_cost + 0.02:
+                self.device_floor = min(self.device_floor * 4, 1 << 30)
+            elif (self._dev_stall < np_cost and
+                  self.device_floor > self._floor0):
+                self.device_floor = max(self._floor0, self.device_floor // 4)
+            self._dev_stall = 0.0
+            self._dev_reads = 0
+            self._dev_units = 0
 
     def flush_all(self) -> None:
         self.flush_due(T_NEVER + 1)
