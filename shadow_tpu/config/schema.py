@@ -208,14 +208,9 @@ def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
     e.strace_logging_mode = str(exp.get("strace_logging_mode", "off"))
     e.interface_qdisc = str(exp.get("interface_qdisc", "fifo"))
     e.max_unapplied_cpu_latency = parse_time(exp.get("max_unapplied_cpu_latency", 0))
-    if e.use_dynamic_runahead:
-        cfg.warnings.append(
-            "experimental.use_dynamic_runahead accepted but not implemented "
-            "(fixed conservative lookahead is used)")
-    if e.interface_qdisc != "fifo":
-        cfg.warnings.append(
-            f"experimental.interface_qdisc {e.interface_qdisc!r} accepted "
-            "but only 'fifo' is implemented")
+    _require(e.interface_qdisc in ("fifo", "round_robin"),
+             f"experimental.interface_qdisc must be fifo or round_robin, "
+             f"got {e.interface_qdisc!r}")
     if e.max_unapplied_cpu_latency:
         cfg.warnings.append(
             "experimental.max_unapplied_cpu_latency accepted but not "

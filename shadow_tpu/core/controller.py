@@ -203,7 +203,13 @@ class Controller:
         next_gc = _GC_EVERY_ROUNDS
         t0 = _walltime.perf_counter()
         now: SimTime = 0
+        dyn = cfg.experimental.use_dynamic_runahead
         while now < stop:
+            if dyn:
+                # widen to the smallest latency traffic has actually used
+                # (never narrower than the static conservative window)
+                w = max(self.round_ns,
+                        min(self.engine.min_used_latency, 10 * self.round_ns))
             round_end = min(now + w, stop)
             self.engine.start_of_round(now, round_end)
             hosts = self.hosts

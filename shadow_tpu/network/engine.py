@@ -105,8 +105,17 @@ class NetworkEngine:
         self._dev_reads = 0
         self._dev_units = 0
         self._dev_warm = False  # first read (compile/attach) is excluded
+        self._floor_cooldown = 0  # rounds until a starved floor decays
         self._np_per_unit = 4e-6  # refined by calibration when available
         self._floor0 = float("inf")  # calibrated floor: decay lower bound
+        #: dynamic runahead (reference: experimental.use_dynamic_runahead):
+        #: the smallest latency any resolved unit has actually used. Rounds
+        #: may widen to this instead of the graph-wide minimum; a new flow
+        #: over a shorter edge gets its first arrivals clamped to one
+        #: barrier (the documented fidelity trade), then shrinks the window
+        self.min_used_latency: SimTime = T_NEVER
+        self.qdisc = str(getattr(tpu_options, "interface_qdisc", "fifo")
+                         or "fifo")
         if backend == "tpu":
             n_shards = int(getattr(tpu_options, "tpu_mesh_shards", 0) or 0)
             floor = int(getattr(tpu_options, "tpu_device_floor", 0) or 0)
@@ -211,6 +220,8 @@ class NetworkEngine:
                     if ep.state != 0:  # not CLOSED
                         ep.receiver.flush_ack()
             if h.egress:
+                if self.qdisc == "round_robin" and len(h.egress) > 1:
+                    h.egress = _round_robin(h.egress)
                 units.extend(h.egress)
                 h.egress = []
         n = len(units)
@@ -242,6 +253,10 @@ class NetworkEngine:
             n = len(units)
 
         arrival = depart + lat
+        if n:
+            ml = int(lat.min())
+            if ml < self.min_used_latency:
+                self.min_used_latency = ml
         thresh = self.params.drop_thresh[sn, dn]
         extra = np.fromiter((u.loss_extra_ns for u in units), dtype=np.int64, count=n)
         notify = arrival + extra
@@ -263,6 +278,17 @@ class NetworkEngine:
             and n >= self.device_floor
             and bool((thresh > 0).any())
         )
+        if (not use_device and self.device_floor > self._floor0
+                and self._floor_cooldown > 0):
+            # a backed-off floor must be able to recover even when it now
+            # starves the device entirely (no reads -> no stall windows)
+            self._floor_cooldown -= 1
+            if self._floor_cooldown == 0:
+                self.device_floor = max(self._floor0, self.device_floor // 4)
+                self._floor_cooldown = 512
+                self._dev_stall = 0.0
+                self._dev_reads = 0
+                self._dev_units = 0
         if not use_device:
             flags = loss_flags(self.params.seed, *_uid_arrays(units, n), thresh)
             if forced is not None:
@@ -312,6 +338,7 @@ class NetworkEngine:
             np_cost = self._np_per_unit * self._dev_units
             if self._dev_stall > 4 * np_cost + 0.02:
                 self.device_floor = min(self.device_floor * 4, 1 << 30)
+                self._floor_cooldown = 512
             elif (self._dev_stall < np_cost and
                   self.device_floor > self._floor0):
                 self.device_floor = max(self._floor0, self.device_floor // 4)
@@ -354,6 +381,26 @@ class NetworkEngine:
         self.units_sent += sent
         self.units_dropped += dropped_ct
         self.bytes_sent += nbytes
+
+
+def _round_robin(egress):
+    """interface_qdisc: round_robin — fair interleave across this host's
+    flows (src_port). Emission-time causality is primary (a unit emitted
+    later can never charge the link bucket before an earlier one — the
+    fluid serialization is FIFO in t_emit); fairness applies where it
+    actually binds: among units emitted at the same instant, flows take
+    turns (per-flow rank breaks the tie) instead of one flow's burst
+    monopolizing the link. O(n log n), deterministic."""
+    rank: dict = {}
+    order: dict = {}
+    keyed = []
+    for i, u in enumerate(egress):
+        f = u.src_port
+        r = rank.get(f, 0)
+        rank[f] = r + 1
+        keyed.append((u.t_emit, r, order.setdefault(f, len(order)), i, u))
+    keyed.sort(key=lambda t: t[:4])
+    return [t[4] for t in keyed]
 
 
 class _ForcedHandle:

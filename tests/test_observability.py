@@ -5,6 +5,7 @@ for accepted-but-unimplemented knobs."""
 import subprocess
 from pathlib import Path
 
+import pytest
 import yaml
 
 from shadow_tpu.config import parse_config
@@ -123,14 +124,24 @@ def test_without_bootstrap_same_config_cannot_finish():
 
 
 def test_unimplemented_knobs_warn():
+    # the remaining accepted-but-unimplemented knob still warns ...
+    cfg = parse_config(yaml.safe_load(BOOT_CFG), {
+        "general.data_directory": "/tmp/st-obs-warn",
+        "experimental.max_unapplied_cpu_latency": "1ms",
+    })
+    assert any("max_unapplied_cpu_latency" in w for w in cfg.warnings)
+    # ... implemented ones no longer do, and bogus values error loudly
     cfg = parse_config(yaml.safe_load(BOOT_CFG), {
         "general.data_directory": "/tmp/st-obs-warn",
         "experimental.use_dynamic_runahead": True,
-        "experimental.interface_qdisc": "codel",
+        "experimental.interface_qdisc": "round_robin",
     })
-    assert len(cfg.warnings) == 2
-    assert any("use_dynamic_runahead" in w for w in cfg.warnings)
-    assert any("interface_qdisc" in w for w in cfg.warnings)
+    assert cfg.warnings == []
+    with pytest.raises(ValueError, match="interface_qdisc"):
+        parse_config(yaml.safe_load(BOOT_CFG), {
+            "general.data_directory": "/tmp/st-obs-warn",
+            "experimental.interface_qdisc": "codel",
+        })
 
 
 def test_strace_logging_managed_process():
