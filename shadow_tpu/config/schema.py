@@ -208,13 +208,11 @@ def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
     e.strace_logging_mode = str(exp.get("strace_logging_mode", "off"))
     e.interface_qdisc = str(exp.get("interface_qdisc", "fifo"))
     e.max_unapplied_cpu_latency = parse_time(exp.get("max_unapplied_cpu_latency", 0))
+    _require(e.max_unapplied_cpu_latency >= 0,
+             "experimental.max_unapplied_cpu_latency must be >= 0")
     _require(e.interface_qdisc in ("fifo", "round_robin"),
              f"experimental.interface_qdisc must be fifo or round_robin, "
              f"got {e.interface_qdisc!r}")
-    if e.max_unapplied_cpu_latency:
-        cfg.warnings.append(
-            "experimental.max_unapplied_cpu_latency accepted but not "
-            "implemented (unblocked-syscall latency is a fixed 1 us)")
     e.unit_mtus = int(exp.get("unit_mtus", 10))
     _require(1 <= e.unit_mtus <= 64,
              "experimental.unit_mtus must be in [1, 64]")

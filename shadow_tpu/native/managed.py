@@ -285,6 +285,12 @@ class ManagedProcess(ProcessLifecycle):
         self._strace = None  # open file when strace_logging_mode != off
         gen = host.controller.cfg.general
         self._syscall_latency = 1000 if gen.model_unblocked_syscall_latency else 0
+        # reference: max_unapplied_cpu_latency — modeled syscall latency
+        # accumulates and is applied to the clock in batches of this size
+        # (fewer, coarser clock bumps; 0 = apply each immediately)
+        self._max_unapplied = host.controller.cfg.experimental.\
+            max_unapplied_cpu_latency
+        self._unapplied = 0
         self._spin_t = -1  # busy-loop detector: syscalls at one sim instant
         self._spin_n = 0
         # deterministic virtual pid (real pids would leak host scheduling
@@ -482,8 +488,11 @@ class ManagedProcess(ProcessLifecycle):
             except OSError:
                 ret = -EFAULT  # guest memory went away (racing exit)
             if ret is _BLOCK:
+                self._flush_cpu_lat()  # timeouts must see consumed CPU time
                 self._trace(nr, args, "<blocked>")
                 return
+            if ret in (_DETACH, _EXITGROUP):
+                self._flush_cpu_lat()
             if ret is _DETACH:
                 # thread announced exit: reply so it can finish dying
                 # natively, then never read its channel again
@@ -528,7 +537,10 @@ class ManagedProcess(ProcessLifecycle):
                 # model_unblocked_syscall_latency: each serviced syscall
                 # advances this host's clock slightly, so busy-loops spin
                 # forward in sim time instead of livelocking the round
-                self.host._now += self._syscall_latency
+                self._unapplied += self._syscall_latency
+                if self._unapplied > self._max_unapplied:
+                    self.host._now += self._unapplied
+                    self._unapplied = 0
             try:
                 self._reply(th, ret)
             except OSError:
@@ -725,6 +737,14 @@ class ManagedProcess(ProcessLifecycle):
     def _kick(self) -> None:
         if self.running and not self._pumping:
             self._drain_ready()
+
+    def _flush_cpu_lat(self) -> None:
+        """Apply accumulated-but-unapplied modeled CPU latency. Reference
+        semantics: unapplied latency flushes at blocking points so sleep/
+        poll timeouts are computed against the true consumed-CPU clock."""
+        if self._unapplied:
+            self.host._now += self._unapplied
+            self._unapplied = 0
 
     def _kill_now(self) -> None:
         """SIGKILL the guest synchronously (exit_group: sibling threads

@@ -683,3 +683,25 @@ def test_sel_pipe_managed():
     assert result["process_errors"] == [], result["process_errors"]
     out = Path("/tmp/st-selpipe/hosts/box/sel_pipe.0.stdout").read_text()
     assert "select-ok waited_ms=100" in out, out
+
+
+def test_cpu_latency_batching_flushes_at_blocking_points():
+    """max_unapplied_cpu_latency batches the modeled per-syscall clock
+    bumps; accumulated latency flushes before any blocking wait, so
+    sleeps still land at the right simulated instants (ms-identical
+    results to unbatched application)."""
+    outs = []
+    for knob in ("0", "1ms"):
+        cfg = parse_config(yaml.safe_load(SLEEP_CFG), {
+            "general.data_directory": f"/tmp/st-cpulat-{knob}",
+            "general.model_unblocked_syscall_latency": True,
+            "experimental.max_unapplied_cpu_latency": knob,
+        })
+        c = Controller(cfg, mirror_log=False)
+        result = c.run()
+        assert result["process_errors"] == [], result["process_errors"]
+        out = Path(f"/tmp/st-cpulat-{knob}/hosts/box/sleep_clock.0.stdout"
+                   ).read_text()
+        assert out.count("elapsed_ms=250") == 3, out
+        outs.append(out)
+    assert outs[0] == outs[1]

@@ -123,16 +123,12 @@ def test_without_bootstrap_same_config_cannot_finish():
     assert result["process_errors"] != []  # still running at stop_time
 
 
-def test_unimplemented_knobs_warn():
-    # the remaining accepted-but-unimplemented knob still warns ...
+def test_all_knobs_implemented_no_warnings():
+    # every schema knob now has a consumer: none of these may warn, and
+    # bogus values error loudly
     cfg = parse_config(yaml.safe_load(BOOT_CFG), {
         "general.data_directory": "/tmp/st-obs-warn",
         "experimental.max_unapplied_cpu_latency": "1ms",
-    })
-    assert any("max_unapplied_cpu_latency" in w for w in cfg.warnings)
-    # ... implemented ones no longer do, and bogus values error loudly
-    cfg = parse_config(yaml.safe_load(BOOT_CFG), {
-        "general.data_directory": "/tmp/st-obs-warn",
         "experimental.use_dynamic_runahead": True,
         "experimental.interface_qdisc": "round_robin",
     })
