@@ -1,0 +1,31 @@
+/* kill_child — signal-between-guests test program: forks a child that
+ * sleeps forever; the parent waits 50 ms (sim time), SIGTERMs it by pid,
+ * and verifies the wait status reports death by SIGTERM.
+ */
+#include <signal.h>
+#include <stdio.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+int main(void) {
+  pid_t child = fork();
+  if (child < 0) { perror("fork"); return 1; }
+  if (child == 0) {
+    for (;;) {
+      struct timespec ts = {3600, 0};
+      nanosleep(&ts, NULL);
+    }
+  }
+  struct timespec ts = {0, 50000000};
+  nanosleep(&ts, NULL);
+  if (kill(child, SIGTERM) != 0) { perror("kill"); return 1; }
+  int status = 0;
+  if (waitpid(child, &status, 0) != child) { perror("waitpid"); return 1; }
+  if (!WIFSIGNALED(status) || WTERMSIG(status) != SIGTERM) {
+    fprintf(stderr, "bad status %x\n", status);
+    return 1;
+  }
+  printf("kill-ok child=%d sig=%d\n", (int)child, WTERMSIG(status));
+  return 0;
+}

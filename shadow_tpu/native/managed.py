@@ -56,7 +56,14 @@ SYS_dup, SYS_dup2, SYS_dup3 = 32, 33, 292
 SYS_fstat, SYS_lseek, SYS_newfstatat = 5, 8, 262
 SYS_close_range = 436
 SYS_select, SYS_pselect6 = 23, 270
-WNOHANG, ECHILD = 1, 10
+SYS_kill = 62
+# default-terminate signals the worker emulates for guest-to-guest kill
+# every Linux default-terminate signal (+ realtime 34..64, all default-
+# terminate); STOP/CONT/TSTP (19,18,20..22) and default-ignores excluded
+_TERM_SIGS = ({1, 2, 3, 4, 5, 6, 7, 8, 10, 11, 12, 13, 14, 15, 16,
+               24, 25, 26, 27, 29, 30, 31} | set(range(34, 65)))
+_IGN_SIGS = {17, 23, 28}  # CHLD URG WINCH: default-ignore
+WNOHANG, ECHILD, ESRCH = 1, 10, 3
 MAX_THREADS = 32           # slots 1..31 map to shim fds 994..964
 SYS_futex = 202
 FUTEX_WAIT, FUTEX_WAKE, FUTEX_REQUEUE, FUTEX_CMP_REQUEUE = 0, 1, 3, 4
@@ -298,6 +305,7 @@ class ManagedProcess(ProcessLifecycle):
         self.vpid = 1000 + host.id * 64 + index
         # fork support
         self._exit_hint = None  # true exit code captured from exit_group
+        self._signal_hint = None  # -signum from an emulated kill(2)
         self.children: list = []  # forked ManagedProcess records
         self.parent_proc = None
         self.reaped = False  # consumed by the parent's wait4
@@ -757,6 +765,42 @@ class ManagedProcess(ProcessLifecycle):
             except ProcessLookupError:
                 pass
 
+    def _kill(self, args):
+        """kill(2) between managed guests of one simulated host: vpid
+        resolution + DEFAULT dispositions emulated worker-side (terminate /
+        ignore). Real in-guest handler delivery is out of scope — the
+        turn-taking protocol admits no out-of-turn syscalls (a handler
+        firing inside a parked syscall would corrupt the channel)."""
+        pid = args[0] & 0xFFFFFFFF
+        if pid >= (1 << 31):
+            pid -= 1 << 32
+        sig = args[1] & 0xFFFFFFFF
+        if sig > 64:
+            return -EINVAL
+        if pid <= 0:
+            return -EPERM  # process groups: not modeled
+        target = None
+        for p in self.host.processes:
+            if getattr(p, "vpid", None) == pid and p.running:
+                target = p
+                break
+        if target is None:
+            return -ESRCH
+        if sig == 0:
+            return 0  # existence probe
+        if sig in _IGN_SIGS or sig not in _TERM_SIGS and sig != 9:
+            return 0  # default-ignore, or dispositions we don't model
+        target._signal_hint = -sig
+        if target is self:
+            # self-signal with a fatal default: terminate after the reply
+            self._exit_hint = None
+            return _EXITGROUP
+        target._kill_now()
+        # the victim's channel EOF is collected here so its death (and
+        # any wait4 wakeup) lands at THIS sim instant, deterministically
+        target._exited()
+        return 0
+
     def _wait4(self, args):
         # pid is a C int: only the low 32 bits are defined (the ABI leaves
         # the upper half of the register unspecified for int args)
@@ -1051,11 +1095,18 @@ class ManagedProcess(ProcessLifecycle):
                 # exit_group path: the shim raw-exits / worker SIGKILLs,
                 # but the TRUE code was captured at the trap
                 code = self._exit_hint
+            if code < 0 and self._signal_hint is not None:
+                code = self._signal_hint  # the signal the guest was sent
         else:
             # adopted (forked) guest: not our OS child, no waitpid — the
             # captured exit_group code is authoritative; EOF without it
-            # means a signal death we cannot attribute precisely
-            code = self._exit_hint if self._exit_hint is not None else -9
+            # means a signal death (attributed when an emulated kill sent it)
+            if self._exit_hint is not None:
+                code = self._exit_hint
+            elif self._signal_hint is not None:
+                code = self._signal_hint
+            else:
+                code = -9
         if self._strace is not None:
             self._strace.write(f"+++ exited with {code} +++\n")
             self._strace.close()
@@ -1376,6 +1427,8 @@ class ManagedProcess(ProcessLifecycle):
             return self._fork_commit(args[0], args[1])
         if nr == SYS_wait4:
             return self._wait4(args)
+        if nr == SYS_kill:
+            return self._kill(args)
         if nr == SYS_exit_group:
             # record the true exit code; _pump then replies, SIGKILLs the
             # process synchronously (sibling threads must not outlive an

@@ -705,3 +705,27 @@ def test_cpu_latency_batching_flushes_at_blocking_points():
         assert out.count("elapsed_ms=250") == 3, out
         outs.append(out)
     assert outs[0] == outs[1]
+
+
+# ---- signals between guests -----------------------------------------------
+
+def test_kill_child_native_oracle():
+    r = subprocess.run([str(BUILD / "kill_child")], capture_output=True,
+                       text=True, timeout=30)
+    assert r.returncode == 0, r.stderr
+    assert "kill-ok" in r.stdout and "sig=15" in r.stdout
+
+
+def test_kill_child_managed():
+    """kill(2) between managed guests: the parent SIGTERMs its forked
+    child by vpid at a simulated instant; the worker emulates the default
+    disposition (terminate), and wait4 reports death by SIGTERM."""
+    cfg_text = SLEEP_CFG.replace("sleep_clock", "kill_child")
+    cfg = parse_config(yaml.safe_load(cfg_text), {
+        "general.data_directory": "/tmp/st-killchild",
+    })
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    out = Path("/tmp/st-killchild/hosts/box/kill_child.0.stdout").read_text()
+    assert "kill-ok child=40000 sig=15" in out, out
