@@ -15,6 +15,8 @@ import argparse
 import json
 import sys
 
+from shadow_tpu.config.schema import SCHEDULER_POLICIES
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
@@ -29,7 +31,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data-directory", help="override general.data_directory")
     p.add_argument(
         "--scheduler-policy",
-        choices=["thread_per_core", "thread_per_host", "tpu_batch"],
+        choices=list(SCHEDULER_POLICIES),
         help="override experimental.scheduler_policy",
     )
     p.add_argument(

@@ -25,7 +25,8 @@ import yaml
 from shadow_tpu.core.time import SimTime, parse_time
 from shadow_tpu.utils.units import parse_bandwidth, parse_size
 
-SCHEDULER_POLICIES = ("thread_per_core", "thread_per_host", "tpu_batch")
+SCHEDULER_POLICIES = ("thread_per_core", "thread_per_host", "tpu_batch",
+                      "tpu_mesh")
 LOG_LEVELS = ("error", "warning", "info", "debug", "trace")
 
 

@@ -118,7 +118,7 @@ class Controller:
             max_unit=cfg.experimental.unit_mtus * MTU,
         )
         policy = cfg.experimental.scheduler_policy
-        backend = "tpu" if policy == "tpu_batch" else "numpy"
+        backend = {"tpu_batch": "tpu", "tpu_mesh": "mesh"}.get(policy, "numpy")
         self.engine = NetworkEngine(
             self.graph, params, self.hosts, self.round_ns, backend=backend,
             tpu_options=cfg.experimental,

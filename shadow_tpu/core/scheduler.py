@@ -173,7 +173,7 @@ def make_scheduler(policy: str, hosts: Sequence, parallelism: int):
                 "OS threads; use thread_per_core or tpu_batch"
             )
         return ThreadPerHostScheduler(hosts)
-    if policy == "tpu_batch":
+    if policy in ("tpu_batch", "tpu_mesh"):
         # host events run serially on the main thread; the data plane is on
         # the device. (Event execution overlap with device steps comes from
         # dispatch asynchrony, not Python threads.)
