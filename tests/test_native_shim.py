@@ -729,3 +729,68 @@ def test_kill_child_managed():
     assert result["process_errors"] == [], result["process_errors"]
     out = Path("/tmp/st-killchild/hosts/box/kill_child.0.stdout").read_text()
     assert "kill-ok child=40000 sig=15" in out, out
+
+
+def test_cpython_http_server_serves_curl():
+    """The full server-side stack in an unmodified interpreter: CPython's
+    http.server (socket/bind/listen/accept/selectors) serves a 100 kB file
+    to distro curl over the simulated network. The server's own access log
+    timestamps in SIMULATED time and shows the client's SIMULATED address;
+    curl reports simulated transfer seconds. Bit-deterministic."""
+    import sys
+
+    srv_dir = Path("/tmp/st-pyhttp-docroot")
+    srv_dir.mkdir(exist_ok=True)
+    (srv_dir / "index.html").write_text("x" * 100000)
+    cfg_text = f"""
+general: {{stop_time: 20s, seed: 7}}
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 1 host_bandwidth_up "20 Mbit" host_bandwidth_down "20 Mbit" ]
+        edge [ source 0 target 1 latency "30 ms" ]
+        edge [ source 0 target 0 latency "5 ms" ]
+        edge [ source 1 target 1 latency "5 ms" ]
+      ]
+hosts:
+  pysrv:
+    network_node_id: 0
+    ip_addr: 11.0.0.1
+    processes:
+      - path: {sys.executable}
+        args: ["-u", "-m", "http.server", "--directory", "{srv_dir}",
+               "--bind", "0.0.0.0", "8080"]
+        expected_final_state: running
+  client:
+    network_node_id: 1
+    processes:
+      - path: /usr/bin/curl
+        args: ["-s", "-o", "/dev/null", "-w",
+               "code=%{{http_code}} bytes=%{{size_download}} time=%{{time_total}}\\n",
+               "http://11.0.0.1:8080/index.html"]
+        start_time: 2s
+        expected_final_state: {{exited: 0}}
+"""
+    outs = []
+    for tag in ("a", "b"):
+        cfg = parse_config(yaml.safe_load(cfg_text), {
+            "general.data_directory": f"/tmp/st-pyhttp-{tag}",
+        })
+        c = Controller(cfg, mirror_log=False)
+        result = c.run()
+        assert result["process_errors"] == [], result["process_errors"]
+        out = Path(f"/tmp/st-pyhttp-{tag}/hosts/client/curl.0.stdout"
+                   ).read_text()
+        assert "code=200 bytes=100000" in out, out
+        name = Path(sys.executable).name
+        log = Path(f"/tmp/st-pyhttp-{tag}/hosts/pysrv/{name}.0.stderr"
+                   ).read_text()
+        # the access log line carries the SIMULATED clock and client addr
+        assert "[01/Jan/2000 00:00:02]" in log, log
+        assert '"GET /index.html HTTP/1.1" 200' in log, log
+        outs.append(out + log.splitlines()[-1])
+    assert outs[0] == outs[1]
