@@ -1,0 +1,50 @@
+/* spair_echo — socketpair(2) test program: parent and forked child share
+ * a duplex AF_UNIX pair; the child sleeps 30 ms (sim time under the
+ * shim), uppercases what it reads, and sends it back; the parent
+ * verifies the echo and the round-trip timing.
+ */
+#include <ctype.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+int main(void) {
+  int sv[2];
+  if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) {
+    perror("socketpair");
+    return 1;
+  }
+  pid_t child = fork();
+  if (child < 0) { perror("fork"); return 1; }
+  if (child == 0) {
+    close(sv[0]);
+    char buf[64];
+    long r = read(sv[1], buf, sizeof buf);
+    if (r <= 0) _exit(9);
+    struct timespec ts = {0, 30000000};
+    nanosleep(&ts, NULL);
+    for (long i = 0; i < r; i++) buf[i] = (char)toupper(buf[i]);
+    if (write(sv[1], buf, r) != r) _exit(8);
+    close(sv[1]);
+    _exit(0);
+  }
+  close(sv[1]);
+  struct timespec t0, t1;
+  clock_gettime(CLOCK_REALTIME, &t0);
+  if (send(sv[0], "hello-spair", 11, 0) != 11) { perror("send"); return 1; }
+  char buf[64];
+  long r = recv(sv[0], buf, sizeof buf, 0);
+  clock_gettime(CLOCK_REALTIME, &t1);
+  if (r != 11 || memcmp(buf, "HELLO-SPAIR", 11) != 0) {
+    fprintf(stderr, "bad echo %ld\n", r);
+    return 1;
+  }
+  int status;
+  waitpid(child, &status, 0);
+  long ms = (t1.tv_sec - t0.tv_sec) * 1000 + (t1.tv_nsec - t0.tv_nsec) / 1000000;
+  printf("spair-ok rtt_ms=%ld\n", ms);
+  return 0;
+}

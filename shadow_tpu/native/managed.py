@@ -57,6 +57,7 @@ SYS_fstat, SYS_lseek, SYS_newfstatat = 5, 8, 262
 SYS_close_range = 436
 SYS_select, SYS_pselect6 = 23, 270
 SYS_kill = 62
+SYS_socketpair = 53
 # default-terminate signals the worker emulates for guest-to-guest kill
 # every Linux default-terminate signal (+ realtime 34..64, all default-
 # terminate); STOP/CONT/TSTP (19,18,20..22) and default-ignores excluded
@@ -169,7 +170,7 @@ class VSocket:
                  "connected", "connect_err", "bound_port", "listening",
                  "accept_q", "nonblock", "dgram_q", "udp", "interest",
                  "expirations", "interval_ns", "deadline", "timer_handle",
-                 "evt_counter", "refs", "pipe")
+                 "evt_counter", "refs", "pipe", "pipe_out")
 
     def __init__(self, vfd: int, kind: str = "stream") -> None:
         self.vfd = vfd
@@ -197,7 +198,9 @@ class VSocket:
         # table shares VSocket objects; the backing object closes when the
         # LAST table entry referencing it closes, like the kernel's)
         self.refs = 1
-        self.pipe = None  # PipeBuf when kind is pipe_r/pipe_w
+        self.pipe = None  # PipeBuf when kind is pipe_r/pipe_w (read side
+        # for "spair" duplex ends)
+        self.pipe_out = None  # "spair": the buffer this end WRITES
 
 
 class PipeBuf:
@@ -615,6 +618,8 @@ class ManagedProcess(ProcessLifecycle):
             vs.endpoint.close()
         if vs.pipe is not None:
             vs.pipe.wake()  # refs hit 0: EOF readers / EPIPE writers
+        if vs.pipe_out is not None:
+            vs.pipe_out.wake()
 
     def _thread_gone(self, th: GuestThread) -> None:
         """A non-main thread announced exit (or its channel died)."""
@@ -731,6 +736,8 @@ class ManagedProcess(ProcessLifecycle):
             vs.refs += 1
             if vs.pipe is not None:
                 vs.pipe.procs.add(self)
+            if vs.pipe_out is not None:
+                vs.pipe_out.procs.add(self)
         self._next_vfd = parent._next_vfd
         self.threads = {0: GuestThread(0, sock)}
         self._cur = self.threads[0]
@@ -846,6 +853,7 @@ class ManagedProcess(ProcessLifecycle):
             return -EBADF
         mode = {"pipe_r": 0o010600, "pipe_w": 0o010600,  # S_IFIFO
                 "stream": 0o140777, "dgram": 0o140777,   # S_IFSOCK
+                "spair": 0o140777,
                 }.get(vs.kind, 0o0600)  # epoll/timer/event: anon inode
         st = bytearray(144)  # struct stat, x86-64 layout
         struct.pack_into("<QQQIII", st, 0, 0, fd, 1, mode, 0, 0)
@@ -874,6 +882,33 @@ class ManagedProcess(ProcessLifecycle):
         self.mem.write(fds_ptr, struct.pack("<ii", r.vfd, w.vfd))
         return 0
 
+    def _socketpair(self, args):
+        """AF_UNIX socketpair(2): a duplex pair modeled as two cross-
+        wired PipeBufs (bash process substitution, mux/IPC idioms; works
+        across fork like pipes)."""
+        if args[0] != 1:  # AF_UNIX only
+            return -EAFNOSUPPORT
+        if (args[1] & 0xFF) != 1:  # SOCK_STREAM only — datagram pairs
+            return -93  # EPROTONOSUPPORT: fail loudly, not mis-frame
+        a = VSocket(self._next_vfd, "spair")
+        b = VSocket(self._next_vfd + 1, "spair")
+        self._next_vfd += 2
+        ab, ba = PipeBuf(), PipeBuf()  # a->b and b->a byte streams
+        ab.procs.add(self)
+        ba.procs.add(self)
+        ab.w_end, ab.r_end = a, b
+        ba.w_end, ba.r_end = b, a
+        a.pipe, a.pipe_out = ba, ab
+        b.pipe, b.pipe_out = ab, ba
+        if args[1] & 0o4000:  # SOCK_NONBLOCK
+            a.nonblock = b.nonblock = True
+        if args[1] & O_CLOEXEC:
+            self.fd_cloexec.update((a.vfd, b.vfd))
+        self.fds[a.vfd] = a
+        self.fds[b.vfd] = b
+        self.mem.write(args[3], struct.pack("<ii", a.vfd, b.vfd))
+        return 0
+
     def _dup(self, oldfd: int, newfd):
         vs = self.fds.get(oldfd)
         if vs is None:
@@ -892,6 +927,8 @@ class ManagedProcess(ProcessLifecycle):
 
     def _pipe_read(self, vs: VSocket, iovs):
         pb = vs.pipe
+        if pb is None:  # SHUT_RD half of a shutdown socketpair
+            return 0
         if pb.buf:
             k = min(len(pb.buf), sum(ln for _, ln in iovs))
             self._scatter(iovs, bytes(pb.buf[:k]))
@@ -908,8 +945,13 @@ class ManagedProcess(ProcessLifecycle):
 
     PIPE_BUF = 4096  # POSIX atomicity bound for pipe writes
 
+    def _wbuf(self, vs: VSocket):
+        return vs.pipe_out if vs.kind == "spair" else vs.pipe
+
     def _pipe_write(self, vs: VSocket, data: bytes):
-        pb = vs.pipe
+        pb = self._wbuf(vs)
+        if pb is None:  # SHUT_WR half of a shutdown socketpair
+            return -EPIPE
         if pb.readers == 0:
             return -EPIPE
         room = PipeBuf.CAP - len(pb.buf)
@@ -947,6 +989,7 @@ class ManagedProcess(ProcessLifecycle):
                 pb.waiting.append((self, th))
             return
         data, done = w[2], w[3]
+        pb = self._wbuf(vs)
         if pb.readers == 0:
             self._resume(th, done if done else -EPIPE)
             return
@@ -1156,7 +1199,7 @@ class ManagedProcess(ProcessLifecycle):
                 else:
                     self._notify()
                 return 8
-            if vs is not None and vs.kind == "pipe_w":
+            if vs is not None and vs.kind in ("pipe_w", "spair"):
                 return self._pipe_write(vs, self.mem.read(addr, min(n, 1 << 20)))
             if vs is not None and vs.kind == "pipe_r":
                 return -EBADF  # write on the read end
@@ -1167,7 +1210,7 @@ class ManagedProcess(ProcessLifecycle):
             vs = self.fds.get(args[0])
             if vs is not None and vs.kind in ("timer", "event"):
                 return self._counter_read(vs, args[1], args[2])
-            if vs is not None and vs.kind == "pipe_r":
+            if vs is not None and vs.kind in ("pipe_r", "spair"):
                 return self._pipe_read(vs, [(args[1], args[2])])
             if vs is not None and vs.kind == "pipe_w":
                 return -EBADF  # read on the write end
@@ -1245,6 +1288,17 @@ class ManagedProcess(ProcessLifecycle):
             vs = self.fds.get(args[0])
             if vs is None:
                 return -EBADF
+            if vs.kind == "spair":
+                how = args[1]
+                if how in (1, 2) and vs.pipe_out is not None:  # SHUT_WR
+                    pb, vs.pipe_out = vs.pipe_out, None
+                    pb.w_end = None  # writers -> 0: peer reads see EOF
+                    pb.wake()
+                if how in (0, 2) and vs.pipe is not None:  # SHUT_RD
+                    pb, vs.pipe = vs.pipe, None
+                    pb.r_end = None  # readers -> 0: peer writes see EPIPE
+                    pb.wake()
+                return 0
             if vs.endpoint is not None:
                 vs.endpoint.close()
             return 0
@@ -1337,8 +1391,12 @@ class ManagedProcess(ProcessLifecycle):
                 vs.nonblock = bool(flag)
                 return 0
             if args[1] == FIONREAD:
-                avail = (len(vs.rxbuf) if vs.kind == "stream"
-                         else (vs.dgram_q[0][1] if vs.dgram_q else 0))
+                if vs.kind in ("pipe_r", "spair"):
+                    avail = len(vs.pipe.buf) if vs.pipe is not None else 0
+                elif vs.kind == "stream":
+                    avail = len(vs.rxbuf)
+                else:
+                    avail = vs.dgram_q[0][1] if vs.dgram_q else 0
                 self.mem.write(args[2], struct.pack("<i", avail))
                 return 0
             return 0
@@ -1437,6 +1495,8 @@ class ManagedProcess(ProcessLifecycle):
             return _EXITGROUP
         if nr in (SYS_pipe, SYS_pipe2):
             return self._pipe(args[0], args[1] if nr == SYS_pipe2 else 0)
+        if nr == SYS_socketpair:
+            return self._socketpair(args)
         if nr == SYS_close_range:
             # close the range's VFDS only; real fds — including the shim's
             # reserved IPC window — survive (the guest can't be allowed to
@@ -1481,7 +1541,9 @@ class ManagedProcess(ProcessLifecycle):
             return vs.expirations > 0
         if vs.kind == "event":
             return vs.evt_counter > 0
-        if vs.kind == "pipe_r":
+        if vs.kind in ("pipe_r", "spair"):
+            if vs.pipe is None:
+                return True  # SHUT_RD: reads return EOF immediately
             return bool(vs.pipe.buf) or vs.pipe.writers == 0
         if vs.kind == "pipe_w":
             return False
@@ -1496,6 +1558,11 @@ class ManagedProcess(ProcessLifecycle):
             return True
         if vs.kind == "pipe_w":
             return (len(vs.pipe.buf) < PipeBuf.CAP) or vs.pipe.readers == 0
+        if vs.kind == "spair":
+            pb = vs.pipe_out
+            if pb is None:
+                return True  # SHUT_WR: writes fail fast with EPIPE
+            return (len(pb.buf) < PipeBuf.CAP) or pb.readers == 0
         if vs.kind == "pipe_r":
             return False
         ep = vs.endpoint
@@ -1749,6 +1816,8 @@ class ManagedProcess(ProcessLifecycle):
         vs = self.fds.get(fd)
         if vs is None:
             return -EBADF
+        if vs.kind == "spair":
+            return self._pipe_write(vs, self.mem.read(addr, min(n, 1 << 20)))
         if vs.endpoint is None or not vs.connected:
             return -ENOTCONN
         if vs.peer_closed:
@@ -1767,6 +1836,8 @@ class ManagedProcess(ProcessLifecycle):
         vs = self.fds.get(fd)
         if vs is None:
             return -EBADF
+        if vs.kind == "spair":
+            return self._pipe_read(vs, [(bufaddr, buflen)])
         if vs.endpoint is None:
             return -ENOTCONN
         if vs.rxbuf:
@@ -1980,6 +2051,8 @@ class ManagedProcess(ProcessLifecycle):
             # reuse the sendto path with a staged buffer
             return self._dgram_sendto(vs, (fd, 0, len(data), 0, name, namelen),
                                       staged=data)
+        if vs.kind == "spair":
+            return self._pipe_write(vs, data)
         return self._stream_send(vs, data)
 
     def _recvmsg(self, fd: int, msg_ptr: int):
@@ -1987,6 +2060,8 @@ class ManagedProcess(ProcessLifecycle):
         if vs is None:
             return -EBADF
         name, namelen, iovs = self._read_msghdr(msg_ptr)
+        if vs.kind == "spair":
+            return self._pipe_read(vs, iovs)
         if vs.kind == "dgram":
             if not vs.dgram_q:
                 if vs.nonblock:
@@ -2033,7 +2108,7 @@ class ManagedProcess(ProcessLifecycle):
             vs.evt_counter += struct.unpack("<Q", data[:8])[0]
             self._notify()
             return 8
-        if vs.kind == "pipe_w":
+        if vs.kind in ("pipe_w", "spair"):
             return self._pipe_write(vs, data)
         return self._stream_send(vs, data)
 
@@ -2048,7 +2123,7 @@ class ManagedProcess(ProcessLifecycle):
             if not iovs:
                 return -EINVAL
             return self._counter_read(vs, iovs[0][0], iovs[0][1])
-        if vs.kind == "pipe_r":
+        if vs.kind in ("pipe_r", "spair"):
             return self._pipe_read(vs, iovs)
         if vs.kind == "dgram":
             if not vs.dgram_q:

@@ -794,3 +794,25 @@ hosts:
         assert '"GET /index.html HTTP/1.1" 200' in log, log
         outs.append(out + log.splitlines()[-1])
     assert outs[0] == outs[1]
+
+
+def test_spair_echo_native_oracle():
+    r = subprocess.run([str(BUILD / "spair_echo")], capture_output=True,
+                       text=True, timeout=30)
+    assert r.returncode == 0, r.stderr
+    assert "spair-ok" in r.stdout
+
+
+def test_spair_echo_managed():
+    """socketpair(2) across fork: the duplex pair carries the request and
+    the uppercased echo between managed parent and child, with the child's
+    30 ms sleep on SIM time (rtt_ms=30 exactly)."""
+    cfg_text = SLEEP_CFG.replace("sleep_clock", "spair_echo")
+    cfg = parse_config(yaml.safe_load(cfg_text), {
+        "general.data_directory": "/tmp/st-spair",
+    })
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    out = Path("/tmp/st-spair/hosts/box/spair_echo.0.stdout").read_text()
+    assert "spair-ok rtt_ms=30" in out, out
