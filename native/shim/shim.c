@@ -62,6 +62,7 @@
 #define SHIM_THREAD_EXIT 0xFFFFFFF3u
 #define SHIM_FORK_INTENT 0xFFFFFFF4u
 #define SHIM_FORK_COMMIT 0xFFFFFFF5u
+#define SHIM_RESOLVE 0xFFFFFFF6u /* arg0 = name ptr -> IPv4 as host u32 */
 
 struct shim_req { uint64_t nr; uint64_t args[6]; };
 
@@ -514,6 +515,101 @@ static void *shim_thread_tramp(void *p) {
   void *r = t.fn(t.arg);
   forward(SHIM_THREAD_EXIT, (uint64_t)r, 0, 0, 0, 0, 0);
   return r;
+}
+
+/* ---- simulated name resolution ------------------------------------------
+ *
+ * Reference analog: Shadow resolves config host names to simulated IPs
+ * for its guests. getaddrinfo is interposed: names the WORKER knows
+ * (config host names) resolve to their simulated IPv4 without touching
+ * /etc/hosts or DNS; everything else falls through to the real resolver.
+ * Results we fabricate live in single-malloc blocks tracked in a small
+ * registry so the interposed freeaddrinfo releases ours and forwards the
+ * rest. */
+
+#include <netdb.h>
+#include <netinet/in.h>
+
+struct shim_ai_block {
+  struct addrinfo ai;
+  struct sockaddr_in sa;
+  char canon[256]; /* AI_CANONNAME storage (freed with the block) */
+};
+
+#define SHIM_AI_MAX 64
+static struct addrinfo *shim_ai_live[SHIM_AI_MAX];
+
+int getaddrinfo(const char *node, const char *service,
+                const struct addrinfo *hints, struct addrinfo **res) {
+  static int (*real)(const char *, const char *, const struct addrinfo *,
+                     struct addrinfo **);
+  if (!real) {
+    union { void *p; int (*f)(const char *, const char *,
+                              const struct addrinfo *,
+                              struct addrinfo **); } u;
+    u.p = dlsym(RTLD_NEXT, "getaddrinfo");
+    real = u.f;
+  }
+  int family_ok = !hints || hints->ai_family == AF_UNSPEC ||
+                  hints->ai_family == AF_INET;
+  if (shim_active && node != NULL && family_ok) {
+    int64_t ip = forward(SHIM_RESOLVE, (uint64_t)node, 0, 0, 0, 0, 0);
+    if (ip >= 0) {
+      long port = 0;
+      if (service) {
+        for (const char *p = service; *p; p++) {
+          if (*p < '0' || *p > '9' || port > 65535) { port = -1; break; }
+          port = port * 10 + (*p - '0');
+        }
+        if (port < 0 || port > 65535)
+          return EAI_SERVICE; /* named services: not modeled */
+      }
+      struct shim_ai_block *b = calloc(1, sizeof *b);
+      if (!b) return EAI_MEMORY;
+      b->sa.sin_family = AF_INET;
+      b->sa.sin_port = htons((uint16_t)port);
+      b->sa.sin_addr.s_addr = htonl((uint32_t)ip);
+      b->ai.ai_family = AF_INET;
+      b->ai.ai_socktype = hints && hints->ai_socktype ? hints->ai_socktype
+                                                      : SOCK_STREAM;
+      b->ai.ai_protocol = 0;
+      b->ai.ai_addrlen = sizeof b->sa;
+      b->ai.ai_addr = (struct sockaddr *)&b->sa;
+      if (hints && (hints->ai_flags & AI_CANONNAME)) {
+        strncpy(b->canon, node, sizeof b->canon - 1);
+        b->ai.ai_canonname = b->canon;
+      }
+      /* registry claim must be atomic (threaded resolvers) and must not
+       * drop: an unregistered block reaching the REAL freeaddrinfo is
+       * undefined behavior on allocator-layout-assuming libcs */
+      int claimed = 0;
+      for (int i = 0; i < SHIM_AI_MAX && !claimed; i++)
+        claimed = __sync_bool_compare_and_swap(&shim_ai_live[i], NULL,
+                                               &b->ai);
+      if (!claimed) {
+        free(b); /* registry full: degrade to the real resolver */
+        return real(node, service, hints, res);
+      }
+      *res = &b->ai;
+      return 0;
+    }
+  }
+  return real(node, service, hints, res);
+}
+
+void freeaddrinfo(struct addrinfo *ai) {
+  static void (*real)(struct addrinfo *);
+  if (!real) {
+    union { void *p; void (*f)(struct addrinfo *); } u;
+    u.p = dlsym(RTLD_NEXT, "freeaddrinfo");
+    real = u.f;
+  }
+  for (int i = 0; i < SHIM_AI_MAX; i++)
+    if (__sync_bool_compare_and_swap(&shim_ai_live[i], ai, NULL)) {
+      free(ai); /* the whole shim_ai_block in one allocation */
+      return;
+    }
+  real(ai);
 }
 
 pid_t vfork(void) {

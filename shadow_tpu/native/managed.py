@@ -51,6 +51,7 @@ THREAD_JOIN = 0xFFFFFFF2   # arg0 = slot; reply is the thread's retval
 THREAD_EXIT = 0xFFFFFFF3   # arg0 = retval; thread finishes dying natively
 FORK_INTENT = 0xFFFFFFF4   # -> reply carries embryo id + SCM_RIGHTS fd
 FORK_COMMIT = 0xFFFFFFF5   # args = (embryo id, real child pid) -> vpid
+RESOLVE = 0xFFFFFFF6       # arg0 = guest ptr to a hostname -> IPv4 (u32)
 SYS_wait4, SYS_exit_group, SYS_pipe, SYS_pipe2 = 61, 231, 22, 293
 SYS_dup, SYS_dup2, SYS_dup3 = 32, 33, 292
 SYS_fstat, SYS_lseek, SYS_newfstatat = 5, 8, 262
@@ -1483,6 +1484,17 @@ class ManagedProcess(ProcessLifecycle):
             return self._fork_intent()
         if nr == FORK_COMMIT:
             return self._fork_commit(args[0], args[1])
+        if nr == RESOLVE:
+            # simulated name resolution (shim-interposed getaddrinfo):
+            # config host names map to their simulated IPv4
+            name = self._read_cstr(args[0])
+            if name is not None:
+                ctl = self.host.controller
+                hid = ctl._by_name.get(name)
+                if hid is not None:
+                    return int.from_bytes(
+                        socket.inet_aton(ctl.hosts[hid].ip), "big")
+            return -1  # unknown: the shim falls through to the real resolver
         if nr == SYS_wait4:
             return self._wait4(args)
         if nr == SYS_kill:
@@ -1999,6 +2011,12 @@ class ManagedProcess(ProcessLifecycle):
         return _BLOCK
 
     # -- scatter-gather (msghdr/iovec walking via guest memory) --------------
+    def _read_cstr(self, ptr: int, limit: int = 256):
+        try:
+            return self.mem.read_cstr(ptr, limit).decode()
+        except (OSError, UnicodeDecodeError):
+            return None
+
     def _read_iovec(self, iov_ptr: int, iovcnt: int):
         """Reads a struct iovec[] from guest memory → [(base, len)]."""
         iovs = []

@@ -48,9 +48,13 @@ class ProcessMemory:
         return _vm_call(_libc.process_vm_writev, self.pid, buf, addr, len(data))
 
     def read_cstr(self, addr: int, limit: int = 4096) -> bytes:
+        """NUL-terminated guest string, read page-by-page: process_vm_readv
+        fails wholesale if any page is unmapped, so never read past the
+        page holding the terminator."""
         out = b""
         while len(out) < limit:
-            chunk = self.read(addr + len(out), min(256, limit - len(out)))
+            avail = min(4096 - ((addr + len(out)) & 4095), limit - len(out))
+            chunk = self.read(addr + len(out), avail)
             if b"\0" in chunk:
                 return out + chunk.split(b"\0", 1)[0]
             out += chunk

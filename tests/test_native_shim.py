@@ -816,3 +816,25 @@ def test_spair_echo_managed():
     assert result["process_errors"] == [], result["process_errors"]
     out = Path("/tmp/st-spair/hosts/box/spair_echo.0.stdout").read_text()
     assert "spair-ok rtt_ms=30" in out, out
+
+
+@pytest.mark.skipif(not Path("/usr/bin/curl").exists(), reason="no curl")
+def test_curl_resolves_simulated_hostname():
+    """Simulated name resolution: the shim interposes getaddrinfo and asks
+    the worker to resolve config host names to simulated IPs — curl
+    fetches http://webserver/ by NAME (through its threaded resolver,
+    which runs as a managed guest thread)."""
+    cfg_text = CURL_CFG.replace(
+        "http://11.0.0.1/data.bin", "http://server/data.bin")
+    outs = []
+    for tag in ("a", "b"):
+        cfg = parse_config(yaml.safe_load(cfg_text), {
+            "general.data_directory": f"/tmp/st-dns-{tag}",
+        })
+        c = Controller(cfg, mirror_log=False)
+        result = c.run()
+        assert result["process_errors"] == [], result["process_errors"]
+        out = Path(f"/tmp/st-dns-{tag}/hosts/client/curl.0.stdout").read_text()
+        assert "code=200 bytes=250000" in out, out
+        outs.append(out)
+    assert outs[0] == outs[1]
