@@ -705,56 +705,57 @@ void pthread_exit(void *retval) {
 
 static int install_seccomp(void) {
   /* BEGIN GENERATED BPF (tools/gen_bpf.py) */
-  struct sock_filter prog[] = {  /* 82 instructions */
+  struct sock_filter prog[] = {  /* 83 instructions */
       LD(BPF_ARCHF),
-      JEQ(AUDIT_ARCH_X86_64, 0, 79),
+      JEQ(AUDIT_ARCH_X86_64, 0, 80),
       LD(BPF_NR),
-      JEQ(0, 50, 0),  /* read */
-      JEQ(1, 54, 0),  /* write */
-      JEQ(3, 68, 0),  /* close */
-      JEQ(19, 47, 0),  /* readv */
-      JEQ(20, 51, 0),  /* writev */
-      JEQ(16, 68, 0),  /* ioctl */
-      JEQ(72, 67, 0),  /* fcntl */
-      JEQ(32, 66, 0),  /* dup */
-      JEQ(33, 65, 0),  /* dup2 */
-      JEQ(292, 64, 0),  /* dup3 */
-      JEQ(5, 63, 0),  /* fstat */
-      JEQ(8, 62, 0),  /* lseek */
-      JEQ(262, 61, 0),  /* newfstatat */
-      JEQ(35, 63, 0),  /* nanosleep */
-      JEQ(230, 62, 0),  /* clock_nanosleep */
-      JEQ(228, 61, 0),  /* clock_gettime */
-      JEQ(96, 60, 0),  /* gettimeofday */
-      JEQ(201, 59, 0),  /* time */
-      JEQ(318, 58, 0),  /* getrandom */
-      JEQ(7, 57, 0),  /* poll */
-      JEQ(271, 56, 0),  /* ppoll */
-      JEQ(213, 55, 0),  /* epoll_create */
-      JEQ(291, 54, 0),  /* epoll_create1 */
-      JEQ(233, 53, 0),  /* epoll_ctl */
-      JEQ(232, 52, 0),  /* epoll_wait */
-      JEQ(281, 51, 0),  /* epoll_pwait */
-      JEQ(288, 50, 0),  /* accept4 */
-      JEQ(435, 49, 0),  /* clone3 */
-      JEQ(39, 48, 0),  /* getpid */
-      JEQ(110, 47, 0),  /* getppid */
-      JEQ(186, 46, 0),  /* gettid */
-      JEQ(283, 45, 0),  /* timerfd_create */
-      JEQ(286, 44, 0),  /* timerfd_settime */
-      JEQ(287, 43, 0),  /* timerfd_gettime */
-      JEQ(284, 42, 0),  /* eventfd */
-      JEQ(290, 41, 0),  /* eventfd2 */
-      JEQ(202, 40, 0),  /* futex */
-      JEQ(14, 39, 0),  /* rt_sigprocmask */
-      JEQ(22, 38, 0),  /* pipe */
-      JEQ(293, 37, 0),  /* pipe2 */
-      JEQ(61, 36, 0),  /* wait4 */
-      JEQ(231, 35, 0),  /* exit_group */
-      JEQ(436, 34, 0),  /* close_range */
-      JEQ(23, 33, 0),  /* select */
-      JEQ(270, 32, 0),  /* pselect6 */
-      JEQ(62, 31, 0),  /* kill */
+      JEQ(0, 51, 0),  /* read */
+      JEQ(1, 55, 0),  /* write */
+      JEQ(3, 69, 0),  /* close */
+      JEQ(19, 48, 0),  /* readv */
+      JEQ(20, 52, 0),  /* writev */
+      JEQ(16, 69, 0),  /* ioctl */
+      JEQ(72, 68, 0),  /* fcntl */
+      JEQ(32, 67, 0),  /* dup */
+      JEQ(33, 66, 0),  /* dup2 */
+      JEQ(292, 65, 0),  /* dup3 */
+      JEQ(5, 64, 0),  /* fstat */
+      JEQ(8, 63, 0),  /* lseek */
+      JEQ(262, 62, 0),  /* newfstatat */
+      JEQ(35, 64, 0),  /* nanosleep */
+      JEQ(230, 63, 0),  /* clock_nanosleep */
+      JEQ(228, 62, 0),  /* clock_gettime */
+      JEQ(96, 61, 0),  /* gettimeofday */
+      JEQ(201, 60, 0),  /* time */
+      JEQ(318, 59, 0),  /* getrandom */
+      JEQ(7, 58, 0),  /* poll */
+      JEQ(271, 57, 0),  /* ppoll */
+      JEQ(213, 56, 0),  /* epoll_create */
+      JEQ(291, 55, 0),  /* epoll_create1 */
+      JEQ(233, 54, 0),  /* epoll_ctl */
+      JEQ(232, 53, 0),  /* epoll_wait */
+      JEQ(281, 52, 0),  /* epoll_pwait */
+      JEQ(288, 51, 0),  /* accept4 */
+      JEQ(435, 50, 0),  /* clone3 */
+      JEQ(39, 49, 0),  /* getpid */
+      JEQ(110, 48, 0),  /* getppid */
+      JEQ(186, 47, 0),  /* gettid */
+      JEQ(283, 46, 0),  /* timerfd_create */
+      JEQ(286, 45, 0),  /* timerfd_settime */
+      JEQ(287, 44, 0),  /* timerfd_gettime */
+      JEQ(284, 43, 0),  /* eventfd */
+      JEQ(290, 42, 0),  /* eventfd2 */
+      JEQ(202, 41, 0),  /* futex */
+      JEQ(14, 40, 0),  /* rt_sigprocmask */
+      JEQ(22, 39, 0),  /* pipe */
+      JEQ(293, 38, 0),  /* pipe2 */
+      JEQ(61, 37, 0),  /* wait4 */
+      JEQ(231, 36, 0),  /* exit_group */
+      JEQ(436, 35, 0),  /* close_range */
+      JEQ(23, 34, 0),  /* select */
+      JEQ(270, 33, 0),  /* pselect6 */
+      JEQ(62, 32, 0),  /* kill */
+      JEQ(63, 31, 0),  /* uname */
       JEQ(47, 14, 0),  /* recvmsg */
       JEQ(56, 16, 0),  /* clone */
       JEQ(59, 18, 0),  /* execve */

@@ -1,0 +1,3 @@
+import socket, os
+print("hostname:", socket.gethostname())
+print("nodename:", os.uname().nodename)

@@ -59,6 +59,7 @@ SYS_close_range = 436
 SYS_select, SYS_pselect6 = 23, 270
 SYS_kill = 62
 SYS_socketpair = 53
+SYS_uname = 63
 # default-terminate signals the worker emulates for guest-to-guest kill
 # every Linux default-terminate signal (+ realtime 34..64, all default-
 # terminate); STOP/CONT/TSTP (19,18,20..22) and default-ignores excluded
@@ -1499,6 +1500,16 @@ class ManagedProcess(ProcessLifecycle):
             return self._wait4(args)
         if nr == SYS_kill:
             return self._kill(args)
+        if nr == SYS_uname:
+            # identity virtualization: nodename is the SIMULATED host name
+            # (gethostname() routes through uname in glibc)
+            u = os.uname()
+            buf = b"".join(
+                s.encode()[:64].ljust(65, b"\0")
+                for s in ("Linux", self.host.name, u.release, u.version,
+                          u.machine, ""))
+            self.mem.write(args[0], buf)
+            return 0
         if nr == SYS_exit_group:
             # record the true exit code; _pump then replies, SIGKILLs the
             # process synchronously (sibling threads must not outlive an

@@ -838,3 +838,23 @@ def test_curl_resolves_simulated_hostname():
         assert "code=200 bytes=250000" in out, out
         outs.append(out)
     assert outs[0] == outs[1]
+
+
+def test_guest_hostname_is_simulated_identity():
+    """uname(2) is virtualized: a guest's nodename (and so gethostname())
+    is its CONFIG host name, not the real machine's."""
+    import sys
+
+    cfg_text = SLEEP_CFG.replace("box:", "relay7:").replace(
+        f"path: {BUILD}/sleep_clock",
+        f"path: {sys.executable}\n        args: "
+        f"[\"{ROOT}/native/tests/guest/py_ident.py\"]")
+    cfg = parse_config(yaml.safe_load(cfg_text), {
+        "general.data_directory": "/tmp/st-ident",
+    })
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    name = Path(sys.executable).name
+    out = Path(f"/tmp/st-ident/hosts/relay7/{name}.0.stdout").read_text()
+    assert "hostname: relay7" in out and "nodename: relay7" in out, out
