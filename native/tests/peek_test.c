@@ -1,0 +1,21 @@
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <unistd.h>
+int main(void) {
+  int sv[2];
+  socketpair(AF_UNIX, SOCK_STREAM, 0, sv);
+  pid_t c = fork();
+  if (c == 0) { write(sv[1], "peekaboo", 8); _exit(0); }
+  char a[16] = {0}, b[16] = {0};
+  long r1 = recv(sv[0], a, 4, MSG_PEEK);
+  long r2 = recv(sv[0], b, 8, 0);
+  waitpid(c, 0, 0);
+  if (r1 != 4 || memcmp(a, "peek", 4) || r2 != 8 || memcmp(b, "peekaboo", 8)) {
+    fprintf(stderr, "peek broken: %ld %ld %s %s\n", r1, r2, a, b);
+    return 1;
+  }
+  printf("peek-ok\n");
+  return 0;
+}

@@ -927,13 +927,15 @@ class ManagedProcess(ProcessLifecycle):
         self.fd_cloexec.discard(newfd)  # dup/dup2 clear FD_CLOEXEC
         return newfd
 
-    def _pipe_read(self, vs: VSocket, iovs):
+    def _pipe_read(self, vs: VSocket, iovs, peek: bool = False):
         pb = vs.pipe
         if pb is None:  # SHUT_RD half of a shutdown socketpair
             return 0
         if pb.buf:
             k = min(len(pb.buf), sum(ln for _, ln in iovs))
             self._scatter(iovs, bytes(pb.buf[:k]))
+            if peek:  # MSG_PEEK: leave the data in place
+                return k
             del pb.buf[:k]
             pb.wake()  # writers may have room now
             return k
@@ -941,7 +943,7 @@ class ManagedProcess(ProcessLifecycle):
             return 0  # EOF
         if vs.nonblock:
             return -EAGAIN
-        self._cur.waiting = ("pipe_r", vs, iovs)
+        self._cur.waiting = ("pipe_r", vs, iovs, peek)
         pb.waiting.append((self, self._cur))
         return _BLOCK
 
@@ -982,9 +984,10 @@ class ManagedProcess(ProcessLifecycle):
             if pb.buf:
                 k = min(len(pb.buf), sum(ln for _, ln in w[2]))
                 self._scatter(w[2], bytes(pb.buf[:k]))
-                del pb.buf[:k]
+                if not (len(w) > 3 and w[3]):  # MSG_PEEK leaves the data
+                    del pb.buf[:k]
+                    pb.wake()
                 self._resume(th, k)
-                pb.wake()
             elif pb.writers == 0:
                 self._resume(th, 0)
             else:
@@ -1285,7 +1288,8 @@ class ManagedProcess(ProcessLifecycle):
             vs = self.fds.get(args[0])
             if vs is not None and vs.kind == "dgram":
                 return self._dgram_recvfrom(vs, args)
-            return self._vfd_recv(args[0], args[1], args[2])
+            return self._vfd_recv(args[0], args[1], args[2],
+                                  peek=bool(args[3] & 2))  # MSG_PEEK
         if nr == SYS_shutdown:
             vs = self.fds.get(args[0])
             if vs is None:
@@ -1798,7 +1802,8 @@ class ManagedProcess(ProcessLifecycle):
         th, w = self._find_waiter((("recv", "rmsg"), vs))
         if th is not None:
             if w[0] == "recv":
-                self._fulfill_recv(th, vs, w[2], w[3])
+                self._fulfill_recv(th, vs, w[2], w[3],
+                                   w[4] if len(w) > 4 else False)
             else:
                 self._resume(th, self._scatter_rx(vs, w[2]))
             return
@@ -1855,25 +1860,35 @@ class ManagedProcess(ProcessLifecycle):
         self._waiting = ("send", vs, addr, n)
         return _BLOCK
 
-    def _vfd_recv(self, fd: int, bufaddr: int, buflen: int):
+    def _vfd_recv(self, fd: int, bufaddr: int, buflen: int,
+                  peek: bool = False):
         vs = self.fds.get(fd)
         if vs is None:
             return -EBADF
         if vs.kind == "spair":
-            return self._pipe_read(vs, [(bufaddr, buflen)])
+            return self._pipe_read(vs, [(bufaddr, buflen)], peek=peek)
         if vs.endpoint is None:
             return -ENOTCONN
         if vs.rxbuf:
+            if peek:  # MSG_PEEK: copy without consuming
+                k = min(len(vs.rxbuf), buflen)
+                self.mem.write(bufaddr, bytes(vs.rxbuf[:k]))
+                return k
             return self._take_rx(vs, bufaddr, buflen)
         if vs.peer_closed:
             return 0
         if vs.nonblock:
             return -EAGAIN
-        self._waiting = ("recv", vs, bufaddr, buflen)
+        self._waiting = ("recv", vs, bufaddr, buflen, peek)
         return _BLOCK
 
     def _fulfill_recv(self, th: GuestThread, vs: VSocket, bufaddr: int,
-                      buflen: int) -> None:
+                      buflen: int, peek: bool = False) -> None:
+        if peek:  # a parked MSG_PEEK must not consume on wakeup
+            k = min(len(vs.rxbuf), buflen)
+            self.mem.write(bufaddr, bytes(vs.rxbuf[:k]))
+            self._resume(th, k)
+            return
         self._resume(th, self._take_rx(vs, bufaddr, buflen))
 
     def _take_rx(self, vs: VSocket, bufaddr: int, buflen: int) -> int:

@@ -858,3 +858,24 @@ def test_guest_hostname_is_simulated_identity():
     name = Path(sys.executable).name
     out = Path(f"/tmp/st-ident/hosts/relay7/{name}.0.stdout").read_text()
     assert "hostname: relay7" in out and "nodename: relay7" in out, out
+
+
+def test_msg_peek_native_oracle():
+    r = subprocess.run([str(BUILD / "peek_test")], capture_output=True,
+                       text=True, timeout=30)
+    assert r.returncode == 0, r.stderr
+    assert "peek-ok" in r.stdout
+
+
+def test_msg_peek_managed():
+    """MSG_PEEK copies without consuming — including a peek that parked
+    before the data arrived (the wakeup must not consume either)."""
+    cfg_text = SLEEP_CFG.replace("sleep_clock", "peek_test")
+    cfg = parse_config(yaml.safe_load(cfg_text), {
+        "general.data_directory": "/tmp/st-peek-t",
+    })
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    out = Path("/tmp/st-peek-t/hosts/box/peek_test.0.stdout").read_text()
+    assert "peek-ok" in out, out
