@@ -1287,7 +1287,8 @@ class ManagedProcess(ProcessLifecycle):
         if nr == SYS_recvfrom:
             vs = self.fds.get(args[0])
             if vs is not None and vs.kind == "dgram":
-                return self._dgram_recvfrom(vs, args)
+                return self._dgram_recvfrom(vs, args,
+                                            peek=bool(args[3] & 2))
             return self._vfd_recv(args[0], args[1], args[2],
                                   peek=bool(args[3] & 2))  # MSG_PEEK
         if nr == SYS_shutdown:
@@ -1445,7 +1446,8 @@ class ManagedProcess(ProcessLifecycle):
         if nr == SYS_sendmsg:
             return self._sendmsg(args[0], args[1])
         if nr == SYS_recvmsg:
-            return self._recvmsg(args[0], args[1])
+            return self._recvmsg(args[0], args[1],
+                                 peek=bool(args[2] & 2))  # MSG_PEEK
         if nr == SYS_writev:
             return self._writev(args[0], args[1], args[2])
         if nr == SYS_readv:
@@ -1799,14 +1801,16 @@ class ManagedProcess(ProcessLifecycle):
 
     def _on_net_data(self, vs: VSocket, n: int, payload) -> None:
         vs.rxbuf += payload if payload is not None else b"\0" * n
-        th, w = self._find_waiter((("recv", "rmsg"), vs))
-        if th is not None:
+        # wake every satisfiable waiter: a fulfilled MSG_PEEK leaves the
+        # data in place, so another thread's recv may also be servable
+        while vs.rxbuf:
+            th, w = self._find_waiter((("recv", "rmsg"), vs))
+            if th is None:
+                break
             if w[0] == "recv":
-                self._fulfill_recv(th, vs, w[2], w[3],
-                                   w[4] if len(w) > 4 else False)
+                self._fulfill_recv(th, vs, w[2], w[3], w[4])
             else:
                 self._resume(th, self._scatter_rx(vs, w[2]))
-            return
         self._notify()
 
     def _on_net_close(self, vs: VSocket) -> None:
@@ -1870,11 +1874,7 @@ class ManagedProcess(ProcessLifecycle):
         if vs.endpoint is None:
             return -ENOTCONN
         if vs.rxbuf:
-            if peek:  # MSG_PEEK: copy without consuming
-                k = min(len(vs.rxbuf), buflen)
-                self.mem.write(bufaddr, bytes(vs.rxbuf[:k]))
-                return k
-            return self._take_rx(vs, bufaddr, buflen)
+            return self._take_rx(vs, bufaddr, buflen, consume=not peek)
         if vs.peer_closed:
             return 0
         if vs.nonblock:
@@ -1884,17 +1884,16 @@ class ManagedProcess(ProcessLifecycle):
 
     def _fulfill_recv(self, th: GuestThread, vs: VSocket, bufaddr: int,
                       buflen: int, peek: bool = False) -> None:
-        if peek:  # a parked MSG_PEEK must not consume on wakeup
-            k = min(len(vs.rxbuf), buflen)
-            self.mem.write(bufaddr, bytes(vs.rxbuf[:k]))
-            self._resume(th, k)
-            return
-        self._resume(th, self._take_rx(vs, bufaddr, buflen))
+        # a parked MSG_PEEK must not consume on wakeup
+        self._resume(th, self._take_rx(vs, bufaddr, buflen,
+                                       consume=not peek))
 
-    def _take_rx(self, vs: VSocket, bufaddr: int, buflen: int) -> int:
+    def _take_rx(self, vs: VSocket, bufaddr: int, buflen: int,
+                 consume: bool = True) -> int:
         k = min(len(vs.rxbuf), buflen)
         self.mem.write(bufaddr, bytes(vs.rxbuf[:k]))
-        del vs.rxbuf[:k]
+        if consume:
+            del vs.rxbuf[:k]
         return k
 
     # -- select -------------------------------------------------------------
@@ -2099,13 +2098,13 @@ class ManagedProcess(ProcessLifecycle):
             return self._pipe_write(vs, data)
         return self._stream_send(vs, data)
 
-    def _recvmsg(self, fd: int, msg_ptr: int):
+    def _recvmsg(self, fd: int, msg_ptr: int, peek: bool = False):
         vs = self.fds.get(fd)
         if vs is None:
             return -EBADF
         name, namelen, iovs = self._read_msghdr(msg_ptr)
         if vs.kind == "spair":
-            return self._pipe_read(vs, iovs)
+            return self._pipe_read(vs, iovs, peek=peek)
         if vs.kind == "dgram":
             if not vs.dgram_q:
                 if vs.nonblock:
@@ -2114,6 +2113,10 @@ class ManagedProcess(ProcessLifecycle):
                 return _BLOCK
             return self._recvmsg_take(vs, iovs, (msg_ptr, name, namelen))
         if vs.rxbuf:
+            if peek:
+                k = min(len(vs.rxbuf), sum(ln for _, ln in iovs))
+                self._scatter(iovs, bytes(vs.rxbuf[:k]))
+                return k
             return self._scatter_rx(vs, iovs)
         if vs.peer_closed:
             return 0
@@ -2267,15 +2270,20 @@ class ManagedProcess(ProcessLifecycle):
 
         def on_datagram(nbytes, payload, src_addr, now):
             vs.dgram_q.append((payload, nbytes, src_addr[0], src_addr[1]))
-            th, w = self._find_waiter((("drecv", "dmsg"), vs))
-            if th is not None:
+            # wake every satisfiable waiter: a fulfilled MSG_PEEK leaves
+            # the datagram queued for the next reader
+            while vs.dgram_q:
+                th, w = self._find_waiter((("drecv", "dmsg"), vs))
+                if th is None:
+                    break
                 if w[0] == "drecv":
                     self._resume(
-                        th, self._dgram_take(vs, w[2], w[3], w[4], w[5]))
+                        th, self._dgram_take(vs, w[2], w[3], w[4], w[5],
+                                             consume=not (len(w) > 6
+                                                          and w[6])))
                 else:
                     self._resume(th, self._recvmsg_take(vs, w[2], w[3]))
-            else:
-                self._notify()
+            self._notify()
 
         sock.on_datagram = on_datagram
         return 0
@@ -2299,19 +2307,25 @@ class ManagedProcess(ProcessLifecycle):
         vs.udp.sendto(peer, port, payload=data)
         return len(data)
 
-    def _dgram_recvfrom(self, vs: VSocket, args):
+    def _dgram_recvfrom(self, vs: VSocket, args, peek: bool = False):
         if vs.udp is None:
             return -ENOTCONN
         if vs.dgram_q:
-            return self._dgram_take(vs, args[1], args[2], args[4], args[5])
+            return self._dgram_take(vs, args[1], args[2], args[4], args[5],
+                                    consume=not peek)
         if vs.nonblock:
             return -EAGAIN
-        self._waiting = ("drecv", vs, args[1], args[2], args[4], args[5])
+        self._waiting = ("drecv", vs, args[1], args[2], args[4], args[5],
+                         peek)
         return _BLOCK
 
     def _dgram_take(self, vs: VSocket, buf: int, buflen: int,
-                    src_ptr: int, srclen_ptr: int) -> int:
-        payload, nbytes, src, sport = vs.dgram_q.pop(0)
+                    src_ptr: int, srclen_ptr: int,
+                    consume: bool = True) -> int:
+        if consume:
+            payload, nbytes, src, sport = vs.dgram_q.pop(0)
+        else:  # MSG_PEEK: inspect without dequeuing
+            payload, nbytes, src, sport = vs.dgram_q[0]
         data = payload if payload is not None else b"\0" * nbytes
         k = min(len(data), buflen)
         self.mem.write(buf, data[:k])
