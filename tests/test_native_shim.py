@@ -879,3 +879,47 @@ def test_msg_peek_managed():
     assert result["process_errors"] == [], result["process_errors"]
     out = Path("/tmp/st-peek-t/hosts/box/peek_test.0.stdout").read_text()
     assert "peek-ok" in out, out
+
+
+def test_fifty_real_processes_concurrently():
+    """Scale the native layer itself: 10 real server binaries x 4
+    connections each, 40 real clients — 50 concurrent managed processes,
+    every transfer completing, bit-deterministic."""
+    hosts = {}
+    for i in range(10):
+        hosts[f"srv{i}"] = {
+            "network_node_id": 0, "ip_addr": f"11.0.0.{i + 1}",
+            "processes": [{"path": str(BUILD / "tgen_srv"),
+                           "args": ["8080", "4"],
+                           "expected_final_state": {"exited": 0}}]}
+    for i in range(40):
+        hosts[f"cli{i}"] = {
+            "network_node_id": 1,
+            "processes": [{"path": str(BUILD / "tgen_cli"),
+                           "args": [f"11.0.0.{(i % 10) + 1}", "8080",
+                                    "100000"],
+                           "start_time": f"{1000 + i * 37} ms",
+                           "expected_final_state": {"exited": 0}}]}
+    doc = {
+        "general": {"stop_time": "30s", "seed": 11},
+        "network": {"graph": {"type": "gml", "inline": """graph [
+  directed 0
+  node [ id 0 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+  node [ id 1 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+  edge [ source 0 target 1 latency "20 ms" ]
+  edge [ source 0 target 0 latency "2 ms" ]
+  edge [ source 1 target 1 latency "2 ms" ]
+]"""}},
+        "hosts": hosts,
+    }
+    results = []
+    for tag in ("a", "b"):
+        cfg = parse_config(doc, {
+            "general.data_directory": f"/tmp/st-fifty-{tag}"})
+        r = Controller(cfg, mirror_log=False).run()
+        assert r["process_errors"] == [], r["process_errors"][:5]
+        results.append(r)
+    a, b = results
+    for k in ("events", "units_sent", "bytes_sent"):
+        assert a[k] == b[k], k
+    assert a["bytes_sent"] >= 40 * 100000
