@@ -60,6 +60,7 @@ SYS_select, SYS_pselect6 = 23, 270
 SYS_kill = 62
 SYS_socketpair = 53
 SYS_uname = 63
+SYS_times, SYS_clock_getres = 100, 229
 # default-terminate signals the worker emulates for guest-to-guest kill
 # every Linux default-terminate signal (+ realtime 34..64, all default-
 # terminate); STOP/CONT/TSTP (19,18,20..22) and default-ignores excluded
@@ -1506,6 +1507,17 @@ class ManagedProcess(ProcessLifecycle):
             return self._wait4(args)
         if nr == SYS_kill:
             return self._kill(args)
+        if nr == SYS_times:
+            # clock ticks (100/s) of SIM time; per-process CPU split is
+            # not modeled — report elapsed in utime, zeros elsewhere
+            ticks = emulated(h.now) * 100 // NS_PER_SEC
+            if args[0]:
+                self.mem.write(args[0], struct.pack("<qqqq", ticks, 0, 0, 0))
+            return ticks & 0x7FFFFFFFFFFFFFFF
+        if nr == SYS_clock_getres:
+            if args[1]:
+                self.mem.write(args[1], struct.pack("<qq", 0, 1))  # 1 ns
+            return 0
         if nr == SYS_uname:
             # identity virtualization: nodename is the SIMULATED host name
             # (gethostname() routes through uname in glibc)
