@@ -705,59 +705,61 @@ void pthread_exit(void *retval) {
 
 static int install_seccomp(void) {
   /* BEGIN GENERATED BPF (tools/gen_bpf.py) */
-  struct sock_filter prog[] = {  /* 85 instructions */
+  struct sock_filter prog[] = {  /* 87 instructions */
       LD(BPF_ARCHF),
-      JEQ(AUDIT_ARCH_X86_64, 0, 82),
+      JEQ(AUDIT_ARCH_X86_64, 0, 84),
       LD(BPF_NR),
-      JEQ(0, 53, 0),  /* read */
-      JEQ(1, 57, 0),  /* write */
-      JEQ(3, 71, 0),  /* close */
-      JEQ(19, 50, 0),  /* readv */
-      JEQ(20, 54, 0),  /* writev */
-      JEQ(16, 71, 0),  /* ioctl */
-      JEQ(72, 70, 0),  /* fcntl */
-      JEQ(32, 69, 0),  /* dup */
-      JEQ(33, 68, 0),  /* dup2 */
-      JEQ(292, 67, 0),  /* dup3 */
-      JEQ(5, 66, 0),  /* fstat */
-      JEQ(8, 65, 0),  /* lseek */
-      JEQ(262, 64, 0),  /* newfstatat */
-      JEQ(35, 66, 0),  /* nanosleep */
-      JEQ(230, 65, 0),  /* clock_nanosleep */
-      JEQ(228, 64, 0),  /* clock_gettime */
-      JEQ(96, 63, 0),  /* gettimeofday */
-      JEQ(201, 62, 0),  /* time */
-      JEQ(318, 61, 0),  /* getrandom */
-      JEQ(7, 60, 0),  /* poll */
-      JEQ(271, 59, 0),  /* ppoll */
-      JEQ(213, 58, 0),  /* epoll_create */
-      JEQ(291, 57, 0),  /* epoll_create1 */
-      JEQ(233, 56, 0),  /* epoll_ctl */
-      JEQ(232, 55, 0),  /* epoll_wait */
-      JEQ(281, 54, 0),  /* epoll_pwait */
-      JEQ(288, 53, 0),  /* accept4 */
-      JEQ(435, 52, 0),  /* clone3 */
-      JEQ(39, 51, 0),  /* getpid */
-      JEQ(110, 50, 0),  /* getppid */
-      JEQ(186, 49, 0),  /* gettid */
-      JEQ(283, 48, 0),  /* timerfd_create */
-      JEQ(286, 47, 0),  /* timerfd_settime */
-      JEQ(287, 46, 0),  /* timerfd_gettime */
-      JEQ(284, 45, 0),  /* eventfd */
-      JEQ(290, 44, 0),  /* eventfd2 */
-      JEQ(202, 43, 0),  /* futex */
-      JEQ(14, 42, 0),  /* rt_sigprocmask */
-      JEQ(22, 41, 0),  /* pipe */
-      JEQ(293, 40, 0),  /* pipe2 */
-      JEQ(61, 39, 0),  /* wait4 */
-      JEQ(231, 38, 0),  /* exit_group */
-      JEQ(436, 37, 0),  /* close_range */
-      JEQ(23, 36, 0),  /* select */
-      JEQ(270, 35, 0),  /* pselect6 */
-      JEQ(62, 34, 0),  /* kill */
-      JEQ(63, 33, 0),  /* uname */
-      JEQ(100, 32, 0),  /* times */
-      JEQ(229, 31, 0),  /* clock_getres */
+      JEQ(0, 55, 0),  /* read */
+      JEQ(1, 59, 0),  /* write */
+      JEQ(3, 73, 0),  /* close */
+      JEQ(19, 52, 0),  /* readv */
+      JEQ(20, 56, 0),  /* writev */
+      JEQ(16, 73, 0),  /* ioctl */
+      JEQ(72, 72, 0),  /* fcntl */
+      JEQ(32, 71, 0),  /* dup */
+      JEQ(33, 70, 0),  /* dup2 */
+      JEQ(292, 69, 0),  /* dup3 */
+      JEQ(5, 68, 0),  /* fstat */
+      JEQ(8, 67, 0),  /* lseek */
+      JEQ(262, 66, 0),  /* newfstatat */
+      JEQ(35, 68, 0),  /* nanosleep */
+      JEQ(230, 67, 0),  /* clock_nanosleep */
+      JEQ(228, 66, 0),  /* clock_gettime */
+      JEQ(96, 65, 0),  /* gettimeofday */
+      JEQ(201, 64, 0),  /* time */
+      JEQ(318, 63, 0),  /* getrandom */
+      JEQ(7, 62, 0),  /* poll */
+      JEQ(271, 61, 0),  /* ppoll */
+      JEQ(213, 60, 0),  /* epoll_create */
+      JEQ(291, 59, 0),  /* epoll_create1 */
+      JEQ(233, 58, 0),  /* epoll_ctl */
+      JEQ(232, 57, 0),  /* epoll_wait */
+      JEQ(281, 56, 0),  /* epoll_pwait */
+      JEQ(288, 55, 0),  /* accept4 */
+      JEQ(435, 54, 0),  /* clone3 */
+      JEQ(39, 53, 0),  /* getpid */
+      JEQ(110, 52, 0),  /* getppid */
+      JEQ(186, 51, 0),  /* gettid */
+      JEQ(283, 50, 0),  /* timerfd_create */
+      JEQ(286, 49, 0),  /* timerfd_settime */
+      JEQ(287, 48, 0),  /* timerfd_gettime */
+      JEQ(284, 47, 0),  /* eventfd */
+      JEQ(290, 46, 0),  /* eventfd2 */
+      JEQ(202, 45, 0),  /* futex */
+      JEQ(14, 44, 0),  /* rt_sigprocmask */
+      JEQ(22, 43, 0),  /* pipe */
+      JEQ(293, 42, 0),  /* pipe2 */
+      JEQ(61, 41, 0),  /* wait4 */
+      JEQ(231, 40, 0),  /* exit_group */
+      JEQ(436, 39, 0),  /* close_range */
+      JEQ(23, 38, 0),  /* select */
+      JEQ(270, 37, 0),  /* pselect6 */
+      JEQ(62, 36, 0),  /* kill */
+      JEQ(63, 35, 0),  /* uname */
+      JEQ(100, 34, 0),  /* times */
+      JEQ(229, 33, 0),  /* clock_getres */
+      JEQ(204, 32, 0),  /* sched_getaffinity */
+      JEQ(99, 31, 0),  /* sysinfo */
       JEQ(47, 14, 0),  /* recvmsg */
       JEQ(56, 16, 0),  /* clone */
       JEQ(59, 18, 0),  /* execve */

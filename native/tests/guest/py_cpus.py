@@ -1,0 +1,2 @@
+import os
+print("cpus:", os.cpu_count(), len(os.sched_getaffinity(0)))

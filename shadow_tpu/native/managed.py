@@ -61,6 +61,8 @@ SYS_kill = 62
 SYS_socketpair = 53
 SYS_uname = 63
 SYS_times, SYS_clock_getres = 100, 229
+SYS_sched_getaffinity, SYS_sysinfo = 204, 99
+SIM_CPUS = 2  # virtual cores guests see (machine-independent behavior)
 # default-terminate signals the worker emulates for guest-to-guest kill
 # every Linux default-terminate signal (+ realtime 34..64, all default-
 # terminate); STOP/CONT/TSTP (19,18,20..22) and default-ignores excluded
@@ -1507,6 +1509,26 @@ class ManagedProcess(ProcessLifecycle):
             return self._wait4(args)
         if nr == SYS_kill:
             return self._kill(args)
+        if nr == SYS_sched_getaffinity:
+            # deterministic virtual CPU count: guests sizing thread pools
+            # by core count behave identically on every real machine (and
+            # stay inside the 31-thread channel window)
+            size = min(args[1], 128)
+            if size < 8:
+                return -EINVAL
+            mask = ((1 << SIM_CPUS) - 1).to_bytes(8, "little")
+            self.mem.write(args[2], mask + b"\0" * (size - 8))
+            return 8  # kernel returns the mask size it wrote
+        if nr == SYS_sysinfo:
+            # deterministic virtual machine: 2 GB RAM, sim uptime
+            si = bytearray(112)  # sizeof(struct sysinfo) on x86-64
+            struct.pack_into("<q", si, 0, emulated(h.now) // NS_PER_SEC)
+            struct.pack_into("<QQ", si, 32,
+                             2 << 30, (2 << 30) - (256 << 20))
+            struct.pack_into("<H", si, 80, 1)  # procs
+            struct.pack_into("<I", si, 104, 1)  # mem_unit = 1 byte
+            self.mem.write(args[0], bytes(si))
+            return 0
         if nr == SYS_times:
             # clock ticks (100/s) of SIM time; per-process CPU split is
             # not modeled — report elapsed in utime, zeros elsewhere

@@ -923,3 +923,26 @@ def test_fifty_real_processes_concurrently():
     for k in ("events", "units_sent", "bytes_sent"):
         assert a[k] == b[k], k
     assert a["bytes_sent"] >= 40 * 100000
+
+
+def test_virtual_cpu_count():
+    """sched_getaffinity reports a DETERMINISTIC virtual 2-CPU machine:
+    guests sizing thread pools by affinity behave identically regardless
+    of the real core count (and stay inside the thread-channel window).
+    (/sys-based cpu_count readers still see the real machine — a
+    documented scope limit.)"""
+    import sys
+
+    cfg_text = SLEEP_CFG.replace(
+        f"path: {BUILD}/sleep_clock",
+        f"path: {sys.executable}\n        args: "
+        f"[\"{ROOT}/native/tests/guest/py_cpus.py\"]")
+    cfg = parse_config(yaml.safe_load(cfg_text), {
+        "general.data_directory": "/tmp/st-vcpus",
+    })
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    name = Path(sys.executable).name
+    out = Path(f"/tmp/st-vcpus/hosts/box/{name}.0.stdout").read_text()
+    assert out.strip().endswith("2"), out  # len(sched_getaffinity(0)) == 2
