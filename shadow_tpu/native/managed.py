@@ -1514,7 +1514,7 @@ class ManagedProcess(ProcessLifecycle):
             # by core count behave identically on every real machine (and
             # stay inside the 31-thread channel window)
             size = min(args[1], 128)
-            if size < 8:
+            if size < 8 or size % 8:  # kernel: multiple of sizeof(long)
                 return -EINVAL
             mask = ((1 << SIM_CPUS) - 1).to_bytes(8, "little")
             self.mem.write(args[2], mask + b"\0" * (size - 8))
