@@ -705,61 +705,62 @@ void pthread_exit(void *retval) {
 
 static int install_seccomp(void) {
   /* BEGIN GENERATED BPF (tools/gen_bpf.py) */
-  struct sock_filter prog[] = {  /* 87 instructions */
+  struct sock_filter prog[] = {  /* 88 instructions */
       LD(BPF_ARCHF),
-      JEQ(AUDIT_ARCH_X86_64, 0, 84),
+      JEQ(AUDIT_ARCH_X86_64, 0, 85),
       LD(BPF_NR),
-      JEQ(0, 55, 0),  /* read */
-      JEQ(1, 59, 0),  /* write */
-      JEQ(3, 73, 0),  /* close */
-      JEQ(19, 52, 0),  /* readv */
-      JEQ(20, 56, 0),  /* writev */
-      JEQ(16, 73, 0),  /* ioctl */
-      JEQ(72, 72, 0),  /* fcntl */
-      JEQ(32, 71, 0),  /* dup */
-      JEQ(33, 70, 0),  /* dup2 */
-      JEQ(292, 69, 0),  /* dup3 */
-      JEQ(5, 68, 0),  /* fstat */
-      JEQ(8, 67, 0),  /* lseek */
-      JEQ(262, 66, 0),  /* newfstatat */
-      JEQ(35, 68, 0),  /* nanosleep */
-      JEQ(230, 67, 0),  /* clock_nanosleep */
-      JEQ(228, 66, 0),  /* clock_gettime */
-      JEQ(96, 65, 0),  /* gettimeofday */
-      JEQ(201, 64, 0),  /* time */
-      JEQ(318, 63, 0),  /* getrandom */
-      JEQ(7, 62, 0),  /* poll */
-      JEQ(271, 61, 0),  /* ppoll */
-      JEQ(213, 60, 0),  /* epoll_create */
-      JEQ(291, 59, 0),  /* epoll_create1 */
-      JEQ(233, 58, 0),  /* epoll_ctl */
-      JEQ(232, 57, 0),  /* epoll_wait */
-      JEQ(281, 56, 0),  /* epoll_pwait */
-      JEQ(288, 55, 0),  /* accept4 */
-      JEQ(435, 54, 0),  /* clone3 */
-      JEQ(39, 53, 0),  /* getpid */
-      JEQ(110, 52, 0),  /* getppid */
-      JEQ(186, 51, 0),  /* gettid */
-      JEQ(283, 50, 0),  /* timerfd_create */
-      JEQ(286, 49, 0),  /* timerfd_settime */
-      JEQ(287, 48, 0),  /* timerfd_gettime */
-      JEQ(284, 47, 0),  /* eventfd */
-      JEQ(290, 46, 0),  /* eventfd2 */
-      JEQ(202, 45, 0),  /* futex */
-      JEQ(14, 44, 0),  /* rt_sigprocmask */
-      JEQ(22, 43, 0),  /* pipe */
-      JEQ(293, 42, 0),  /* pipe2 */
-      JEQ(61, 41, 0),  /* wait4 */
-      JEQ(231, 40, 0),  /* exit_group */
-      JEQ(436, 39, 0),  /* close_range */
-      JEQ(23, 38, 0),  /* select */
-      JEQ(270, 37, 0),  /* pselect6 */
-      JEQ(62, 36, 0),  /* kill */
-      JEQ(63, 35, 0),  /* uname */
-      JEQ(100, 34, 0),  /* times */
-      JEQ(229, 33, 0),  /* clock_getres */
-      JEQ(204, 32, 0),  /* sched_getaffinity */
-      JEQ(99, 31, 0),  /* sysinfo */
+      JEQ(0, 56, 0),  /* read */
+      JEQ(1, 60, 0),  /* write */
+      JEQ(3, 74, 0),  /* close */
+      JEQ(19, 53, 0),  /* readv */
+      JEQ(20, 57, 0),  /* writev */
+      JEQ(16, 74, 0),  /* ioctl */
+      JEQ(72, 73, 0),  /* fcntl */
+      JEQ(32, 72, 0),  /* dup */
+      JEQ(33, 71, 0),  /* dup2 */
+      JEQ(292, 70, 0),  /* dup3 */
+      JEQ(5, 69, 0),  /* fstat */
+      JEQ(8, 68, 0),  /* lseek */
+      JEQ(262, 67, 0),  /* newfstatat */
+      JEQ(35, 69, 0),  /* nanosleep */
+      JEQ(230, 68, 0),  /* clock_nanosleep */
+      JEQ(228, 67, 0),  /* clock_gettime */
+      JEQ(96, 66, 0),  /* gettimeofday */
+      JEQ(201, 65, 0),  /* time */
+      JEQ(318, 64, 0),  /* getrandom */
+      JEQ(7, 63, 0),  /* poll */
+      JEQ(271, 62, 0),  /* ppoll */
+      JEQ(213, 61, 0),  /* epoll_create */
+      JEQ(291, 60, 0),  /* epoll_create1 */
+      JEQ(233, 59, 0),  /* epoll_ctl */
+      JEQ(232, 58, 0),  /* epoll_wait */
+      JEQ(281, 57, 0),  /* epoll_pwait */
+      JEQ(288, 56, 0),  /* accept4 */
+      JEQ(435, 55, 0),  /* clone3 */
+      JEQ(39, 54, 0),  /* getpid */
+      JEQ(110, 53, 0),  /* getppid */
+      JEQ(186, 52, 0),  /* gettid */
+      JEQ(283, 51, 0),  /* timerfd_create */
+      JEQ(286, 50, 0),  /* timerfd_settime */
+      JEQ(287, 49, 0),  /* timerfd_gettime */
+      JEQ(284, 48, 0),  /* eventfd */
+      JEQ(290, 47, 0),  /* eventfd2 */
+      JEQ(202, 46, 0),  /* futex */
+      JEQ(14, 45, 0),  /* rt_sigprocmask */
+      JEQ(22, 44, 0),  /* pipe */
+      JEQ(293, 43, 0),  /* pipe2 */
+      JEQ(61, 42, 0),  /* wait4 */
+      JEQ(231, 41, 0),  /* exit_group */
+      JEQ(436, 40, 0),  /* close_range */
+      JEQ(23, 39, 0),  /* select */
+      JEQ(270, 38, 0),  /* pselect6 */
+      JEQ(62, 37, 0),  /* kill */
+      JEQ(63, 36, 0),  /* uname */
+      JEQ(100, 35, 0),  /* times */
+      JEQ(229, 34, 0),  /* clock_getres */
+      JEQ(204, 33, 0),  /* sched_getaffinity */
+      JEQ(99, 32, 0),  /* sysinfo */
+      JEQ(98, 31, 0),  /* getrusage */
       JEQ(47, 14, 0),  /* recvmsg */
       JEQ(56, 16, 0),  /* clone */
       JEQ(59, 18, 0),  /* execve */

@@ -62,6 +62,7 @@ SYS_socketpair = 53
 SYS_uname = 63
 SYS_times, SYS_clock_getres = 100, 229
 SYS_sched_getaffinity, SYS_sysinfo = 204, 99
+SYS_getrusage = 98
 SIM_CPUS = 2  # virtual cores guests see (machine-independent behavior)
 # default-terminate signals the worker emulates for guest-to-guest kill
 # every Linux default-terminate signal (+ realtime 34..64, all default-
@@ -1528,6 +1529,15 @@ class ManagedProcess(ProcessLifecycle):
             struct.pack_into("<H", si, 80, 1)  # procs
             struct.pack_into("<I", si, 104, 1)  # mem_unit = 1 byte
             self.mem.write(args[0], bytes(si))
+            return 0
+        if nr == SYS_getrusage:
+            # sim-time resource usage: utime = simulated elapsed, the rest
+            # zero (per-process CPU accounting is not modeled)
+            ru = bytearray(144)  # struct rusage
+            ns = emulated(h.now)
+            struct.pack_into("<qq", ru, 0, ns // NS_PER_SEC,
+                             (ns % NS_PER_SEC) // 1000)
+            self.mem.write(args[1], bytes(ru))
             return 0
         if nr == SYS_times:
             # clock ticks (100/s) of SIM time; per-process CPU split is

@@ -27,7 +27,7 @@ SYS = dict(read=0, write=1, close=3, fstat=5, poll=7, lseek=8,
            wait4=61, execve=59, exit_group=231, clone3=435,
            close_range=436, select=23, pselect6=270, kill=62,
            uname=63, times=100, clock_getres=229,
-           sched_getaffinity=204, sysinfo=99)
+           sched_getaffinity=204, sysinfo=99, getrusage=98)
 
 CLONE_THREAD = 0x10000
 CLONE_IO = 0x80000000  # shim's own fork-replay marker: benign, lets the
@@ -42,7 +42,7 @@ UNCONDITIONAL = [
     "timerfd_gettime", "eventfd", "eventfd2", "futex",
     "rt_sigprocmask", "pipe", "pipe2", "wait4", "exit_group",
     "close_range", "select", "pselect6", "kill", "uname", "times",
-    "clock_getres", "sched_getaffinity", "sysinfo",
+    "clock_getres", "sched_getaffinity", "sysinfo", "getrusage",
 ]
 
 #: syscalls trapped only when arg0 is a virtual fd
