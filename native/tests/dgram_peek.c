@@ -1,0 +1,33 @@
+/* dgram_peek — UDP MSG_PEEK test program: peeks a datagram (must not
+ * consume), then reads it for real, then confirms the queue advanced.
+ */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+  if (argc != 3) { fprintf(stderr, "usage: %s ip port\n", argv[0]); return 2; }
+  int fd = socket(AF_INET, SOCK_DGRAM, 0);
+  struct sockaddr_in dst;
+  memset(&dst, 0, sizeof dst);
+  dst.sin_family = AF_INET;
+  dst.sin_port = htons((unsigned short)atoi(argv[2]));
+  inet_pton(AF_INET, argv[1], &dst.sin_addr);
+  sendto(fd, "one", 3, 0, (struct sockaddr *)&dst, sizeof dst);
+  sendto(fd, "two", 3, 0, (struct sockaddr *)&dst, sizeof dst);
+  char a[8] = {0}, b[8] = {0}, c[8] = {0};
+  long r1 = recv(fd, a, sizeof a, MSG_PEEK); /* echo of "one" */
+  long r2 = recv(fd, b, sizeof b, 0);
+  long r3 = recv(fd, c, sizeof c, 0);
+  if (r1 != 3 || r2 != 3 || r3 != 3 ||
+      memcmp(a, "one", 3) || memcmp(b, "one", 3) || memcmp(c, "two", 3)) {
+    fprintf(stderr, "peek: %ld/%s %ld/%s %ld/%s\n", r1, a, r2, b, r3, c);
+    return 1;
+  }
+  printf("dgram-peek-ok\n");
+  return 0;
+}

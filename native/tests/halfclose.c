@@ -1,0 +1,43 @@
+/* halfclose — socketpair shutdown(SHUT_WR) test program: the parent
+ * writes a request, half-closes its write side, and reads the reply
+ * stream to EOF; the child reads to EOF (the parent's half-close),
+ * replies, and exits. The classic request/response-over-one-connection
+ * idiom.
+ */
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+int main(void) {
+  int sv[2];
+  if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) { perror("sp"); return 1; }
+  pid_t c = fork();
+  if (c == 0) {
+    close(sv[0]);
+    char buf[256];
+    long total = 0, r;
+    while ((r = read(sv[1], buf + total, sizeof buf - total)) > 0)
+      total += r;  /* to EOF: parent's SHUT_WR */
+    if (total != 11 || memcmp(buf, "request-abc", 11)) _exit(9);
+    if (write(sv[1], "reply-xyz", 9) != 9) _exit(8);
+    close(sv[1]);
+    _exit(0);
+  }
+  close(sv[1]);
+  if (send(sv[0], "request-abc", 11, 0) != 11) { perror("send"); return 1; }
+  if (shutdown(sv[0], SHUT_WR) != 0) { perror("shutdown"); return 1; }
+  char buf[256];
+  long total = 0, r;
+  while ((r = read(sv[0], buf + total, sizeof buf - total)) > 0)
+    total += r;
+  int status;
+  waitpid(c, &status, 0);
+  if (total != 9 || memcmp(buf, "reply-xyz", 9)) {
+    fprintf(stderr, "bad reply %ld\n", total);
+    return 1;
+  }
+  printf("halfclose-ok\n");
+  return 0;
+}

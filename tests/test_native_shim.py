@@ -946,3 +946,50 @@ def test_virtual_cpu_count():
     name = Path(sys.executable).name
     out = Path(f"/tmp/st-vcpus/hosts/box/{name}.0.stdout").read_text()
     assert out.strip().split()[-1] == "2", out  # len(sched_getaffinity(0))
+
+
+def test_halfclose_native_oracle():
+    r = subprocess.run([str(BUILD / "halfclose")], capture_output=True,
+                       text=True, timeout=30)
+    assert r.returncode == 0, r.stderr
+    assert "halfclose-ok" in r.stdout
+
+
+def test_halfclose_managed():
+    """shutdown(SHUT_WR) on a socketpair delivers EOF to the peer while
+    the reply direction stays open — the request/response-over-one-
+    connection idiom across fork."""
+    cfg_text = SLEEP_CFG.replace("sleep_clock", "halfclose")
+    cfg = parse_config(yaml.safe_load(cfg_text), {
+        "general.data_directory": "/tmp/st-halfclose",
+    })
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    out = Path("/tmp/st-halfclose/hosts/box/halfclose.0.stdout").read_text()
+    assert "halfclose-ok" in out, out
+
+
+def test_dgram_peek_managed():
+    """MSG_PEEK on UDP inspects without dequeuing: peek sees the first
+    datagram, the real reads then get both in order (via the echo
+    server's replies over the simulated network)."""
+    cfg_text = SRV_MANAGED_CFG.replace(
+        'path: pyapp:shadow_tpu.models.tgen:TGenClient',
+        f'path: {BUILD}/dgram_peek',
+    ).replace('args: ["200 kB", "2", serial, "8080", server]',
+              'args: ["11.0.0.1", "9090"]'
+    ).replace(f'path: {BUILD}/tgen_srv',
+              'path: pyapp:shadow_tpu.models.echo:EchoServer'
+    ).replace('args: ["8080", "2"]', 'args: ["9090"]'
+    ).replace('expected_final_state: {exited: 0}\n  client',
+              'expected_final_state: running\n  client')
+    cfg = parse_config(yaml.safe_load(cfg_text), {
+        "general.data_directory": "/tmp/st-dgram-peek",
+    })
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    out = Path("/tmp/st-dgram-peek/hosts/client/dgram_peek.0.stdout"
+               ).read_text()
+    assert "dgram-peek-ok" in out, out
