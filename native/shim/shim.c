@@ -450,9 +450,22 @@ static int64_t emulated_now_ns(void) {
   return forward(SYS_clock_gettime, (uint64_t)-1, 0, 0, 0, 0, 0);
 }
 
+/* The simulation boots at 2000-01-01T00:00:00Z (shadow_tpu/core/time.py
+ * EMULATED_EPOCH); monotonic-family clocks originate at boot == sim start,
+ * consistent with sysinfo's sim-second uptime and Linux's near-zero
+ * monotonic origin. */
+#define SHIM_EMULATED_EPOCH_NS 946684800000000000LL
+
+static int clk_is_monotonic(clockid_t clk) {
+  return clk == CLOCK_MONOTONIC || clk == CLOCK_MONOTONIC_RAW ||
+         clk == CLOCK_MONOTONIC_COARSE || clk == CLOCK_BOOTTIME ||
+         clk == CLOCK_PROCESS_CPUTIME_ID || clk == CLOCK_THREAD_CPUTIME_ID;
+}
+
 int clock_gettime(clockid_t clk, struct timespec *ts) {
   if (!shim_active) return (int)raw3(SYS_clock_gettime, clk, (long)ts, 0);
   int64_t ns = emulated_now_ns();
+  if (clk_is_monotonic(clk)) ns -= SHIM_EMULATED_EPOCH_NS;
   ts->tv_sec = ns / 1000000000;
   ts->tv_nsec = ns % 1000000000;
   return 0;

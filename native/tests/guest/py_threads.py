@@ -9,13 +9,13 @@ def worker(i):
     with lock:
         results.append((i, time.monotonic()))
 
-t0 = time.monotonic()
+t0 = time.monotonic_ns()
 threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
 for t in threads:
     t.start()
 for t in threads:
     t.join()
-elapsed_ms = int((time.monotonic() - t0) * 1000)
+elapsed_ms = (time.monotonic_ns() - t0) // 1_000_000
 order = [i for i, _ in sorted(results, key=lambda x: x[1])]
 print(f"order={order} n={len(results)} elapsed_ms={elapsed_ms}")
 print("ok")

@@ -1,0 +1,88 @@
+/* Connected-UDP semantics (ADVICE r2: connect(2) on SOCK_DGRAM must be
+ * instant and record a default peer) + recvmsg(MSG_PEEK) on datagrams +
+ * monotonic-clock origin sanity. Self-contained dual-run test: socket A
+ * is a manual echo responder, socket B is the connected client.
+ * argv[1] = the address A is reachable at (127.0.0.1 natively, the host's
+ * simulated IP under the simulator). */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#define PORT 9001
+
+static int fail(const char *what) {
+  fprintf(stderr, "FAIL: %s\n", what);
+  return 1;
+}
+
+int main(int argc, char **argv) {
+  const char *ip = argc > 1 ? argv[1] : "127.0.0.1";
+
+  /* connect(2) on a dgram socket must complete instantly: no handshake
+   * traffic exists for UDP, so a wall/sim-time stall here is a bug. */
+  struct timespec c0, c1;
+  clock_gettime(CLOCK_MONOTONIC, &c0);
+
+  int a = socket(AF_INET, SOCK_DGRAM, 0);
+  int b = socket(AF_INET, SOCK_DGRAM, 0);
+  if (a < 0 || b < 0) return fail("socket");
+  struct sockaddr_in sa;
+  memset(&sa, 0, sizeof sa);
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(PORT);
+  sa.sin_addr.s_addr = INADDR_ANY;
+  if (bind(a, (struct sockaddr *)&sa, sizeof sa) != 0) return fail("bind");
+  sa.sin_addr.s_addr = inet_addr(ip);
+  if (connect(b, (struct sockaddr *)&sa, sizeof sa) != 0)
+    return fail("connect");
+
+  clock_gettime(CLOCK_MONOTONIC, &c1);
+  long conn_ms = (c1.tv_sec - c0.tv_sec) * 1000 +
+                 (c1.tv_nsec - c0.tv_nsec) / 1000000;
+  if (conn_ms > 1000) return fail("dgram connect stalled");
+  /* monotonic origin is boot-ish, not the UNIX epoch (< ~10 years) */
+  if (c1.tv_sec > 3650L * 86400) return fail("monotonic epoch-based");
+
+  /* send() and write() both use the connected peer */
+  if (send(b, "ping1", 5, 0) != 5) return fail("send");
+  if (write(b, "ping2", 5) != 5) return fail("write");
+
+  /* A answers each ping to its source */
+  char buf[64];
+  struct sockaddr_in src;
+  for (int i = 0; i < 2; i++) {
+    socklen_t slen = sizeof src;
+    ssize_t n = recvfrom(a, buf, sizeof buf, 0,
+                         (struct sockaddr *)&src, &slen);
+    if (n != 5 || memcmp(buf, "ping", 4) != 0) return fail("recvfrom A");
+    char pong[6] = "pongX";
+    pong[4] = buf[4];
+    if (sendto(a, pong, 5, 0, (struct sockaddr *)&src, slen) != 5)
+      return fail("sendto A");
+  }
+
+  /* recvmsg(MSG_PEEK) must copy without consuming */
+  struct iovec iov = {buf, sizeof buf};
+  struct msghdr mh;
+  memset(&mh, 0, sizeof mh);
+  mh.msg_iov = &iov;
+  mh.msg_iovlen = 1;
+  if (recvmsg(b, &mh, MSG_PEEK) != 5 || memcmp(buf, "pong1", 5) != 0)
+    return fail("recvmsg peek");
+  memset(buf, 0, sizeof buf);
+  if (recvmsg(b, &mh, 0) != 5 || memcmp(buf, "pong1", 5) != 0)
+    return fail("recvmsg consume");
+  /* read(2) works on a connected dgram socket and sees the NEXT datagram */
+  memset(buf, 0, sizeof buf);
+  if (read(b, buf, sizeof buf) != 5 || memcmp(buf, "pong2", 5) != 0)
+    return fail("read next dgram");
+
+  close(a);
+  close(b);
+  printf("udp-conn-ok\n");
+  return 0;
+}

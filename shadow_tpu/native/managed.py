@@ -98,6 +98,9 @@ SYS_getpid, SYS_getppid, SYS_gettid = 39, 110, 186
 SYS_timerfd_create, SYS_timerfd_settime, SYS_timerfd_gettime = 283, 286, 287
 SYS_eventfd, SYS_eventfd2 = 284, 290
 TFD_TIMER_ABSTIME = 1
+#: clock ids whose origin is boot == sim start (monotonic + cputime
+#: families); the realtime family stays epoch-based (core/time.EMULATED_EPOCH)
+MONO_CLOCKS = (1, 2, 3, 4, 6, 7)
 
 POLLIN, POLLOUT, POLLERR, POLLHUP = 0x001, 0x004, 0x008, 0x010
 EPOLL_CTL_ADD, EPOLL_CTL_DEL, EPOLL_CTL_MOD = 1, 2, 3
@@ -174,9 +177,10 @@ class VSocket:
 
     __slots__ = ("vfd", "kind", "endpoint", "rxbuf", "peer_closed",
                  "connected", "connect_err", "bound_port", "listening",
-                 "accept_q", "nonblock", "dgram_q", "udp", "interest",
+                 "accept_q", "nonblock", "dgram_q", "udp", "dgram_peer",
+                 "interest",
                  "expirations", "interval_ns", "deadline", "timer_handle",
-                 "evt_counter", "refs", "pipe", "pipe_out")
+                 "evt_counter", "refs", "pipe", "pipe_out", "timer_clock")
 
     def __init__(self, vfd: int, kind: str = "stream") -> None:
         self.vfd = vfd
@@ -192,6 +196,7 @@ class VSocket:
         self.nonblock = False
         self.dgram_q: list = []  # (payload bytes|b"", nbytes, src, sport)
         self.udp = None  # DatagramSocket when bound
+        self.dgram_peer = None  # connected-UDP default peer: (host_id, port)
         self.interest: dict = {}  # epoll: vfd -> (events, userdata)
         # timerfd state
         self.expirations = 0
@@ -200,6 +205,7 @@ class VSocket:
         self.timer_handle = None
         # eventfd state
         self.evt_counter = 0
+        self.timer_clock = 0  # timerfd: clockid the deadlines are based on
         # fork support: open-file-description refcount (a forked child's fd
         # table shares VSocket objects; the backing object closes when the
         # LAST table entry referencing it closes, like the kernel's)
@@ -1036,10 +1042,13 @@ class ManagedProcess(ProcessLifecycle):
             if args[3]:  # timeout pointer
                 sec, nsec = struct.unpack("<qq", self.mem.read(args[3], 16))
                 t = sec * NS_PER_SEC + nsec
-                # WAIT: relative. WAIT_BITSET: absolute (either clock maps
-                # to the one emulated timeline; see SYS_clock_gettime)
+                # WAIT: relative. WAIT_BITSET: absolute against
+                # CLOCK_MONOTONIC (origin = sim start) unless
+                # FUTEX_CLOCK_REALTIME selects the epoch clock
                 if op == FUTEX_WAIT_BITSET or abs_realtime:
-                    delay = max(0, t - emulated(self.host.now))
+                    base = (emulated(self.host.now) if abs_realtime
+                            else self.host.now)
+                    delay = max(0, t - base)
                 else:
                     delay = max(0, t)
 
@@ -1238,8 +1247,12 @@ class ManagedProcess(ProcessLifecycle):
         if nr == SYS_clock_gettime:
             if args[0] == 2**64 - 1:  # shim slow-path sentinel: raw ns
                 return emulated(h.now)
+            # monotonic/cputime-family clock ids originate at boot == sim
+            # start; realtime family stays epoch-based — matching the
+            # shim's libc interposition and sysinfo's uptime
+            ns = h.now if args[0] in MONO_CLOCKS else emulated(h.now)
             self.mem.write(args[1], struct.pack(
-                "<qq", emulated(h.now) // NS_PER_SEC, emulated(h.now) % NS_PER_SEC))
+                "<qq", ns // NS_PER_SEC, ns % NS_PER_SEC))
             return 0
         if nr == SYS_gettimeofday:
             if args[0]:
@@ -1257,7 +1270,10 @@ class ManagedProcess(ProcessLifecycle):
             sec, nsec = struct.unpack("<qq", self.mem.read(ts_addr, 16))
             dur = sec * NS_PER_SEC + nsec
             if nr == SYS_clock_nanosleep and args[1] & TIMER_ABSTIME:
-                dur = max(0, sec * NS_PER_SEC + nsec - emulated(h.now))
+                # absolute deadline in the REQUESTED clock's base:
+                # monotonic family originates at sim start
+                base = h.now if args[0] in MONO_CLOCKS else emulated(h.now)
+                dur = max(0, sec * NS_PER_SEC + nsec - base)
             self._waiting = ("sleep",)
             th = self._cur
             h.schedule_in(max(dur, 0), lambda: self._resume(th, 0))
@@ -1420,7 +1436,9 @@ class ManagedProcess(ProcessLifecycle):
         if nr == SYS_timerfd_create:
             vfd = self._next_vfd
             self._next_vfd += 1
-            self.fds[vfd] = VSocket(vfd, "timer")
+            vs = VSocket(vfd, "timer")
+            vs.timer_clock = args[0] & 0xFFFFFFFF  # clockid: deadline base
+            self.fds[vfd] = vs
             if args[1] & 0o2000000:  # TFD_CLOEXEC
                 self.fd_cloexec.add(vfd)
             return vfd
@@ -1430,7 +1448,9 @@ class ManagedProcess(ProcessLifecycle):
             vs = self.fds.get(args[0])
             if vs is None or vs.kind != "timer":
                 return -EBADF
-            left = max(vs.deadline - emulated(h.now), 0) if vs.timer_handle else 0
+            tnow = (h.now if vs.timer_clock in MONO_CLOCKS
+                    else emulated(h.now))
+            left = max(vs.deadline - tnow, 0) if vs.timer_handle else 0
             self.mem.write(args[1], struct.pack(
                 "<qqqq", vs.interval_ns // NS_PER_SEC,
                 vs.interval_ns % NS_PER_SEC,
@@ -1727,6 +1747,10 @@ class ManagedProcess(ProcessLifecycle):
         ep.on_close = lambda now: self._on_net_close(vs)
         ep.on_error = lambda msg: self._on_net_error(vs)
         ep.on_drain = lambda room: self._on_drain(vs)
+        # flow control sees the guest's unread backlog: a guest that never
+        # reads closes the advertised window instead of growing rxbuf
+        # without bound (transport.StreamReceiver.window)
+        ep.receiver.app_unread = lambda: len(vs.rxbuf)
 
     def _on_drain(self, vs: VSocket) -> None:
         th, w = self._find_waiter((("send", "smsg"), vs))
@@ -1817,6 +1841,29 @@ class ManagedProcess(ProcessLifecycle):
             return -106 if vs.connected else -114  # EISCONN / EALREADY
         raw = self.mem.read(addr, min(max(addrlen, 16), 128))
         family = struct.unpack_from("<H", raw, 0)[0]
+        if vs.kind == "dgram":
+            # connected UDP (DNS/stub-resolver idiom): record the default
+            # peer, filter inbound to it, and return instantly — Linux
+            # performs no handshake for SOCK_DGRAM connect(2)
+            if family == socket.AF_UNSPEC:  # dissolve the association
+                vs.dgram_peer = None
+                vs.connected = False
+                return 0
+            if family != socket.AF_INET:
+                return -EAFNOSUPPORT
+            port = struct.unpack_from(">H", raw, 2)[0]
+            ip = socket.inet_ntoa(raw[4:8])
+            try:
+                peer = self.host.controller.resolve(ip)
+            except KeyError:
+                return -ENETUNREACH
+            if vs.udp is None:
+                r = self._dgram_bind(vs)  # connect auto-binds, like the kernel
+                if r != 0:
+                    return r
+            vs.dgram_peer = (peer, port)
+            vs.connected = True
+            return 0
         if family != socket.AF_INET:
             return -EAFNOSUPPORT
         port = struct.unpack_from(">H", raw, 2)[0]
@@ -1853,8 +1900,10 @@ class ManagedProcess(ProcessLifecycle):
                 break
             if w[0] == "recv":
                 self._fulfill_recv(th, vs, w[2], w[3], w[4])
-            else:
-                self._resume(th, self._scatter_rx(vs, w[2]))
+            else:  # rmsg: a parked MSG_PEEK must not consume on wakeup
+                peek = len(w) > 3 and w[3]
+                self._resume(th, self._scatter_rx(vs, w[2],
+                                                  consume=not peek))
         self._notify()
 
     def _on_net_close(self, vs: VSocket) -> None:
@@ -1894,6 +1943,8 @@ class ManagedProcess(ProcessLifecycle):
             return -EBADF
         if vs.kind == "spair":
             return self._pipe_write(vs, self.mem.read(addr, min(n, 1 << 20)))
+        if vs.kind == "dgram":  # send/write(2) on a connected-UDP socket
+            return self._dgram_sendto(vs, (fd, addr, n, 0, 0, 0))
         if vs.endpoint is None or not vs.connected:
             return -ENOTCONN
         if vs.peer_closed:
@@ -1915,6 +1966,9 @@ class ManagedProcess(ProcessLifecycle):
             return -EBADF
         if vs.kind == "spair":
             return self._pipe_read(vs, [(bufaddr, buflen)], peek=peek)
+        if vs.kind == "dgram":  # recv/read(2) on a (connected-)UDP socket
+            return self._dgram_recvfrom(vs, (fd, bufaddr, buflen, 0, 0, 0),
+                                        peek=peek)
         if vs.endpoint is None:
             return -ENOTCONN
         if vs.rxbuf:
@@ -1938,7 +1992,14 @@ class ManagedProcess(ProcessLifecycle):
         self.mem.write(bufaddr, bytes(vs.rxbuf[:k]))
         if consume:
             del vs.rxbuf[:k]
+            self._rx_consumed(vs)
         return k
+
+    def _rx_consumed(self, vs: VSocket) -> None:
+        """The guest read from rxbuf: let the receiver send a window-update
+        ack if the sender was throttled by our advertised window."""
+        if vs.endpoint is not None:
+            vs.endpoint.receiver.on_app_read()
 
     # -- select -------------------------------------------------------------
     def _select(self, args, is_pselect: bool):
@@ -2118,11 +2179,14 @@ class ManagedProcess(ProcessLifecycle):
         self._waiting = ("smsg", vs, data)
         return _BLOCK
 
-    def _scatter_rx(self, vs: VSocket, iovs) -> int:
-        """Move bytes from vs.rxbuf into the guest's iovecs."""
+    def _scatter_rx(self, vs: VSocket, iovs, consume: bool = True) -> int:
+        """Move bytes from vs.rxbuf into the guest's iovecs (MSG_PEEK:
+        copy without consuming)."""
         k = min(len(vs.rxbuf), sum(ln for _, ln in iovs))
         self._scatter(iovs, bytes(vs.rxbuf[:k]))
-        del vs.rxbuf[:k]
+        if consume:
+            del vs.rxbuf[:k]
+            self._rx_consumed(vs)
         return k
 
     def _sendmsg(self, fd: int, msg_ptr: int):
@@ -2133,9 +2197,7 @@ class ManagedProcess(ProcessLifecycle):
         data = b"".join(self.mem.read(b, min(ln, 1 << 20))
                         for b, ln in iovs if ln)
         if vs.kind == "dgram":
-            if not name:
-                return -89  # EDESTADDRREQ: connected-dgram sendmsg unsupported
-            # reuse the sendto path with a staged buffer
+            # NULL name falls back to the connected-UDP default peer
             return self._dgram_sendto(vs, (fd, 0, len(data), 0, name, namelen),
                                       staged=data)
         if vs.kind == "spair":
@@ -2153,24 +2215,28 @@ class ManagedProcess(ProcessLifecycle):
             if not vs.dgram_q:
                 if vs.nonblock:
                     return -EAGAIN
-                self._waiting = ("dmsg", vs, iovs, (msg_ptr, name, namelen))
+                self._waiting = ("dmsg", vs, iovs, (msg_ptr, name, namelen),
+                                 peek)
                 return _BLOCK
-            return self._recvmsg_take(vs, iovs, (msg_ptr, name, namelen))
+            return self._recvmsg_take(vs, iovs, (msg_ptr, name, namelen),
+                                      consume=not peek)
         if vs.rxbuf:
-            if peek:
-                k = min(len(vs.rxbuf), sum(ln for _, ln in iovs))
-                self._scatter(iovs, bytes(vs.rxbuf[:k]))
-                return k
-            return self._scatter_rx(vs, iovs)
+            return self._scatter_rx(vs, iovs, consume=not peek)
         if vs.peer_closed:
             return 0
         if vs.nonblock:
             return -EAGAIN
-        self._waiting = ("rmsg", vs, iovs)
+        self._waiting = ("rmsg", vs, iovs, peek)
         return _BLOCK
 
-    def _recvmsg_take(self, vs: VSocket, iovs, where) -> int:
-        payload, nbytes, src, sport = vs.dgram_q.pop(0)
+    def _recvmsg_take(self, vs: VSocket, iovs, where,
+                      consume: bool = True) -> int:
+        # MSG_PEEK (consume=False) copies the head datagram without
+        # dequeuing it, matching the recvfrom path (_dgram_take)
+        if consume:
+            payload, nbytes, src, sport = vs.dgram_q.pop(0)
+        else:
+            payload, nbytes, src, sport = vs.dgram_q[0]
         data = payload if payload is not None else b"\0" * nbytes
         msg_ptr, name_ptr, namelen = where if where else (0, 0, 0)
         if name_ptr and namelen:
@@ -2265,8 +2331,12 @@ class ManagedProcess(ProcessLifecycle):
             return -EBADF
         isec, insec, vsec, vnsec = struct.unpack(
             "<qqqq", self.mem.read(new_ptr, 32))
+        # deadlines live in the timerfd's OWN clock base (timerfd_create
+        # clockid): monotonic family counts from sim start
+        now = (self.host.now if vs.timer_clock in MONO_CLOCKS
+               else emulated(self.host.now))
         if old_ptr:
-            left = max(vs.deadline - emulated(self.host.now), 0) if vs.timer_handle else 0
+            left = max(vs.deadline - now, 0) if vs.timer_handle else 0
             self.mem.write(old_ptr, struct.pack(
                 "<qqqq", vs.interval_ns // NS_PER_SEC,
                 vs.interval_ns % NS_PER_SEC,
@@ -2279,11 +2349,11 @@ class ManagedProcess(ProcessLifecycle):
         if first == 0:
             return 0  # disarm
         if flags & TFD_TIMER_ABSTIME:
-            delay = max(first - emulated(self.host.now), 0)
+            delay = max(first - now, 0)
             vs.deadline = first
         else:
             delay = first
-            vs.deadline = emulated(self.host.now) + first
+            vs.deadline = now + first
         vs.timer_handle = self.host.schedule_in(delay, lambda: self._timer_fire(vs))
         return 0
 
@@ -2313,6 +2383,8 @@ class ManagedProcess(ProcessLifecycle):
         vs.bound_port = sock.local_port
 
         def on_datagram(nbytes, payload, src_addr, now):
+            if vs.dgram_peer is not None and src_addr != vs.dgram_peer:
+                return  # connected UDP filters inbound to the peer
             vs.dgram_q.append((payload, nbytes, src_addr[0], src_addr[1]))
             # wake every satisfiable waiter: a fulfilled MSG_PEEK leaves
             # the datagram queued for the next reader
@@ -2326,24 +2398,34 @@ class ManagedProcess(ProcessLifecycle):
                                              consume=not (len(w) > 6
                                                           and w[6])))
                 else:
-                    self._resume(th, self._recvmsg_take(vs, w[2], w[3]))
+                    self._resume(th, self._recvmsg_take(
+                        vs, w[2], w[3],
+                        consume=not (len(w) > 4 and w[4])))
             self._notify()
 
         sock.on_datagram = on_datagram
         return 0
 
     def _dgram_sendto(self, vs: VSocket, args, staged: bytes = None):
+        if not args[4] and vs.dgram_peer is None:
+            # NULL addr needs a connected socket; error BEFORE the
+            # auto-bind so the failed send leaves the socket unbound,
+            # like the kernel
+            return -89  # EDESTADDRREQ
         if vs.udp is None:
             r = self._dgram_bind(vs)  # auto-bind an ephemeral port
             if r != 0:
                 return r
-        raw = self.mem.read(args[4], min(max(args[5], 16), 128))
-        port = struct.unpack_from(">H", raw, 2)[0]
-        ip = socket.inet_ntoa(raw[4:8])
-        try:
-            peer = self.host.controller.resolve(ip)
-        except KeyError:
-            return -ENETUNREACH
+        if not args[4]:
+            peer, port = vs.dgram_peer
+        else:
+            raw = self.mem.read(args[4], min(max(args[5], 16), 128))
+            port = struct.unpack_from(">H", raw, 2)[0]
+            ip = socket.inet_ntoa(raw[4:8])
+            try:
+                peer = self.host.controller.resolve(ip)
+            except KeyError:
+                return -ENETUNREACH
         if staged is not None:
             data = staged
         else:

@@ -102,8 +102,9 @@ class NetParams:
             raise ValueError("host count exceeds 2**18 (uid packing bound)")
         if (rate_up > MAX_RATE).any() or (rate_down > MAX_RATE).any():
             raise ValueError(
-                f"host bandwidth exceeds {MAX_RATE} B/s (~72 Gbit/s), the "
-                "integer-exact ceiling of the closed-form bucket math"
+                f"host bandwidth exceeds {MAX_RATE} B/s "
+                f"(= {MAX_RATE * 8 / 1e9:.0f} Gbit/s), the integer-exact "
+                "ceiling of the closed-form bucket math"
             )
         # capacity floor: at least one full unit (+ header) must fit, or a
         # max-size unit could never clear the bucket

@@ -163,7 +163,11 @@ class StreamSender:
         self.rto_timer = None
         if self.inflight == 0 or self.ep.state in (CLOSED, TIME_WAIT):
             return
-        self.retries += 1
+        if self.adv_wnd > 0:
+            # zero-window retransmits are persist probes, not losses: TCP
+            # probes a closed peer window indefinitely instead of counting
+            # toward the retry limit (the backoff below still applies)
+            self.retries += 1
         if self.retries > DATA_RETRIES:
             self.ep._reset("data retransmission retries exhausted")
             return
@@ -210,9 +214,17 @@ class StreamReceiver:
         self.ooo: dict[int, tuple[int, Optional[bytes]]] = {}  # seq -> (n, p)
         self.ooo_bytes = 0
         self.bytes_received = 0
+        #: optional delegate reporting delivered-but-unread application
+        #: bytes (the managed-process bridge wires this to the guest's
+        #: rxbuf); plugin apps consume synchronously, so it stays None
+        self.app_unread: Optional[Callable[[], int]] = None
+        #: the window the peer last heard (via flush_ack / handshake);
+        #: drives read-triggered window-update acks
+        self.last_wnd = recv_buffer
 
     def window(self) -> int:
-        return max(self.recv_buffer - self.ooo_bytes, 0)
+        unread = self.app_unread() if self.app_unread is not None else 0
+        return max(self.recv_buffer - self.ooo_bytes - unread, 0)
 
     def on_data(self, unit: Unit, now: SimTime) -> None:
         seq, n = unit.seq, unit.nbytes
@@ -225,12 +237,28 @@ class StreamReceiver:
                 self.ooo_bytes += n
             self._ack()  # "duplicate ack": rcv_nxt unchanged
             return
+        if n > self.window():
+            # beyond-window in-order data (a sender probing a closed
+            # window): refuse it like TCP drops out-of-window segments —
+            # rcv_nxt stays, the duplicate ack re-advertises the window,
+            # and the sender's RTO retries until the app reads
+            self._ack()
+            return
         self._deliver(n, unit.payload, now)
         while self.rcv_nxt in self.ooo:
             n2, p2 = self.ooo.pop(self.rcv_nxt)
             self.ooo_bytes -= n2
             self._deliver(n2, p2, now)
         self._ack()
+
+    def on_app_read(self) -> None:
+        """The app consumed buffered bytes: if the peer last saw a
+        materially closed window, queue a window-update ack (flushed,
+        coalesced, at the round barrier)."""
+        if (self.last_wnd < (self.recv_buffer >> 2)
+                and self.window() > self.last_wnd
+                and self.ep.state not in (CLOSED, TIME_WAIT)):
+            self._ack()
 
     def _deliver(self, nbytes: int, payload, now: SimTime) -> None:
         self.rcv_nxt += nbytes
@@ -247,7 +275,8 @@ class StreamReceiver:
         self.ep.host._ack_eps[self.ep] = None
 
     def flush_ack(self) -> None:
-        self.ep.emit(U.ACK, acked=self.rcv_nxt, wnd=self.window())
+        self.last_wnd = self.window()
+        self.ep.emit(U.ACK, acked=self.rcv_nxt, wnd=self.last_wnd)
 
 
 # endpoint states

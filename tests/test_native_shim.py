@@ -993,3 +993,28 @@ def test_dgram_peek_managed():
     out = Path("/tmp/st-dgram-peek/hosts/client/dgram_peek.0.stdout"
                ).read_text()
     assert "dgram-peek-ok" in out, out
+
+
+def test_udp_conn_native_oracle():
+    r = subprocess.run([str(BUILD / "udp_conn")], capture_output=True,
+                       text=True, timeout=30)
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "udp-conn-ok" in r.stdout
+
+
+def test_udp_conn_managed():
+    """connect(2) on SOCK_DGRAM is instant connected-UDP (default peer for
+    send/write, inbound filtered), recvmsg(MSG_PEEK) copies the head
+    datagram without dequeuing, and CLOCK_MONOTONIC originates at boot —
+    same binary, same assertions as the native oracle run."""
+    cfg_text = SLEEP_CFG.replace("sleep_clock", "udp_conn").replace(
+        "expected_final_state: {exited: 0}",
+        "args: [\"11.0.0.1\"]\n        expected_final_state: {exited: 0}")
+    cfg = parse_config(yaml.safe_load(cfg_text), {
+        "general.data_directory": "/tmp/st-udp-conn",
+    })
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    out = Path("/tmp/st-udp-conn/hosts/box/udp_conn.0.stdout").read_text()
+    assert "udp-conn-ok" in out, out
