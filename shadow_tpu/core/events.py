@@ -76,6 +76,12 @@ class EventQueue:
         self._drop_cancelled_head()
         return self._heap[0][0] if self._heap else T_NEVER
 
+    def head(self):
+        """The earliest pending entry (time, band, key, seq, task), or
+        None — the columnar plane's inbox-merge peek."""
+        self._drop_cancelled_head()
+        return self._heap[0] if self._heap else None
+
     def pop_until(self, end: SimTime) -> Optional[tuple[SimTime, Callable[[], None]]]:
         """Pop the earliest event with time < end, else None."""
         self._drop_cancelled_head()

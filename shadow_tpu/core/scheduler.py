@@ -42,7 +42,7 @@ def _run_hosts(hosts, round_end: SimTime) -> int:
     n = 0
     for h in hosts:
         heap = h.equeue._heap
-        if heap and heap[0][0] < round_end:
+        if (heap and heap[0][0] < round_end) or h._inbox is not None:
             n += h.run_events(round_end)
     return n
 

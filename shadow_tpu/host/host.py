@@ -45,12 +45,20 @@ class Host:
         self._now: SimTime = 0
         self._uid_counter = 0
         self.egress: list[Unit] = []  # units emitted this round (FIFO)
+        #: columnar-plane state (set when the engine is a ColumnarPlane):
+        #: egress rows are plain tuples; resolved arrival rows for the
+        #: current round land in _inbox (see network/colplane.py)
+        self.colplane = None
+        self.egress_rows: list[tuple] = []
+        self._inbox = None
+        self.ingress_deferred_rows: list[tuple] = []
         # hot-path counters kept as plain ints (Counter.__getitem__ per
         # unit measurably drags at 1M+ units); folded in fold_counters()
         self._n_emitted = 0
         self._n_delivered = 0
         self._n_dgrams = 0
         self._n_dgrams_recv = 0
+        self._n_events = 0
         self.ingress_deferred: list[Unit] = []  # ingress-bucket backlog
         self.processes: list = []
         # sockets
@@ -89,18 +97,141 @@ class Host:
             self.counters.add("dgrams_sent", self._n_dgrams)
         if self._n_dgrams_recv:
             self.counters.add("dgrams_received", self._n_dgrams_recv)
+        if self._n_events:
+            self.counters.add("events", self._n_events)
         self._n_emitted = self._n_delivered = self._n_dgrams = 0
         self._n_dgrams_recv = 0
+        self._n_events = 0
 
     def run_events(self, end: SimTime) -> int:
-        """Execute all pending events with time < end (one round's worth)."""
+        """Execute all pending events with time < end (one round's worth).
+        Under the columnar plane, resolved network rows (net_rows) merge
+        with the heap in canonical (time, band, key) order — identical
+        execution order to the per-unit plane's heap-only flow."""
         n = 0
-        while (ev := self.equeue.pop_until(end)) is not None:
-            self._now, task = ev
-            task()
+        rows = self._inbox
+        if rows is None:
+            while (ev := self.equeue.pop_until(end)) is not None:
+                self._now, task = ev
+                task()
+                n += 1
+            self._n_events += n
+            return n
+        self._inbox = None
+        eq = self.equeue
+        heap = eq._heap
+        dispatch = self.dispatch_row
+        pos = 0
+        ln = len(rows)
+        # fast path: no heap events at all (common for workload hosts with
+        # no pending timers) — straight row drain, re-checking only the
+        # cheap emptiness bit in case a dispatch scheduled something
+        while pos < ln and not heap:
+            dispatch(rows[pos])
+            pos += 1
             n += 1
-        self.counters.add("events", n)
+        if heap:
+            head = eq.head
+            pop = eq.pop_until
+            while True:
+                h0 = head()
+                hv = h0 is not None and h0[0] < end
+                if pos < ln:
+                    row = rows[pos]
+                    ti = row[0]
+                    # inbox rows are BAND_NET (0): they win same-time ties
+                    # unless a heap net event carries a smaller key
+                    if (not hv or ti < h0[0]
+                            or (ti == h0[0]
+                                and (0, row[1]) < (h0[1], h0[2]))):
+                        dispatch(row)
+                        pos += 1
+                        n += 1
+                        continue
+                if hv:
+                    self._now, task = pop(end)
+                    task()
+                    n += 1
+                    continue
+                break
+        self._n_events += n
         return n
+
+    def dispatch_row(self, row) -> None:
+        """Columnar-plane arrival dispatch: the field-level twin of the
+        per-unit plane's arrival event (engine.ingress_arrival + deliver;
+        loss rows stand in for the scheduled on_loss closures). Charges
+        the ingress token bucket at event time, in event order — exactly
+        like the per-unit plane — parking the whole row into the deferred
+        backlog when tokens run short."""
+        (t, _key, _tgt, kind, peer, aport, bport, nbytes, seq, frag,
+         nfrags, size, payload) = row
+        if t > self._now:
+            self._now = t
+        if kind == U.KIND_LOSS:
+            ep = self._conns.get((aport, peer, bport))
+            if ep is not None:
+                ep.on_loss_notify(seq, nbytes, payload)
+            return
+        eng = self.engine
+        if t >= eng.bootstrap_end:
+            tokens = eng.tokens_down
+            if tokens[self.id] >= size:
+                tokens[self.id] -= size
+            else:
+                self.ingress_deferred_rows.append(row)
+                eng._deferred.add(self)
+                return
+        self._deliver_row(t, kind, peer, aport, bport, nbytes, seq, frag,
+                          nfrags, payload)
+
+    def _deliver_row(self, t: SimTime, kind: int, peer: int, aport: int,
+                     bport: int, nbytes: int, seq: int, frag: int,
+                     nfrags: int, payload) -> None:
+        """The row cleared the ingress bucket: dispatch to a socket."""
+        if t > self._now:
+            self._now = t
+        self._n_delivered += 1
+        if self.pcap is not None:
+            self.pcap.capture_fields(
+                kind, aport, bport, nbytes, seq, payload, t,
+                self.controller.hosts[peer].ip, self.ip)
+        if kind == U.DGRAM:
+            sock = self._udp.get(bport)
+            if sock is None:
+                self.counters.add("units_unroutable", 1)
+                return
+            sock.handle_fields(nbytes, payload, (peer, aport), seq, frag,
+                               nfrags, t)
+            return
+        key = (bport, peer, aport)
+        ep = self._conns.get(key)
+        if ep is None:
+            if kind == U.SYN:
+                on_accept = self._listeners.get(bport)
+                if on_accept is None:
+                    self.counters.add("units_unroutable", 1)
+                    return
+                ep = self._make_endpoint(bport, peer, aport,
+                                         initiator=False)
+                ep.state = ESTABLISHED
+                ep.sender.adv_wnd = seq  # client window rides the SYN
+                self._conns[key] = ep
+                ep.emit(U.SYNACK, wnd=ep.receiver.window())
+                on_accept(ep, t)
+                return
+            self.counters.add("units_unroutable", 1)
+            return
+        ep.handle_fields(kind, nbytes, payload, seq, t)
+
+    def mark_ack(self, ep) -> None:
+        """Queue a coalesced barrier ack for this endpoint (transport's
+        _ack); the columnar plane tracks owing hosts in a list instead of
+        scanning all hosts at the barrier."""
+        aeps = self._ack_eps
+        if not aeps and self.colplane is not None:
+            self.colplane.ack_hosts.append(self)
+        aeps[ep] = None
 
     # -- units ------------------------------------------------------------
     def next_uid(self) -> int:
@@ -114,6 +245,58 @@ class Host:
         if self.pcap is not None:
             ctl = self.controller
             self.pcap.capture(u, u.t_emit, self.ip, ctl.hosts[u.dst].ip)
+
+    def emit_msg(self, kind: int, dst: int, size: int, nbytes: int,
+                 payload, seq: int, sport: int, dport: int,
+                 frag_idx: int = 0, nfrags: int = 1,
+                 want_loss: bool = False) -> None:
+        """Field-level emission API shared by the transport and datagram
+        layers. Columnar plane: one tuple append, no Unit object, no uid
+        mint (uids are assigned vectorized at the barrier in the same
+        per-host emission order). Per-unit plane: materialize a Unit, the
+        reference-architecture data path."""
+        cp = self.colplane
+        if cp is not None:
+            eg = self.egress_rows
+            if not eg:
+                cp.emitters.append(self)
+            eg.append((kind, dst, size, self._now, sport, dport, nbytes,
+                       seq, frag_idx, nfrags, want_loss, payload))
+            self._n_emitted += 1
+            if self.pcap is not None:
+                self.pcap.capture_fields(
+                    kind, sport, dport, nbytes, seq, payload, self._now,
+                    self.ip, self.controller.hosts[dst].ip)
+            return
+        u = Unit(
+            uid=self.next_uid(),
+            src=self.id,
+            dst=dst,
+            size=size,
+            t_emit=self._now,
+            kind=kind,
+            src_port=sport,
+            dst_port=dport,
+            nbytes=nbytes,
+            payload=payload,
+            seq=seq,
+            frag_idx=frag_idx,
+            nfrags=nfrags,
+        )
+        if want_loss:
+            u.on_loss = lambda: self._dispatch_loss(
+                sport, dst, dport, seq, nbytes, payload)
+            u.loss_extra_ns = self.engine.rtt_extra_ns(self.id, dst)
+        self.emit_unit(u)
+
+    def _dispatch_loss(self, sport: int, dst: int, dport: int, seq: int,
+                       nbytes: int, payload) -> None:
+        """Loss notification fire: route back to the owning endpoint by
+        four-tuple. A lookup miss means the connection is gone — exactly
+        the cases the sender's own state checks used to no-op on."""
+        ep = self._conns.get((sport, dst, dport))
+        if ep is not None:
+            ep.on_loss_notify(seq, nbytes, payload)
 
     def deliver(self, u: Unit, now: SimTime) -> None:
         """A unit cleared the ingress token bucket: dispatch to a socket."""

@@ -1,0 +1,169 @@
+"""Shared device-routing machinery for the two data planes.
+
+Both the per-unit plane (network/engine.py) and the columnar plane
+(network/colplane.py) route loss-draw batches either to the numpy twin
+(fluid.loss_flags) or to the accelerator kernel (ops/propagate.py) — the
+paths are bit-identical, so routing is pure wall-clock policy. This base
+class carries everything that policy needs and that is identical across
+the planes:
+
+- background device attach + floor calibration (the first JAX touch on a
+  tunneled chip costs seconds; simulations start on the numpy twin and
+  switch over when the device publishes),
+- the adaptive floor: realized readback stalls are compared against what
+  the numpy twin would have cost; the floor backs off ×4 when the device
+  is clearly losing and decays back toward the calibrated floor when it
+  stops (a starved floor also decays on a round-count cooldown),
+- interpreter-teardown safety (close() joins the init thread: a daemon
+  thread mid-JAX-call at exit aborts the process when XLA backend
+  destruction races the in-flight computation),
+- the latency/deferred/outstanding accessors the controller polls.
+"""
+
+from __future__ import annotations
+
+from shadow_tpu.core.time import SimTime, T_NEVER
+
+
+class DeviceRoutedPlane:
+    """Mixin state + helpers; subclasses populate graph/params/_deferred/
+    outstanding and call _init_device_routing() from __init__."""
+
+    def _init_device_routing(self, backend: str, tpu_options,
+                             params) -> None:
+        self.max_batch = int(
+            getattr(tpu_options, "tpu_max_batch", 65536) or 65536)
+        self.max_pkts = int(getattr(tpu_options, "unit_mtus", 10) or 10)
+        self.device = None
+        self.device_floor = float("inf")
+        self._dev_stall = 0.0
+        self._dev_reads = 0
+        self._dev_units = 0
+        self._dev_warm = False  # first read (compile/attach) is excluded
+        self._floor_cooldown = 0  # rounds until a starved floor decays
+        self._np_per_unit = 4e-6  # refined by calibration when available
+        self._floor0 = float("inf")  # calibrated floor: decay lower bound
+        self.mesh_plane = None
+        if backend == "mesh":
+            # scheduler_policy: tpu_mesh — the WHOLE per-round network
+            # program (closed-form bucket departures, latency gather, loss
+            # draws, all_to_all arrival exchange, pmin barrier, psum
+            # counters) runs as ONE sharded XLA program per round, hosts
+            # sharded over the local device mesh. Bit-identical to the
+            # host planes (tests/test_multichip.py), so policy choice
+            # cannot change results.
+            from shadow_tpu.parallel.mesh import MeshDataPlane
+            import jax
+
+            n_shards = int(getattr(tpu_options, "tpu_mesh_shards", 0) or 0)
+            n = n_shards or len(jax.devices())
+            ups = max(1024, self.max_batch // n)
+            self.mesh_plane = MeshDataPlane(
+                params, n_shards=n, units_per_shard=ups,
+                max_pkts=self.max_pkts)
+        elif backend == "tpu":
+            n_shards = int(getattr(tpu_options, "tpu_mesh_shards", 0) or 0)
+            floor = int(getattr(tpu_options, "tpu_device_floor", 0) or 0)
+            if floor > 0:
+                from shadow_tpu.ops.propagate import DeviceDrawPlane
+
+                self.device = DeviceDrawPlane(params.seed, self.max_batch,
+                                              n_shards=n_shards,
+                                              max_pkts=self.max_pkts)
+                self.device_floor = floor
+            else:
+                # auto mode: device attach, kernel compile, and floor
+                # calibration run on a background thread; batches route to
+                # the numpy twin until the plane publishes. Because both
+                # paths are bit-identical and event order is
+                # canonicalized, WHEN the device comes online cannot
+                # affect results — only wall time.
+                import threading
+
+                self._bg_thread = threading.Thread(
+                    target=self._bg_init_device,
+                    args=(params.seed, n_shards), daemon=True)
+                self._bg_thread.start()
+
+    def _bg_init_device(self, seed: int, n_shards: int) -> None:
+        try:
+            from shadow_tpu.ops.propagate import DeviceDrawPlane
+
+            plane = DeviceDrawPlane(seed, self.max_batch, n_shards=n_shards,
+                                    max_pkts=self.max_pkts)
+            dev_s, np_per_unit = plane.calibrate()
+            if np_per_unit > 0:
+                self._np_per_unit = np_per_unit
+                self.device_floor = max(512, min(
+                    int(dev_s / np_per_unit), self.max_batch))
+                self._floor0 = self.device_floor
+            self.device = plane  # publish last (reads are GIL-atomic)
+        except Exception:
+            pass  # no usable device: the numpy twin serves everything
+
+    def close(self) -> None:
+        """Join the background device-init thread (if any)."""
+        t = getattr(self, "_bg_thread", None)
+        if t is not None and t.is_alive():
+            t.join()
+
+    # -- adaptive floor -----------------------------------------------------
+    def _floor_cooldown_tick(self) -> None:
+        """Called on barriers that did NOT use the device: a backed-off
+        floor must be able to recover even when it now starves the device
+        entirely (no reads -> no stall windows)."""
+        if self.device_floor > self._floor0 and self._floor_cooldown > 0:
+            self._floor_cooldown -= 1
+            if self._floor_cooldown == 0:
+                self.device_floor = max(self._floor0, self.device_floor // 4)
+                self._floor_cooldown = 512
+                self._dev_stall = 0.0
+                self._dev_reads = 0
+                self._dev_units = 0
+
+    def _record_dev_read(self, dt: float, n_units: int) -> None:
+        if not self._dev_warm:
+            self._dev_warm = True  # compile/attach stall: not signal
+        else:
+            self._dev_stall += dt
+            self._dev_reads += 1
+            self._dev_units += n_units
+
+    def _floor_settle(self) -> None:
+        """Every 8 realized device reads, compare stalls against what the
+        numpy twin would have cost for the same units: back off only when
+        the device is clearly LOSING, decay back toward the calibrated
+        floor when it stops (results are identical either way)."""
+        if self._dev_reads < 8:
+            return
+        np_cost = self._np_per_unit * self._dev_units
+        if self._dev_stall > 4 * np_cost + 0.02:
+            self.device_floor = min(self.device_floor * 4, 1 << 30)
+            self._floor_cooldown = 512
+        elif (self._dev_stall < np_cost and
+              self.device_floor > self._floor0):
+            self.device_floor = max(self._floor0, self.device_floor // 4)
+        self._dev_stall = 0.0
+        self._dev_reads = 0
+        self._dev_units = 0
+
+    # -- accessors shared by the controller --------------------------------
+    def latency_between(self, src_host: int, dst_host: int) -> SimTime:
+        p = self.params
+        return int(self.graph.latency_ns[p.host_node[src_host],
+                                         p.host_node[dst_host]])
+
+    def rtt_extra_ns(self, src_host: int, dst_host: int) -> SimTime:
+        """Extra delay beyond one-way latency for loss notifications: the
+        return-path latency (so the sender learns of a loss one RTT after
+        departure, like a fast-retransmit signal)."""
+        return self.latency_between(dst_host, src_host)
+
+    def has_immediate_work(self) -> bool:
+        """True if the next round must run even with empty event queues
+        (deferred ingress backlog waiting on token refill)."""
+        return bool(self._deferred)
+
+    def earliest_outstanding(self) -> SimTime:
+        """Earliest event time any in-flight draw batch can produce."""
+        return min((b.deadline for b in self.outstanding), default=T_NEVER)

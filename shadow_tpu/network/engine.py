@@ -46,6 +46,7 @@ from shadow_tpu.network.fluid import (
     clamped_refill,
     loss_flags,
 )
+from shadow_tpu.network.devroute import DeviceRoutedPlane
 from shadow_tpu.network.graph import INF_I64, NetworkGraph
 from shadow_tpu.network.unit import Unit
 
@@ -63,7 +64,7 @@ class _Outstanding:
     handle: object  # DrawHandle
 
 
-class NetworkEngine:
+class NetworkEngine(DeviceRoutedPlane):
     def __init__(self, graph: NetworkGraph, params: NetParams, hosts,
                  round_ns: SimTime, backend: str = "numpy",
                  tpu_options=None, bootstrap_end: SimTime = 0) -> None:
@@ -91,23 +92,9 @@ class NetworkEngine:
         #: recovery must come from its own timers (SURVEY.md §5.3).
         self.fault_filter = None
         self.fault_silent = False
+        self.phase_wall: dict = {}  # per-phase timing lives in colplane
 
         self._deferred: set = set()  # hosts with ingress backlog
-        self.max_batch = int(getattr(tpu_options, "tpu_max_batch", 65536) or 65536)
-        self.max_pkts = int(getattr(tpu_options, "unit_mtus", 10) or 10)
-        self.device = None
-        self.device_floor = float("inf")
-        # adaptive guard: a tunneled/contended device can stall readbacks
-        # far beyond the calibrated estimate; when realized stalls are
-        # high, raise the routing floor so batches fall back to numpy
-        # (results are bit-identical either way — this is pure wall time)
-        self._dev_stall = 0.0
-        self._dev_reads = 0
-        self._dev_units = 0
-        self._dev_warm = False  # first read (compile/attach) is excluded
-        self._floor_cooldown = 0  # rounds until a starved floor decays
-        self._np_per_unit = 4e-6  # refined by calibration when available
-        self._floor0 = float("inf")  # calibrated floor: decay lower bound
         #: dynamic runahead (reference: experimental.use_dynamic_runahead):
         #: the smallest latency any resolved unit has actually used. Rounds
         #: may widen to this instead of the graph-wide minimum; a new flow
@@ -116,85 +103,15 @@ class NetworkEngine:
         self.min_used_latency: SimTime = T_NEVER
         self.qdisc = str(getattr(tpu_options, "interface_qdisc", "fifo")
                          or "fifo")
-        self.mesh_plane = None
-        if backend == "mesh":
-            # scheduler_policy: tpu_mesh — the WHOLE per-round network
-            # program (closed-form bucket departures, latency gather, loss
-            # draws, all_to_all arrival exchange, pmin barrier, psum
-            # counters) runs as ONE sharded XLA program per round, hosts
-            # sharded over the local device mesh. Bit-identical to the
-            # host plane (tests/test_multichip.py), so policy choice
-            # cannot change results.
-            from shadow_tpu.parallel.mesh import MeshDataPlane
+        # device attach/calibration + adaptive routing floor (shared with
+        # the columnar plane: network/devroute.py)
+        self._init_device_routing(backend, tpu_options, params)
 
-            n_shards = int(getattr(tpu_options, "tpu_mesh_shards", 0) or 0)
-            import jax
-
-            n = n_shards or len(jax.devices())
-            ups = max(1024, self.max_batch // n)
-            self.mesh_plane = MeshDataPlane(
-                params, n_shards=n, units_per_shard=ups,
-                max_pkts=self.max_pkts)
-        if backend == "tpu":
-            n_shards = int(getattr(tpu_options, "tpu_mesh_shards", 0) or 0)
-            floor = int(getattr(tpu_options, "tpu_device_floor", 0) or 0)
-            if floor > 0:
-                from shadow_tpu.ops.propagate import DeviceDrawPlane
-
-                self.device = DeviceDrawPlane(params.seed, self.max_batch,
-                                              n_shards=n_shards,
-                                              max_pkts=self.max_pkts)
-                self.device_floor = floor
-            else:
-                # auto mode: device attach (~seconds on a tunneled chip),
-                # kernel compile, and floor calibration all run on a
-                # background thread; batches route to the numpy twin until
-                # the plane publishes. Because both paths are bit-identical
-                # and event order is canonicalized, WHEN the device comes
-                # online cannot affect results — only wall time.
-                import threading
-
-                threading.Thread(
-                    target=self._bg_init_device,
-                    args=(params.seed, n_shards), daemon=True,
-                ).start()
-
-    def _bg_init_device(self, seed: int, n_shards: int) -> None:
-        try:
-            from shadow_tpu.ops.propagate import DeviceDrawPlane
-
-            plane = DeviceDrawPlane(seed, self.max_batch, n_shards=n_shards,
-                                    max_pkts=self.max_pkts)
-            dev_s, np_per_unit = plane.calibrate()
-            if np_per_unit > 0:
-                self._np_per_unit = np_per_unit
-                self.device_floor = max(512, min(
-                    int(dev_s / np_per_unit), self.max_batch))
-                self._floor0 = self.device_floor
-            self.device = plane  # publish last (reads are GIL-atomic)
-        except Exception:
-            pass  # no usable device: the numpy twin serves everything
-
-    # latency helpers ------------------------------------------------------
-    def latency_between(self, src_host: int, dst_host: int) -> SimTime:
-        p = self.params
-        return int(self.graph.latency_ns[p.host_node[src_host], p.host_node[dst_host]])
-
-    def rtt_extra_ns(self, src_host: int, dst_host: int) -> SimTime:
-        """Extra delay beyond one-way latency for loss notifications: the
-        return-path latency (so the sender learns of a loss one RTT after
-        departure, like a fast-retransmit signal)."""
-        return self.latency_between(dst_host, src_host)
-
-    # state queries (controller) -------------------------------------------
-    def has_immediate_work(self) -> bool:
-        """True if the next round must run even with empty event queues
-        (deferred ingress backlog waiting on token refill)."""
-        return bool(self._deferred)
-
-    def earliest_outstanding(self) -> SimTime:
-        """Earliest event time any in-flight draw batch can produce."""
-        return min((b.deadline for b in self.outstanding), default=T_NEVER)
+    def pending_head(self) -> SimTime:
+        """Resolved-but-undelivered arrivals: always T_NEVER here — this
+        plane pushes arrivals straight into host heaps (the columnar
+        plane's store is where this is a real quantity)."""
+        return T_NEVER
 
     # round hooks ----------------------------------------------------------
     def start_of_round(self, round_start: SimTime, round_end: SimTime) -> None:
@@ -343,18 +260,8 @@ class NetworkEngine:
             and n >= self.device_floor
             and bool((thresh > 0).any())
         )
-        if (not use_device and self.device_floor > self._floor0
-                and self._floor_cooldown > 0):
-            # a backed-off floor must be able to recover even when it now
-            # starves the device entirely (no reads -> no stall windows)
-            self._floor_cooldown -= 1
-            if self._floor_cooldown == 0:
-                self.device_floor = max(self._floor0, self.device_floor // 4)
-                self._floor_cooldown = 512
-                self._dev_stall = 0.0
-                self._dev_reads = 0
-                self._dev_units = 0
         if not use_device:
+            self._floor_cooldown_tick()
             flags = loss_flags(self.params.seed, *_uid_arrays(units, n), thresh)
             if forced is not None:
                 flags = flags | forced
@@ -386,30 +293,11 @@ class NetworkEngine:
         for b in due:
             t0 = _walltime.perf_counter()
             flags = b.handle.read()
-            dt = _walltime.perf_counter() - t0
-            if not self._dev_warm:
-                self._dev_warm = True  # compile/attach stall: not signal
-            else:
-                self._dev_stall += dt
-                self._dev_reads += 1
-                self._dev_units += len(b.units)
+            self._record_dev_read(_walltime.perf_counter() - t0,
+                                  len(b.units))
             self._schedule_batch(b.units, b.arrival, b.notify,
                                  flags, b.keys, b.round_end)
-        if self._dev_reads >= 8:
-            # compare realized stalls against what the numpy twin would
-            # have cost for the same units: back off only when the device
-            # is clearly LOSING, decay back toward the calibrated floor
-            # when it stops (results are identical either way)
-            np_cost = self._np_per_unit * self._dev_units
-            if self._dev_stall > 4 * np_cost + 0.02:
-                self.device_floor = min(self.device_floor * 4, 1 << 30)
-                self._floor_cooldown = 512
-            elif (self._dev_stall < np_cost and
-                  self.device_floor > self._floor0):
-                self.device_floor = max(self._floor0, self.device_floor // 4)
-            self._dev_stall = 0.0
-            self._dev_reads = 0
-            self._dev_units = 0
+        self._floor_settle()
 
     def flush_all(self) -> None:
         self.flush_due(T_NEVER + 1)

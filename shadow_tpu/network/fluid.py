@@ -208,6 +208,46 @@ class TokenBuckets:
         self.debt[src[starts]] += np.add.reduceat(size64, starts)
         return t_dep
 
+    def depart_times_scalar(self, src_l, size_l, t_emit_l,
+                            t_now: SimTime) -> list:
+        """Exact scalar twin of depart_times for tiny batches (numpy's
+        fixed per-op cost dominates them). Python ints are arbitrary
+        precision, so the arithmetic matches the vector path bit-for-bit;
+        rebase runs lazily on the touched sources only — outcome-identical
+        (an untouched saturated bucket clamps to capacity at whichever
+        barrier next reads it, with the same resulting state)."""
+        p = self.params
+        t_base, tokens, debt = self.t_base, self.tokens, self.debt
+        rate_up, cap_up = p.rate_up, p.cap_up
+        for s in set(src_l):
+            rate = int(rate_up[s])
+            dt = t_now - int(t_base[s])
+            q, r = divmod(dt, NS_PER_SEC)
+            avail = (int(tokens[s]) + rate * q + rate * r // NS_PER_SEC
+                     - int(debt[s]))
+            if avail > int(cap_up[s]):
+                t_base[s] = t_now
+                tokens[s] = cap_up[s]
+                debt[s] = 0
+        out = []
+        cum: dict = {}
+        for i, s in enumerate(src_l):
+            qsum = cum.get(s, 0) + size_l[i]
+            cum[s] = qsum
+            need = int(debt[s]) + qsum - int(tokens[s])
+            if need > 0:
+                rate = int(rate_up[s])
+                q, r = divmod(need, rate)
+                t_ready = (int(t_base[s]) + q * NS_PER_SEC
+                           + (r * NS_PER_SEC + rate - 1) // rate)
+            else:
+                t_ready = 0
+            te = t_emit_l[i]
+            out.append(te if te > t_ready else t_ready)
+        for s, qsum in cum.items():
+            debt[s] += qsum
+        return out
+
 
 def loss_flags(seed: int, uid_lo: np.ndarray, uid_hi: np.ndarray,
                npkts: np.ndarray, thresh: np.ndarray) -> np.ndarray:

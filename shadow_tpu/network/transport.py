@@ -128,11 +128,8 @@ class StreamSender:
             self.ep._on_sender_drained()
 
     def _emit_data(self, seq: int, nbytes: int, payload: Optional[bytes]) -> None:
-        self.ep.emit(
-            U.DATA, nbytes=nbytes, payload=payload, seq=seq,
-            on_loss=lambda: self._on_oracle_loss(seq, nbytes, payload),
-            loss_extra="rtt",
-        )
+        self.ep.emit(U.DATA, nbytes=nbytes, payload=payload, seq=seq,
+                     want_loss=True)
 
     # -- loss recovery -----------------------------------------------------
     def _on_oracle_loss(self, seq: int, nbytes: int, payload) -> None:
@@ -226,14 +223,14 @@ class StreamReceiver:
         unread = self.app_unread() if self.app_unread is not None else 0
         return max(self.recv_buffer - self.ooo_bytes - unread, 0)
 
-    def on_data(self, unit: Unit, now: SimTime) -> None:
-        seq, n = unit.seq, unit.nbytes
+    def on_data(self, seq: int, n: int, payload: Optional[bytes],
+                now: SimTime) -> None:
         if seq + n <= self.rcv_nxt:
             self._ack()  # duplicate (retransmit after a lost ACK): re-ack
             return
         if seq > self.rcv_nxt:
             if seq not in self.ooo and n <= self.window():
-                self.ooo[seq] = (n, unit.payload)
+                self.ooo[seq] = (n, payload)
                 self.ooo_bytes += n
             self._ack()  # "duplicate ack": rcv_nxt unchanged
             return
@@ -244,7 +241,7 @@ class StreamReceiver:
             # and the sender's RTO retries until the app reads
             self._ack()
             return
-        self._deliver(n, unit.payload, now)
+        self._deliver(n, payload, now)
         while self.rcv_nxt in self.ooo:
             n2, p2 = self.ooo.pop(self.rcv_nxt)
             self.ooo_bytes -= n2
@@ -272,7 +269,7 @@ class StreamReceiver:
         # cumulative ACK per connection at the barrier. Halves unit volume
         # on bulk transfers with identical reliability (acks are cumulative
         # and the sender's RTO floor far exceeds a round width).
-        self.ep.host._ack_eps[self.ep] = None
+        self.ep.host.mark_ack(self.ep)
 
     def flush_ack(self) -> None:
         self.last_wnd = self.window()
@@ -407,39 +404,39 @@ class StreamEndpoint:
             self.on_close(now)
 
     def emit(self, kind: int, nbytes: int = 0, payload: Optional[bytes] = None,
-             seq: int = 0, acked: int = 0, wnd: int = 0, on_loss=None,
-             loss_extra=None) -> None:
-        u = Unit(
-            uid=self.host.next_uid(),
-            src=self.host.id,
-            dst=self.remote_host,
-            size=nbytes + HEADER,
-            t_emit=self.host.now,
-            kind=kind,
-            src_port=self.local_port,
-            dst_port=self.remote_port,
-            nbytes=nbytes if kind == U.DATA else acked,
-            payload=payload,
-            seq=seq if kind == U.DATA else wnd,  # control units: seq = window
-        )
-        u.on_loss = on_loss
-        if loss_extra == "rtt":
-            u.loss_extra_ns = self.host.engine.rtt_extra_ns(self.host.id, self.remote_host)
-        self.host.emit_unit(u)
+             seq: int = 0, acked: int = 0, wnd: int = 0,
+             want_loss: bool = False) -> None:
+        # control units overload the fields: nbytes carries the cumulative
+        # ack, seq carries the advertised window. want_loss requests a
+        # loss notification (dispatched back to this endpoint's sender one
+        # return-path latency after the would-be arrival — the fluid
+        # analog of duplicate-ack detection; DATA only)
+        self.host.emit_msg(
+            kind, self.remote_host, nbytes + HEADER,
+            nbytes if kind == U.DATA else acked, payload,
+            seq if kind == U.DATA else wnd,
+            self.local_port, self.remote_port, want_loss=want_loss)
 
     # -- unit arrivals (dispatched by the host) ---------------------------
     def handle(self, unit: Unit, now: SimTime) -> None:
-        k = unit.kind
+        self.handle_fields(unit.kind, unit.nbytes, unit.payload, unit.seq,
+                           now)
+
+    def handle_fields(self, k: int, nbytes: int, payload: Optional[bytes],
+                      seq: int, now: SimTime) -> None:
+        """Field-level arrival dispatch shared by the per-unit plane
+        (via handle) and the columnar plane's inbox loop. Control units:
+        nbytes = cumulative ack, seq = advertised window."""
         if k == U.SYN:
             # (server side) duplicate SYN: the SYNACK was lost — re-ack
             if self.state == ESTABLISHED:
-                self.sender.adv_wnd = unit.seq
+                self.sender.adv_wnd = seq
                 self.emit(U.SYNACK, wnd=self.receiver.window())
             return
         if k == U.SYNACK:
             if self.state == SYN_SENT:
                 self.state = ESTABLISHED
-                self.sender.adv_wnd = unit.seq
+                self.sender.adv_wnd = seq
                 self._cancel_ctl()
                 if self.on_connected is not None:
                     self.on_connected(now)
@@ -448,13 +445,13 @@ class StreamEndpoint:
         if k == U.DATA:
             if self.state in (CLOSED, TIME_WAIT):
                 return
-            self.host.counters.add("stream_bytes_received", unit.nbytes)
-            self.receiver.on_data(unit, now)
+            self.host.counters.add("stream_bytes_received", nbytes)
+            self.receiver.on_data(seq, nbytes, payload, now)
             return
         if k == U.ACK:
             if self.state in (CLOSED, TIME_WAIT):
                 return
-            self.sender.on_ack(unit.nbytes, unit.seq)
+            self.sender.on_ack(nbytes, seq)
             return
         if k == U.FIN:
             # the peer's data all precedes its FIN (it fins only once fully
@@ -485,6 +482,12 @@ class StreamEndpoint:
                     self.on_close(now)
             return
 
+    def on_loss_notify(self, seq: int, nbytes: int,
+                       payload: Optional[bytes]) -> None:
+        """The engine's loss notification for one of our DATA units,
+        re-dispatched by endpoint four-tuple (both planes route here)."""
+        self.sender._on_oracle_loss(seq, nbytes, payload)
+
 
 class DatagramSocket:
     """UDP-like socket with fragmentation/reassembly."""
@@ -507,46 +510,58 @@ class DatagramSocket:
             nbytes = max(nbytes, len(payload))
         dgram = self._next_dgram
         self._next_dgram += 1
-        chunk = self.host.unit_chunk
+        host = self.host
+        chunk = host.unit_chunk
         nfrags = max(1, -(-nbytes // chunk))
-        self.host._n_dgrams += 1
+        host._n_dgrams += 1
+        port = self.local_port
+        if nfrags == 1:  # the overwhelmingly common case: one row, go
+            cp = host.colplane
+            if cp is not None and host.pcap is None:
+                # columnar fast path: inline the emit_msg tuple append
+                # (this call is the hottest emission site at gossip scale)
+                eg = host.egress_rows
+                if not eg:
+                    cp.emitters.append(host)
+                eg.append((U.DGRAM, dst_host, nbytes + HEADER, host._now,
+                           port, dst_port, nbytes, dgram, 0, 1, False,
+                           payload))
+                host._n_emitted += 1
+                return
+            host.emit_msg(U.DGRAM, dst_host, nbytes + HEADER, nbytes,
+                          payload, dgram, port, dst_port)
+            return
+        emit = host.emit_msg
         for i in range(nfrags):
             lo = i * chunk
             hi = min(nbytes, lo + chunk)
-            u = Unit(
-                uid=self.host.next_uid(),
-                src=self.host.id,
-                dst=dst_host,
-                size=(hi - lo) + HEADER,
-                t_emit=self.host.now,
-                kind=U.DGRAM,
-                src_port=self.local_port,
-                dst_port=dst_port,
-                nbytes=hi - lo,
-                payload=payload[lo:hi] if payload is not None else None,
-                seq=dgram,
-                frag_idx=i,
-                nfrags=nfrags,
-            )
-            self.host.emit_unit(u)
+            emit(U.DGRAM, dst_host, (hi - lo) + HEADER, hi - lo,
+                 payload[lo:hi] if payload is not None else None,
+                 dgram, port, dst_port, frag_idx=i, nfrags=nfrags)
 
     def handle(self, unit: Unit, now: SimTime) -> None:
-        src_addr = (unit.src, unit.src_port)
-        if unit.nfrags == 1:
-            self._deliver(unit.nbytes, unit.payload, src_addr, now)
+        self.handle_fields(unit.nbytes, unit.payload,
+                           (unit.src, unit.src_port), unit.seq,
+                           unit.frag_idx, unit.nfrags, now)
+
+    def handle_fields(self, nbytes: int, payload: Optional[bytes],
+                      src_addr: tuple, dgram: int, frag_idx: int,
+                      nfrags: int, now: SimTime) -> None:
+        if nfrags == 1:
+            self._deliver(nbytes, payload, src_addr, now)
             return
-        key = (unit.src, unit.src_port, unit.seq)
-        frags = self._partial.setdefault(key, [None] * unit.nfrags)
-        frags[unit.frag_idx] = unit
+        key = (src_addr[0], src_addr[1], dgram)
+        frags = self._partial.setdefault(key, [None] * nfrags)
+        frags[frag_idx] = (nbytes, payload)
         if all(f is not None for f in frags):
             del self._partial[key]
-            nbytes = sum(f.nbytes for f in frags)
-            payload = (
-                b"".join(f.payload for f in frags)
-                if all(f.payload is not None for f in frags)
+            total = sum(n for n, _ in frags)
+            whole = (
+                b"".join(p for _, p in frags)
+                if all(p is not None for _, p in frags)
                 else None
             )
-            self._deliver(nbytes, payload, src_addr, now)
+            self._deliver(total, whole, src_addr, now)
         elif len(self._partial) > 4096:  # bound memory: drop oldest partial
             self._partial.pop(next(iter(self._partial)))
 

@@ -25,6 +25,9 @@ from shadow_tpu.network.fluid import HARD_MAX_PKTS, HEADER, MTU
 # unit kinds
 SYN, SYNACK, DATA, ACK, FIN, FINACK, DGRAM = range(7)
 KIND_NAMES = ("SYN", "SYNACK", "DATA", "ACK", "FIN", "FINACK", "DGRAM")
+#: columnar-plane row kind for a loss notification (not a wire unit; see
+#: shadow_tpu/network/colplane.py)
+KIND_LOSS = 16
 
 
 @dataclass(slots=True)

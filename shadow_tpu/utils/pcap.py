@@ -35,16 +35,22 @@ class PcapWriter:
         self.records = 0
 
     def capture(self, unit, t_ns: int, src_ip: str, dst_ip: str) -> None:
-        if unit.kind == U.DGRAM:
-            l4 = struct.pack(">HHHH", unit.src_port, unit.dst_port,
-                             8 + unit.nbytes, 0)
+        self.capture_fields(unit.kind, unit.src_port, unit.dst_port,
+                            unit.nbytes, unit.seq, unit.payload, t_ns,
+                            src_ip, dst_ip)
+
+    def capture_fields(self, kind: int, src_port: int, dst_port: int,
+                       nbytes: int, seq: int, payload, t_ns: int,
+                       src_ip: str, dst_ip: str) -> None:
+        if kind == U.DGRAM:
+            l4 = struct.pack(">HHHH", src_port, dst_port, 8 + nbytes, 0)
             proto = socket.IPPROTO_UDP
         else:
-            l4 = struct.pack(">HHIIBBHHH", unit.src_port, unit.dst_port,
-                             unit.seq & 0xFFFFFFFF, 0, 5 << 4,
-                             _TCP_FLAGS.get(unit.kind, 0x10), 65535, 0, 0)
+            l4 = struct.pack(">HHIIBBHHH", src_port, dst_port,
+                             seq & 0xFFFFFFFF, 0, 5 << 4,
+                             _TCP_FLAGS.get(kind, 0x10), 65535, 0, 0)
             proto = socket.IPPROTO_TCP
-        payload = unit.payload or b"\0" * unit.nbytes
+        payload = payload or b"\0" * nbytes
         total = 20 + len(l4) + len(payload)
         ip = struct.pack(">BBHHHBBH4s4s", 0x45, 0, total, self.records & 0xFFFF,
                          0, 64, proto, 0, socket.inet_aton(src_ip),

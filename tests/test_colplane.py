@@ -1,0 +1,243 @@
+"""Cross-plane equality: the columnar data plane (network/colplane.py,
+behind scheduler_policy tpu_batch/tpu_mesh) must produce BIT-IDENTICAL
+simulations to the per-unit reference plane (network/engine.py, behind the
+thread policies) on every workload family — unit identity, event keys,
+bucket charge order, and (time, band, key) execution order are reproduced
+exactly, so any divergence is a bug in one of the planes.
+
+Each test runs the same config under thread_per_core (per-unit plane) and
+tpu_batch (columnar plane, numpy twin under the tests' forced-CPU JAX) and
+asserts the summaries AND the full host output trees match.
+"""
+
+import filecmp
+from pathlib import Path
+
+import yaml
+
+from shadow_tpu.config import parse_config
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.network import unit as U
+
+EQ_KEYS = ("sim_seconds", "rounds", "events", "units_sent", "units_dropped",
+           "bytes_sent", "counters")
+
+
+def _run(doc, policy, tag, overrides=None):
+    over = {"experimental.scheduler_policy": policy,
+            "general.data_directory": f"/tmp/colplane-{tag}-{policy}"}
+    if overrides:
+        over.update(overrides)
+    cfg = parse_config(yaml.safe_load(doc) if isinstance(doc, str) else doc,
+                       over)
+    ctl = Controller(cfg, mirror_log=False)
+    res = ctl.run()
+    return ctl, res
+
+
+def _assert_equal(doc, tag, overrides=None):
+    ctl_a, a = _run(doc, "thread_per_core", tag, overrides)
+    ctl_b, b = _run(doc, "tpu_batch", tag, overrides)
+    for k in EQ_KEYS:
+        assert a[k] == b[k], (tag, k, a[k], b[k])
+    da = Path(f"/tmp/colplane-{tag}-thread_per_core/hosts")
+    db = Path(f"/tmp/colplane-{tag}-tpu_batch/hosts")
+    if da.is_dir():
+        cmp = filecmp.dircmp(da, db)
+        assert not cmp.diff_files and not cmp.left_only and not cmp.right_only
+    return ctl_a, ctl_b, a
+
+
+TGEN_LOSSY = """
+general:
+  stop_time: 30s
+  seed: 7
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "20 Mbit" host_bandwidth_down "20 Mbit" ]
+        node [ id 1 host_bandwidth_up "10 Mbit" host_bandwidth_down "5 Mbit" ]
+        edge [ source 0 target 1 latency "25 ms" packet_loss 0.02 ]
+        edge [ source 0 target 0 latency "5 ms" packet_loss 0.01 ]
+        edge [ source 1 target 1 latency "5 ms" ]
+      ]
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+      - path: pyapp:shadow_tpu.models.tgen:TGenServer
+        args: ["8080"]
+  client:
+    network_node_id: 1
+    quantity: 4
+    processes:
+      - path: pyapp:shadow_tpu.models.tgen:TGenClient
+        args: ["400 kB", "2", serial, "8080", server]
+        start_time: 500 ms
+"""
+
+
+def test_stream_transfers_with_loss_identical():
+    """Bulk TCP-like transfers under per-packet loss: retransmits, cwnd
+    evolution, loss notifications, and ack coalescing all bit-match."""
+    _, _, res = _assert_equal(TGEN_LOSSY, "tgen")
+    assert res["units_dropped"] > 0  # the loss machinery actually engaged
+    assert res["units_sent"] > 500
+
+
+GOSSIP = """
+general:
+  stop_time: 25s
+  seed: 11
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "50 Mbit" host_bandwidth_down "50 Mbit" ]
+        node [ id 1 host_bandwidth_up "20 Mbit" host_bandwidth_down "20 Mbit" ]
+        edge [ source 0 target 1 latency "20 ms" packet_loss 0.005 ]
+        edge [ source 0 target 0 latency "8 ms" ]
+        edge [ source 1 target 1 latency "8 ms" ]
+      ]
+hosts:
+  node:
+    network_node_id: 0
+    quantity: 24
+    processes:
+      - path: pyapp:shadow_tpu.models.gossip:GossipNode
+        args: ["7000", "40", "5", "2", "0.5"]
+  edge:
+    network_node_id: 1
+    quantity: 16
+    processes:
+      - path: pyapp:shadow_tpu.models.gossip:GossipNode
+        args: ["7000", "40", "5", "1", "0.7"]
+"""
+
+
+def test_datagram_gossip_identical():
+    """High-fanout datagram flood (the columnar fast path) bit-matches."""
+    _, _, res = _assert_equal(GOSSIP, "gossip")
+    assert res["units_sent"] > 2000
+
+
+def test_gossip_ingress_pressure_identical():
+    """Tight down-links force the ingress token bucket to defer arrivals:
+    the columnar deferred-drain order must match the per-unit plane's."""
+    doc = yaml.safe_load(GOSSIP)
+    text = GOSSIP.replace('"20 Mbit" host_bandwidth_down "20 Mbit"',
+                          '"20 Mbit" host_bandwidth_down "120 Kbit"')
+    doc = yaml.safe_load(text)
+    _assert_equal(doc, "ingress")
+
+
+TOR = """
+general:
+  stop_time: 25s
+  seed: 3
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 1 host_bandwidth_up "50 Mbit" host_bandwidth_down "50 Mbit" ]
+        edge [ source 0 target 1 latency "40 ms" packet_loss 0.01 ]
+        edge [ source 0 target 0 latency "15 ms" ]
+        edge [ source 1 target 1 latency "15 ms" ]
+      ]
+hosts:
+  relay:
+    network_node_id: 0
+    quantity: 6
+    processes:
+      - path: pyapp:shadow_tpu.models.tor:TorExit
+        args: ["9001"]
+  web0:
+    network_node_id: 0
+    processes:
+      - path: pyapp:shadow_tpu.models.tgen:TGenServer
+        args: ["80"]
+  client:
+    network_node_id: 1
+    quantity: 4
+    processes:
+      - path: pyapp:shadow_tpu.models.tor:TorClient
+        args: ["6", "9001", web0, "80", "50 kB", "2"]
+        start_time: 1s
+"""
+
+
+def test_tor_onion_circuits_identical():
+    """Multi-hop framed relaying over streams bit-matches."""
+    _assert_equal(TOR, "tor")
+
+
+def test_round_robin_qdisc_identical():
+    """interface_qdisc round_robin reorders egress AFTER uid assignment on
+    the per-unit plane; the columnar plane must assign the same uids to
+    the same logical units (emission order), or loss draws diverge."""
+    _assert_equal(TGEN_LOSSY, "rr", {
+        "experimental.interface_qdisc": "round_robin"})
+
+
+def test_multifrag_datagrams_identical():
+    """Datagrams larger than the unit quantum fragment and reassemble;
+    losing any fragment loses the datagram — both planes agree."""
+    doc = yaml.safe_load(GOSSIP)
+    # widen gossip TX payloads past one unit (~15 kB) via a smaller quantum
+    _assert_equal(doc, "frag", {"experimental.unit_mtus": 1})
+
+
+def test_fault_injection_identical():
+    """Targeted fault injection (force-dropped units) takes the vector
+    barrier path with _RowView adapters — same drops, same recovery."""
+    def run_with_fault(policy):
+        over = {"experimental.scheduler_policy": policy,
+                "general.data_directory": f"/tmp/colplane-fault-{policy}"}
+        cfg = parse_config(yaml.safe_load(TGEN_LOSSY), over)
+        ctl = Controller(cfg, mirror_log=False)
+        remaining = {"n": 3}
+
+        def fault(u):
+            # exercises the _RowView surface the per-unit plane's Unit has
+            if (u.kind == U.DATA and u.nbytes > 0 and u.nfrags == 1
+                    and u.t_emit >= 0 and remaining["n"] > 0):
+                remaining["n"] -= 1
+                return True
+            return False
+
+        ctl.engine.fault_filter = fault
+        ctl.engine.fault_silent = False
+        res = ctl.run()
+        assert remaining["n"] == 0, policy
+        return res
+
+    a = run_with_fault("thread_per_core")
+    b = run_with_fault("tpu_batch")
+    for k in EQ_KEYS:
+        assert a[k] == b[k], (k, a[k], b[k])
+
+
+def test_dynamic_runahead_identical():
+    """Dynamic runahead widens rounds from observed latencies — the
+    min_used_latency bookkeeping must agree across planes."""
+    _assert_equal(TGEN_LOSSY, "dyn", {
+        "experimental.use_dynamic_runahead": True})
+
+
+def test_phase_wall_breakdown_present():
+    """The run summary carries the per-phase wall breakdown (VERDICT r2
+    item #7) for both planes: 'events' always, engine phases columnar."""
+    _, a = _run(TGEN_LOSSY, "thread_per_core", "pw")
+    assert "events" in a["phase_wall"]
+    _, b = _run(TGEN_LOSSY, "tpu_batch", "pw")
+    for k in ("events", "barrier", "draw_flush", "extract",
+              "ingress_deferred"):
+        assert k in b["phase_wall"], k
