@@ -75,6 +75,12 @@ class ExperimentalOptions:
     socket_send_buffer: int = 131072
     socket_recv_buffer: int = 174760
     strace_logging_mode: str = "off"  # off | standard | deterministic
+    #: reality-boundary audit for managed processes: the shim traps EVERY
+    #: guest syscall (gadget-IP seccomp filter), counts the unemulated
+    #: numbers it passes through natively, and the summary reports them.
+    #: Diagnostic mode: adds a trap per native syscall; incompatible with
+    #: guests that execve.
+    native_audit: bool = False
     interface_qdisc: str = "fifo"
     max_unapplied_cpu_latency: SimTime = 0
     #: fluid quantum width in MTUs (1..64). Wider units mean fewer events
@@ -207,6 +213,7 @@ def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
     e.socket_send_buffer = parse_size(exp.get("socket_send_buffer", e.socket_send_buffer))
     e.socket_recv_buffer = parse_size(exp.get("socket_recv_buffer", e.socket_recv_buffer))
     e.strace_logging_mode = str(exp.get("strace_logging_mode", "off"))
+    e.native_audit = bool(exp.get("native_audit", False))
     e.interface_qdisc = str(exp.get("interface_qdisc", "fifo"))
     e.max_unapplied_cpu_latency = parse_time(exp.get("max_unapplied_cpu_latency", 0))
     _require(e.max_unapplied_cpu_latency >= 0,

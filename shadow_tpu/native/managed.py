@@ -52,6 +52,7 @@ THREAD_EXIT = 0xFFFFFFF3   # arg0 = retval; thread finishes dying natively
 FORK_INTENT = 0xFFFFFFF4   # -> reply carries embryo id + SCM_RIGHTS fd
 FORK_COMMIT = 0xFFFFFFF5   # args = (embryo id, real child pid) -> vpid
 RESOLVE = 0xFFFFFFF6       # arg0 = guest ptr to a hostname -> IPv4 (u32)
+AUDIT_NOTE = 0xFFFFFFF7    # arg0 = unemulated syscall nr, first native use
 SYS_wait4, SYS_exit_group, SYS_pipe, SYS_pipe2 = 61, 231, 22, 293
 SYS_dup, SYS_dup2, SYS_dup3 = 32, 33, 292
 SYS_fstat, SYS_lseek, SYS_newfstatat = 5, 8, 262
@@ -315,6 +316,9 @@ class ManagedProcess(ProcessLifecycle):
         self._unapplied = 0
         self._spin_t = -1  # busy-loop detector: syscalls at one sim instant
         self._spin_n = 0
+        #: experimental.native_audit: syscall numbers this process ran
+        #: against the host kernel (reported once each by the shim)
+        self.audit_native: set[int] = set()
         # deterministic virtual pid (real pids would leak host scheduling
         # nondeterminism into any guest that prints or hashes its pid)
         self.vpid = 1000 + host.id * 64 + index
@@ -384,6 +388,8 @@ class ManagedProcess(ProcessLifecycle):
             "SHADOW_SHIM": "1",
             "SHADOW_TIME_SHM": str(self._time_path),
         })
+        if self.host.controller.cfg.experimental.native_audit:
+            env["SHADOW_AUDIT"] = "1"
         with _SPAWN_LOCK:
             _reserve_ipc_slot()
             parent, child = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -1168,7 +1174,17 @@ class ManagedProcess(ProcessLifecycle):
                 code = self._signal_hint
             else:
                 code = -9
+        if self.audit_native:
+            # the reality boundary, surfaced (VERDICT r2 item #5): which
+            # syscalls this guest ran against the HOST kernel
+            self.host.log(
+                f"{self.name}: {len(self.audit_native)} unemulated "
+                f"syscalls ran natively: {sorted(self.audit_native)}")
         if self._strace is not None:
+            if self.audit_native:
+                self._strace.write(
+                    f"+++ native passthrough: {sorted(self.audit_native)} "
+                    "+++\n")
             self._strace.write(f"+++ exited with {code} +++\n")
             self._strace.close()
             self._strace = None
@@ -1515,6 +1531,16 @@ class ManagedProcess(ProcessLifecycle):
             return self._fork_intent()
         if nr == FORK_COMMIT:
             return self._fork_commit(args[0], args[1])
+        if nr == AUDIT_NOTE:
+            # reality boundary (experimental.native_audit): the shim passed
+            # an unemulated syscall through to the host kernel; record the
+            # number (once per number per process)
+            self.audit_native.add(int(args[0]))
+            self.host.counters.add("audit_native_syscalls", 1)
+            if self._strace is not None:
+                self._strace.write(
+                    f"native-passthrough first use: syscall_{args[0]}\n")
+            return 0
         if nr == RESOLVE:
             # simulated name resolution (shim-interposed getaddrinfo):
             # config host names map to their simulated IPv4

@@ -50,11 +50,32 @@ VFD_CONDITIONAL = ["ioctl", "fcntl", "dup", "dup2", "dup3",
                    "fstat", "lseek", "newfstatat"]
 
 
-def build():
+def build(audit: bool = False):
+    """audit=True emits the reality-boundary variant: syscalls are
+    allowed ONLY from the shim's fixed-address syscall gadget; everything
+    the guest issues itself traps, and the SIGSYS handler counts + relays
+    unemulated numbers natively (native/shim/shim.c audit path). The
+    functional carve-outs (thread clones, the shim's re-exec, IPC-window
+    reads) keep their ALLOW branches; all default/real-fd ALLOWs become
+    TRAPs."""
+    A = "TRAP" if audit else "ALLOW"  # default disposition
     prog: list = []
     prog.append(("LD_ARCH",))
     prog.append(("JEQ", "ARCH", None, "ALLOW"))
+    if audit:
+        # syscalls issued from the gadget page run natively; the kernel
+        # reports the IP AFTER the syscall insn, still inside the page
+        prog.append(("LD_IPHI",))
+        prog.append(("JEQ", "GADHI", None, "NRSTART"))
+        prog.append(("LD_IPLO",))
+        prog.append(("JGE", "GADLO", None, "NRSTART"))
+        prog.append(("JGE", "GADEND", "NRSTART", "ALLOW"))
+    labels0 = {}
+    labels0["NRSTART"] = len(prog)
     prog.append(("LD_NR",))
+    if audit:
+        # sigreturn must stay native or the SIGSYS handler cannot return
+        prog.append(("JEQ", 15, "ALLOW", None))  # rt_sigreturn
     prog.append(("JEQ", SYS["read"], "READ", None))
     prog.append(("JEQ", SYS["write"], "WRITE", None))
     # close traps for vfds AND the reserved IPC window: guests sweeping
@@ -76,19 +97,19 @@ def build():
     # (the shim re-injects LD_PRELOAD/SHADOW_* and re-execs); any other
     # execve traps so the worker can reject it
     prog.append(("JEQ", SYS["execve"], "EXECCHK", None))
-    prog.append(("JGE", SYS["socket"], None, "ALLOW"))
-    prog.append(("JGE", SYS["clone_end"], "ALLOW", "TRAP"))
-    labels = {}
+    prog.append(("JGE", SYS["socket"], None, A))
+    prog.append(("JGE", SYS["clone_end"], A, "TRAP"))
+    labels = labels0
     labels["READ"] = len(prog)
     prog += [("LD_A0",), ("JGE", "IPCLOW", None, "READCHK"),
              ("JGE", "IPCEND", "READCHK", "ALLOW")]
     labels["READCHK"] = len(prog)
-    prog += [("JEQ", 0, "TRAP", None), ("JGE", "VFD", "TRAP", "ALLOW")]
+    prog += [("JEQ", 0, "TRAP", None), ("JGE", "VFD", "TRAP", A)]
     labels["WRITE"] = len(prog)
     prog += [("LD_A0",), ("JGE", "IPCLOW", None, "WRITECHK"),
              ("JGE", "IPCEND", "WRITECHK", "ALLOW")]
     labels["WRITECHK"] = len(prog)
-    prog += [("JGE", 3, None, "TRAP"), ("JGE", "VFD", "TRAP", "ALLOW")]
+    prog += [("JGE", 3, None, "TRAP"), ("JGE", "VFD", "TRAP", A)]
     labels["IPCRD"] = len(prog)
     prog += [("LD_A0",), ("JGE", "IPCLOW", None, "TRAP"),
              ("JGE", "IPCEND", "TRAP", "ALLOW")]
@@ -106,8 +127,8 @@ def build():
     # unsigned values: let them through natively
     prog += [("LD_A0",)]
     labels["VFDTAIL"] = len(prog)
-    prog += [("JGE", "VFD", None, "ALLOW"),
-             ("JGE", 0xFFFFF000, "ALLOW", "TRAP")]
+    prog += [("JGE", "VFD", None, A),
+             ("JGE", 0xFFFFF000, A, "TRAP")]
     labels["TRAP"] = len(prog)
     prog.append(("RET_TRAP",))
     labels["ALLOW"] = len(prog)
@@ -120,6 +141,9 @@ def build():
                 "IPCLOW": "SHIM_IPC_LOW", "IPCEND": "(SHIM_IPC_FD + 1)",
                 "EXECLO": "(uint32_t)(uintptr_t)SHIM_EXEC_ADDR",
                 "EXECHI": "(uint32_t)((uintptr_t)SHIM_EXEC_ADDR >> 32)",
+                "GADLO": "(uint32_t)(uintptr_t)SHIM_GADGET_ADDR",
+                "GADHI": "(uint32_t)((uintptr_t)SHIM_GADGET_ADDR >> 32)",
+                "GADEND": "((uint32_t)(uintptr_t)SHIM_GADGET_ADDR + 4096)",
                 "VFD": "SHIM_VFD_BASE"}.get(v, str(v))
 
     out = []
@@ -127,6 +151,7 @@ def build():
         k = ins[0]
         simple = {"LD_ARCH": "LD(BPF_ARCHF),", "LD_NR": "LD(BPF_NR),",
                   "LD_A0": "LD(BPF_ARG0),",
+                  "LD_IPLO": "LD(BPF_IPLO),", "LD_IPHI": "LD(BPF_IPHI),",
                   "LD_A2LO": "LD(BPF_ARG2LO),", "LD_A2HI": "LD(BPF_ARG2HI),",
                   "RET_TRAP": "RET(SECCOMP_RET_TRAP),",
                   "RET_ALLOW": "RET(SECCOMP_RET_ALLOW),"}
@@ -151,18 +176,48 @@ def build():
     return len(prog), "\n".join(out)
 
 
+def emu_bitmap():
+    """512-bit bitmap of syscall numbers the worker emulates whenever they
+    trap with no fd condition (the SIGSYS handler's audit fallback checks
+    this; fd-conditional numbers are decided in C)."""
+    bits = bytearray(64)
+    nrs = [SYS[n] for n in UNCONDITIONAL] + list(range(SYS["socket"],
+                                                       SYS["clone_end"]))
+    for nr in nrs:
+        bits[nr >> 3] |= 1 << (nr & 7)
+    rows = []
+    for i in range(0, 64, 8):
+        rows.append("    " + " ".join(f"0x{b:02x}," for b in bits[i:i + 8]))
+    return "\n".join(rows)
+
+
 def main():
     shim = Path(__file__).resolve().parents[1] / "native" / "shim" / "shim.c"
     src = shim.read_text()
     begin = "  /* BEGIN GENERATED BPF (tools/gen_bpf.py) */\n"
     end = "  /* END GENERATED BPF */"
     n, table = build()
+    na, table_a = build(audit=True)
     i, j = src.index(begin) + len(begin), src.index(end)
     src = (src[:i]
            + f"  struct sock_filter prog[] = {{  /* {n} instructions */\n"
-           + table + "\n  };\n" + src[j:])
+           + table + "\n  };\n"
+           + f"  struct sock_filter prog_audit[] = {{"
+           + f"  /* {na} instructions */\n"
+           + table_a + "\n  };\n" + src[j:])
+    bbegin = "/* BEGIN GENERATED EMU BITMAP (tools/gen_bpf.py) */\n"
+    bend = "/* END GENERATED EMU BITMAP */"
+    i, j = src.index(bbegin) + len(bbegin), src.index(bend)
+    src = (src[:i] + "static const uint8_t shim_emu_bitmap[64] = {\n"
+           + emu_bitmap() + "\n};\n" + src[j:])
+    cbegin = "  /* BEGIN GENERATED VFD CASES (tools/gen_bpf.py) */\n"
+    cend = "  /* END GENERATED VFD CASES */"
+    i, j = src.index(cbegin) + len(cbegin), src.index(cend)
+    cases = " ".join(f"case {SYS[n]}:" for n in VFD_CONDITIONAL)
+    src = (src[:i] + f"  {cases}  /* {' '.join(VFD_CONDITIONAL)} */\n"
+           + src[j:])
     shim.write_text(src)
-    print(f"wrote {n}-instruction filter into {shim}")
+    print(f"wrote {n}+{na}-instruction filters into {shim}")
 
 
 if __name__ == "__main__":
