@@ -1,0 +1,27 @@
+"""File-surface exercise guest: runs unmodified natively AND under the
+simulator; stdout must be byte-identical (the dual-run oracle)."""
+import os
+
+os.makedirs("data/sub", exist_ok=True)
+with open("data/a.txt", "w") as f:
+    f.write("hello\n")
+with open("data/a.txt", "a") as f:
+    f.write("world\n")
+os.rename("data/a.txt", "data/b.txt")
+with open("data/sub/c.bin", "wb") as f:
+    f.write(bytes(range(64)) * 100)
+
+print("read:", open("data/b.txt").read().strip().replace("\n", "|"))
+print("listdir:", sorted(os.listdir("data")))
+st = os.stat("data/sub/c.bin")
+print("size:", st.st_size)
+print("isfile:", os.path.isfile("data/b.txt"),
+      os.path.isdir("data/sub"), os.path.exists("data/nope"))
+with open("data/sub/c.bin", "rb") as f:
+    f.seek(100)
+    print("seek-read:", f.read(8).hex())
+os.unlink("data/b.txt")
+print("after-unlink:", sorted(os.listdir("data")))
+os.rmdir("data/sub") if not os.listdir("data/sub") else None
+print("cwd-tail:", os.path.basename(os.getcwd()) != "")
+print("ok")

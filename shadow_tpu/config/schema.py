@@ -78,8 +78,8 @@ class ExperimentalOptions:
     #: reality-boundary audit for managed processes: the shim traps EVERY
     #: guest syscall (gadget-IP seccomp filter), counts the unemulated
     #: numbers it passes through natively, and the summary reports them.
-    #: Diagnostic mode: adds a trap per native syscall; incompatible with
-    #: guests that execve.
+    #: Diagnostic mode: adds a trap per native syscall (execve works —
+    #: the worker-mediated respawn gives the new image fresh filters).
     native_audit: bool = False
     interface_qdisc: str = "fifo"
     max_unapplied_cpu_latency: SimTime = 0

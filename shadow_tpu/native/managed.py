@@ -39,6 +39,7 @@ from typing import Optional
 from shadow_tpu.core.time import NS_PER_SEC, SimTime, emulated
 from shadow_tpu.host.process import ProcessLifecycle
 from shadow_tpu.native.memory import ProcessMemory
+from shadow_tpu.native.vfs import RETRY_NATIVE, HostVFS
 
 SHIM_IPC_FD = 995
 IPC_LOW = 964  # per-thread channel window [IPC_LOW, SHIM_IPC_FD]
@@ -56,6 +57,23 @@ AUDIT_NOTE = 0xFFFFFFF7    # arg0 = unemulated syscall nr, first native use
 SYS_wait4, SYS_exit_group, SYS_pipe, SYS_pipe2 = 61, 231, 22, 293
 SYS_dup, SYS_dup2, SYS_dup3 = 32, 33, 292
 SYS_fstat, SYS_lseek, SYS_newfstatat = 5, 8, 262
+# the virtual file surface (native/vfs.py)
+SYS_open, SYS_stat, SYS_lstat, SYS_access = 2, 4, 6, 21
+SYS_fsync, SYS_fdatasync, SYS_truncate, SYS_ftruncate = 74, 75, 76, 77
+SYS_getcwd, SYS_chdir, SYS_fchdir, SYS_rename, SYS_mkdir = 79, 80, 81, 82, 83
+SYS_rmdir, SYS_creat, SYS_unlink, SYS_readlink = 84, 85, 87, 89
+SYS_getdents64, SYS_openat, SYS_mkdirat, SYS_unlinkat = 217, 257, 258, 263
+SYS_renameat, SYS_readlinkat, SYS_faccessat = 264, 267, 269
+SYS_renameat2, SYS_statx, SYS_faccessat2 = 316, 332, 439
+AT_FDCWD = -100
+AT_REMOVEDIR = 0x200
+AT_SYMLINK_NOFOLLOW = 0x100
+
+
+def _sfd(v: int) -> int:
+    """Sign-extend a u64 syscall fd argument (AT_FDCWD arrives as
+    0xFFFF...FF9C)."""
+    return v - (1 << 64) if v >= (1 << 63) else v
 SYS_close_range = 436
 SYS_select, SYS_pselect6 = 23, 270
 SYS_kill = 62
@@ -128,6 +146,9 @@ _REPLIED = object()  # service() sentinel: reply already sent inline
 _EMBRYO = object()  # ready-queue sentinel: read THREAD_HELLO before granting
 _EXITGROUP = object()  # service() sentinel: reply, SIGKILL the whole
                        # process (exit_group semantics), reap immediately
+_EXECED = object()  # service() sentinel: execve succeeded — the OLD real
+                    # process was killed and replaced; no reply, stop
+                    # reading the dead channel
 
 #: spawn serialization: the child end of the socketpair rides a FIXED fd
 #: number (the seccomp filter bakes it in), so concurrent spawns on
@@ -181,7 +202,8 @@ class VSocket:
                  "accept_q", "nonblock", "dgram_q", "udp", "dgram_peer",
                  "interest",
                  "expirations", "interval_ns", "deadline", "timer_handle",
-                 "evt_counter", "refs", "pipe", "pipe_out", "timer_clock")
+                 "evt_counter", "refs", "pipe", "pipe_out", "timer_clock",
+                 "vfile")
 
     def __init__(self, vfd: int, kind: str = "stream") -> None:
         self.vfd = vfd
@@ -207,6 +229,7 @@ class VSocket:
         # eventfd state
         self.evt_counter = 0
         self.timer_clock = 0  # timerfd: clockid the deadlines are based on
+        self.vfile = None  # VFile when kind is file/dir (native/vfs.py)
         # fork support: open-file-description refcount (a forked child's fd
         # table shares VSocket objects; the backing object closes when the
         # LAST table entry referencing it closes, like the kernel's)
@@ -319,6 +342,9 @@ class ManagedProcess(ProcessLifecycle):
         #: experimental.native_audit: syscall numbers this process ran
         #: against the host kernel (reported once each by the shim)
         self.audit_native: set[int] = set()
+        #: the per-host virtual file surface (native/vfs.py): synthesized
+        #: /etc files, host-data-dir tree, native passthrough elsewhere
+        self.vfs = HostVFS(self)
         # deterministic virtual pid (real pids would leak host scheduling
         # nondeterminism into any guest that prints or hashes its pid)
         self.vpid = 1000 + host.id * 64 + index
@@ -478,6 +504,143 @@ class ManagedProcess(ProcessLifecycle):
                 pass
             self._exited()
 
+    # -- execve: worker-mediated respawn -----------------------------------
+    def _read_ptr_array(self, ptr: int, cap: int = 1024):
+        """Read a NULL-terminated array of C-string pointers (argv/envp)."""
+        out = []
+        for i in range(cap):
+            v = struct.unpack("<Q", self.mem.read(ptr + 8 * i, 8))[0]
+            if v == 0:
+                return out
+            cs = self._read_cstr(v)
+            if cs is None:
+                return None
+            out.append(cs)
+        return None
+
+    def _do_exec(self, args):
+        """execve as a respawn: spawn a fresh managed process (clean
+        filter stack — the old in-place re-exec died in the new image's
+        dynamic linker once file syscalls started trapping) into THIS
+        record: same vpid, vfd table, stdio captures, clock page, strace
+        stream, and audit set. The old real process is killed while its
+        shim blocks in the forward — success never returns, like the real
+        execve. Works from any thread and under audit mode."""
+        path = self._read_cstr(args[0])
+        if path is None:
+            return -EFAULT
+        argv = self._read_ptr_array(args[1]) if args[1] else None
+        envp = self._read_ptr_array(args[2]) if args[2] else []
+        if argv is None and args[1]:
+            return -EFAULT
+        if envp is None:
+            return -EFAULT
+        if not argv:
+            argv = [path]
+        real = path
+        r = self.vfs.resolve(AT_FDCWD, path)
+        if r is not None:
+            if r[0] != "host":
+                return -EACCES  # synthesized files are not executable
+            real = r[1]
+        elif not path.startswith("/"):
+            real = os.path.normpath(self.vfs.cwd + "/" + path)
+        if not os.path.isfile(real):
+            return -2  # ENOENT
+        if not os.access(real, os.X_OK):
+            return -EACCES
+        env = {}
+        for e in envp:
+            k, _, v = e.partition("=")
+            env[k] = v
+        env.update({
+            "LD_PRELOAD": str(_shim_lib()),
+            "SHADOW_SHIM": "1",
+            "SHADOW_TIME_SHM": str(self._time_path),
+        })
+        if self.host.controller.cfg.experimental.native_audit:
+            env["SHADOW_AUDIT"] = "1"
+        cwd = self.vfs.cwd if os.path.isdir(self.vfs.cwd) else None
+        # spawn the replacement FIRST: a failed execve must leave the
+        # calling process unchanged (POSIX), so nothing destructive
+        # happens until the new image exists
+        with _SPAWN_LOCK:
+            _reserve_ipc_slot()
+            parent, child = socket.socketpair(socket.AF_UNIX,
+                                              socket.SOCK_STREAM)
+            os.dup2(child.fileno(), SHIM_IPC_FD)
+            child.close()
+            try:
+                try:
+                    newproc = subprocess.Popen(
+                        argv, executable=real, env=env,
+                        pass_fds=(SHIM_IPC_FD,),
+                        stdout=self._files.get(1),
+                        stderr=self._files.get(2),
+                        cwd=cwd,
+                    )
+                except OSError as exc:
+                    parent.close()
+                    return -(exc.errno or EACCES)
+            finally:
+                devnull = os.open(os.devnull, os.O_RDWR)
+                os.dup2(devnull, SHIM_IPC_FD)
+                os.close(devnull)
+        # point of no return: reap sibling-thread records (exec kills the
+        # real siblings), sweep FD_CLOEXEC vfds, retire the old process
+        cur = self._cur
+        old_threads = self.threads
+        for t in list(old_threads.values()):
+            if t is not cur and not t.dead:
+                t.retval = 0
+                self._thread_gone(t)
+            if t is not cur:
+                t.joined = True
+        for fd in sorted(self.fd_cloexec):  # FD_CLOEXEC sweep
+            vs = self.fds.pop(fd, None)
+            if vs is not None:
+                self._close_vs(vs)
+        self.fd_cloexec.clear()
+        old_proc, old_pid, old_sock = self.proc, self.real_pid, self.sock
+        if old_proc is not None:
+            old_proc.kill()
+            old_proc.wait()
+        elif old_pid is not None:
+            try:
+                os.kill(old_pid, 9)
+            except ProcessLookupError:
+                pass
+        for t in old_threads.values():  # close every per-thread channel
+            if t.sock is not None and t.sock is not old_sock:
+                try:
+                    t.sock.close()
+                except OSError:
+                    pass
+        if old_sock is not None:
+            old_sock.close()
+        self.proc = newproc
+        self.real_pid = None
+        self.mem = ProcessMemory(newproc.pid)
+        self.sock = parent
+        self.threads = {0: GuestThread(0, parent)}
+        main = self.threads[0]
+        self.host.counters.add("execs", 1)
+        if self._strace is not None:
+            self._strace.write(f"+++ execve {real} +++\n")
+        # fresh-image handshake, then queue its first turn grant (drained
+        # when the old thread's pump returns)
+        parent.settimeout(HANDSHAKE_TIMEOUT_S)
+        try:
+            req = self._read_req(main)
+        finally:
+            parent.settimeout(None)
+        if req is None or req[0] != HELLO:
+            newproc.kill()
+            self._exited()
+            return _EXECED
+        self._resume(main, 0)
+        return _EXECED
+
     # -- IPC ---------------------------------------------------------------
     def _read_req(self, th: GuestThread):
         buf = b""
@@ -530,6 +693,12 @@ class ManagedProcess(ProcessLifecycle):
                     self._reply(th, 0)
                 except OSError:
                     pass
+                return
+            if ret is _EXECED:
+                # the record now fronts the REPLACEMENT process; the old
+                # image (this channel) is gone. The new main's first turn
+                # grant is queued and drains when we return.
+                self._trace(nr, args, "<execed>")
                 return
             if ret is _REPLIED:
                 # service sent its own (ancillary-carrying) reply inline
@@ -629,6 +798,9 @@ class ManagedProcess(ProcessLifecycle):
         when the last reference (across forked processes) goes away."""
         vs.refs -= 1
         if vs.refs > 0:
+            return
+        if vs.kind in ("file", "dir"):
+            self.vfs.close(vs)
             return
         if vs.listening:
             self.host.unlisten(vs.bound_port)
@@ -757,6 +929,7 @@ class ManagedProcess(ProcessLifecycle):
             if vs.pipe_out is not None:
                 vs.pipe_out.procs.add(self)
         self._next_vfd = parent._next_vfd
+        self.vfs.cwd = parent.vfs.cwd
         self.threads = {0: GuestThread(0, sock)}
         self._cur = self.threads[0]
         self.parent_proc = parent
@@ -869,6 +1042,9 @@ class ManagedProcess(ProcessLifecycle):
         vs = self.fds.get(fd)
         if vs is None:
             return -EBADF
+        if vs.kind in ("file", "dir"):
+            self.mem.write(buf, self.vfs.fstat_bytes(vs))
+            return 0
         mode = {"pipe_r": 0o010600, "pipe_w": 0o010600,  # S_IFIFO
                 "stream": 0o140777, "dgram": 0o140777,   # S_IFSOCK
                 "spair": 0o140777,
@@ -1237,11 +1413,19 @@ class ManagedProcess(ProcessLifecycle):
                 return self._pipe_write(vs, self.mem.read(addr, min(n, 1 << 20)))
             if vs is not None and vs.kind == "pipe_r":
                 return -EBADF  # write on the read end
+            if vs is not None and vs.kind in ("file", "dir"):
+                return self.vfs.write(vs, self.mem.read(addr, min(n, 1 << 20)))
             return self._vfd_send(fd, addr, n)
         if nr == SYS_read:
             if args[0] == 0 and 0 not in self.fds:
                 return 0  # stdin: EOF (unless a vfd was dup2'd onto it)
             vs = self.fds.get(args[0])
+            if vs is not None and vs.kind in ("file", "dir"):
+                data = self.vfs.read(vs, min(args[2], 1 << 20))
+                if isinstance(data, int):
+                    return data
+                self.mem.write(args[1], data)
+                return len(data)
             if vs is not None and vs.kind in ("timer", "event"):
                 return self._counter_read(vs, args[1], args[2])
             if vs is not None and vs.kind in ("pipe_r", "spair"):
@@ -1633,24 +1817,105 @@ class ManagedProcess(ProcessLifecycle):
         if nr == SYS_fstat:
             return self._fstat(args[0], args[1])
         if nr == SYS_newfstatat:
-            # only reachable with a vfd dirfd: the glibc fstat path
-            # (AT_EMPTY_PATH with an empty pathname)
-            return self._fstat(args[0], args[2])
+            return self.vfs.statat(_sfd(args[0]), args[1], args[2],
+                                   args[3])
         if nr == SYS_lseek:
+            vs = self.fds.get(args[0])
+            if vs is not None and vs.kind in ("file", "dir"):
+                return self.vfs.lseek(vs, args[1], args[2])
             return -29 if args[0] in self.fds else -EBADF  # ESPIPE
+        if nr in (SYS_open, SYS_creat):
+            flags = (0o1101 if nr == SYS_creat  # O_WRONLY|O_CREAT|O_TRUNC
+                     else args[1])
+            return self.vfs.openat(AT_FDCWD, args[0], flags, args[2])
+        if nr == SYS_openat:
+            return self.vfs.openat(_sfd(args[0]), args[1], args[2], args[3])
+        if nr in (SYS_stat, SYS_lstat):
+            return self.vfs.statat(
+                AT_FDCWD, args[0], args[1],
+                AT_SYMLINK_NOFOLLOW if nr == SYS_lstat else 0)
+        if nr == SYS_statx:
+            return self.vfs.statx(_sfd(args[0]), args[1], args[2], args[4])
+        if nr == SYS_access:
+            return self.vfs.access(AT_FDCWD, args[0], args[1])
+        if nr in (SYS_faccessat, SYS_faccessat2):
+            return self.vfs.access(_sfd(args[0]), args[1], args[2])
+        if nr == SYS_unlink:
+            return self.vfs.unlinkat(AT_FDCWD, args[0], 0)
+        if nr == SYS_rmdir:
+            return self.vfs.unlinkat(AT_FDCWD, args[0], AT_REMOVEDIR)
+        if nr == SYS_unlinkat:
+            return self.vfs.unlinkat(_sfd(args[0]), args[1], args[2])
+        if nr == SYS_mkdir:
+            return self.vfs.mkdirat(AT_FDCWD, args[0], args[1])
+        if nr == SYS_mkdirat:
+            return self.vfs.mkdirat(_sfd(args[0]), args[1], args[2])
+        if nr == SYS_rename:
+            return self.vfs.renameat(AT_FDCWD, args[0], AT_FDCWD, args[1])
+        if nr in (SYS_renameat, SYS_renameat2):
+            if nr == SYS_renameat2 and args[4]:
+                return -EINVAL  # RENAME_* flags not modeled
+            return self.vfs.renameat(_sfd(args[0]), args[1],
+                                     _sfd(args[2]), args[3])
+        if nr == SYS_readlink:
+            return self.vfs.readlinkat(AT_FDCWD, args[0], args[1], args[2])
+        if nr == SYS_readlinkat:
+            return self.vfs.readlinkat(_sfd(args[0]), args[1], args[2],
+                                       args[3])
+        if nr == SYS_chdir:
+            return self.vfs.chdir(args[0])
+        if nr == SYS_fchdir:
+            vs = self.fds.get(args[0])
+            if vs is None:
+                return -EBADF
+            return self.vfs.fchdir(vs)
+        if nr == SYS_getcwd:
+            return self.vfs.getcwd(args[0], args[1])
+        if nr == SYS_truncate:
+            return self.vfs.truncate(args[0], args[1])
+        if nr == SYS_ftruncate:
+            vs = self.fds.get(args[0])
+            if vs is None:
+                return -EBADF
+            return self.vfs.ftruncate(vs, args[1])
+        if nr in (SYS_fsync, SYS_fdatasync):
+            return 0 if args[0] in self.fds else -EBADF
+        if nr == SYS_getdents64:
+            vs = self.fds.get(args[0])
+            if vs is None:
+                return -EBADF
+            if vs.kind != "dir":
+                return -20  # ENOTDIR
+            data = self.vfs.getdents64(vs, min(args[2], 1 << 16))
+            if isinstance(data, int):
+                return data
+            self.mem.write(args[1], data)
+            return len(data)
         if nr == SYS_dup:
             return self._dup(args[0], None)
         if nr in (SYS_dup2, SYS_dup3):
+            if args[0] not in self.fds:
+                # REAL source fd: the kernel will do the dup — but a dup2
+                # onto a number we map virtually (a shell restoring its
+                # saved stdout) must drop our mapping first, or writes to
+                # that number keep landing in the old virtual file
+                vs = self.fds.pop(args[1], None)
+                if vs is not None:
+                    self.fd_cloexec.discard(args[1])
+                    self._close_vs(vs)
+                return RETRY_NATIVE
             if args[0] == args[1]:
-                return args[1] if args[0] in self.fds else -EBADF
+                return args[1]
             r = self._dup(args[0], args[1])
             if r >= 0 and nr == SYS_dup3 and args[2] & O_CLOEXEC:
                 self.fd_cloexec.add(r)
             return r
-        if nr in (SYS_clone, SYS_fork, SYS_vfork, SYS_execve, SYS_clone3):
+        if nr == SYS_execve:
+            return self._do_exec(args)
+        if nr in (SYS_clone, SYS_fork, SYS_vfork, SYS_clone3):
             # CLONE_THREAD clones run natively; fork-style clones are
             # executed SHIM-side (FORK_INTENT/COMMIT protocol) and never
-            # reach here; vfork (shared-VM) and execve stay rejected
+            # reach here; vfork (shared-VM) stays rejected
             return -ENOSYS
         return -ENOSYS
 
@@ -2293,6 +2558,8 @@ class ManagedProcess(ProcessLifecycle):
             return 8
         if vs.kind in ("pipe_w", "spair"):
             return self._pipe_write(vs, data)
+        if vs.kind in ("file", "dir"):
+            return self.vfs.write(vs, data)
         return self._stream_send(vs, data)
 
     def _readv(self, fd: int, iov_ptr: int, iovcnt: int):
@@ -2306,6 +2573,11 @@ class ManagedProcess(ProcessLifecycle):
             if not iovs:
                 return -EINVAL
             return self._counter_read(vs, iovs[0][0], iovs[0][1])
+        if vs.kind in ("file", "dir"):
+            data = self.vfs.read(vs, sum(ln for _, ln in iovs))
+            if isinstance(data, int):
+                return data
+            return self._scatter(iovs, data)
         if vs.kind in ("pipe_r", "spair"):
             return self._pipe_read(vs, iovs)
         if vs.kind == "dgram":

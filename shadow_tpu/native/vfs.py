@@ -1,0 +1,562 @@
+"""The per-host virtual file surface for managed processes.
+
+Reference analog: the reference's descriptor table serves regular files to
+guests (SURVEY.md §2 "Descriptor table & file objects", "passthrough to
+real FS under data dir"). Round 3 gives the worker a real file surface
+(VERDICT r2 missing #2): every path-taking syscall traps (tools/gen_bpf.py
+UNCONDITIONAL file set) and resolves here against a three-way policy:
+
+- **synthesized** — ``/etc/hosts`` and ``/etc/resolv.conf`` are generated
+  from the simulation config (every host name with its simulated IPv4), so
+  unmodified binaries that read resolver files see the simulated network;
+- **host tree** — paths under the host's data directory (where the guest's
+  cwd starts) are served by the WORKER against the real directory: reads,
+  writes, directory listings, renames — all deterministic because only
+  this simulation writes there, with stat times drawn from the simulated
+  clock and deterministic inode numbers;
+- **native** — everything else (/lib, /usr, /proc, ...) returns the
+  RETRY_NATIVE sentinel and the shim re-issues the syscall through its
+  gadget: dynamic linking, imports, and host-file reads behave exactly as
+  before, but now by explicit policy instead of a filter default.
+
+Guest-visible fds for virtualized files are ordinary vfds (VSocket kind
+"file"/"dir"); read/write/lseek/fstat/getdents64/close flow through the
+worker with offsets tracked worker-side. Known limitation (documented):
+mmap of a virtualized file fails (mmap stays native and the vfd is not a
+kernel fd) — binaries that map their data files need those paths left on
+the native side of the policy.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import stat as statmod
+import struct
+from pathlib import Path
+
+from shadow_tpu.core.time import NS_PER_SEC, emulated
+
+#: worker reply that makes the shim re-issue the syscall via its gadget
+RETRY_NATIVE = -1000000
+
+AT_FDCWD = -100  # dispatch sign-extends the raw u64 fd args (managed._sfd)
+AT_EMPTY_PATH = 0x1000
+AT_SYMLINK_NOFOLLOW = 0x100
+
+O_ACCMODE = 0o3
+O_CREAT = 0o100
+O_EXCL = 0o200
+O_TRUNC = 0o1000
+O_APPEND = 0o2000
+O_DIRECTORY = 0o200000
+
+ENOENT = errno.ENOENT
+ENOTDIR = errno.ENOTDIR
+EEXIST = errno.EEXIST
+EACCES = errno.EACCES
+EISDIR = errno.EISDIR
+EBADF = errno.EBADF
+EINVAL = errno.EINVAL
+ENOTEMPTY = errno.ENOTEMPTY
+EROFS = errno.EROFS
+
+
+def _det_ino(path: str) -> int:
+    """Deterministic inode number: stable across runs and machines."""
+    h = 1469598103934665603
+    for b in path.encode():
+        h = ((h ^ b) * 1099511628211) & 0xFFFFFFFFFFFF
+    return h | 1
+
+
+class VFile:
+    """Worker-side state of one virtualized open file/directory."""
+
+    __slots__ = ("path", "vpath", "fd", "data", "off", "flags", "is_dir",
+                 "dents", "dent_pos")
+
+    def __init__(self, vpath: str, path: str, fd, data, flags: int,
+                 is_dir: bool = False, dents=None):
+        self.vpath = vpath  # guest-visible absolute path
+        self.path = path  # real backing path ("" for synthesized)
+        self.fd = fd  # os-level fd (None for synthesized content)
+        self.data = data  # bytes for synthesized read-only files
+        self.off = 0
+        self.flags = flags
+        self.is_dir = is_dir
+        self.dents = dents  # sorted [(name, d_type, ino)] snapshot
+        self.dent_pos = 0
+
+
+class HostVFS:
+    """One managed process's view of the virtual file surface. The cwd is
+    tracked per process (fork children copy it); the synthesized /etc
+    files are built once per simulation from the controller's host list."""
+
+    def __init__(self, proc) -> None:
+        self.proc = proc
+        self.root = str(proc.host.controller.data_dir / "hosts"
+                        / proc.host.name)
+        self.cwd = self.root
+
+    # -- path resolution ----------------------------------------------------
+    def _synth(self, path: str):
+        if path == "/etc/hosts":
+            ctl = self.proc.host.controller
+            lines = ["127.0.0.1 localhost\n"]
+            for h in ctl.hosts:
+                lines.append(f"{h.ip} {h.name}\n")
+            return "".join(lines).encode()
+        if path == "/etc/resolv.conf":
+            return b"nameserver 127.0.0.53\noptions edns0\n"
+        return None
+
+    def resolve(self, dirfd: int, path: str):
+        """Classify a path:
+        ("synth", bytes) | ("host", realpath) | ("wnative", abspath) |
+        None (shim re-issues natively). Relative paths ALWAYS absolutize
+        against the WORKER-TRACKED cwd: a relative path landing outside
+        the virtual root is served worker-side against that absolute path
+        ("wnative") instead of re-issuing the original relative args —
+        the real process's kernel cwd therefore never matters, and
+        chdir/fchdir are purely virtual. Host classification keeps paths
+        INSIDE the root (no .. escape)."""
+        rel = not path.startswith("/")
+        if rel:
+            if dirfd == AT_FDCWD:
+                base = self.cwd
+            else:
+                vs = self.proc.fds.get(dirfd)
+                if (vs is not None and vs.kind == "dir"
+                        and vs.vfile is not None):
+                    base = vs.vfile.path
+                else:
+                    return None  # relative to a native dirfd: native
+            path = base + "/" + path if path else base
+        path = os.path.normpath(path)
+        s = self._synth(path)
+        if s is not None:
+            return ("synth", s)
+        root = self.root
+        if path == root or path.startswith(root + "/"):
+            return ("host", path)
+        return ("wnative", path) if rel else None
+
+    def _path_arg(self, ptr: int) -> str | None:
+        if not ptr:
+            return ""
+        raw = self.proc._read_cstr(ptr)
+        return raw
+
+    # -- open ---------------------------------------------------------------
+    def openat(self, dirfd: int, path_ptr: int, flags: int, mode: int):
+        path = self._path_arg(path_ptr)
+        if path is None:
+            return -errno.EFAULT
+        r = self.resolve(dirfd, path)
+        if r is None:
+            return RETRY_NATIVE
+        kind, tgt = r
+        if kind == "synth":
+            if flags & O_ACCMODE != 0 or flags & (O_CREAT | O_TRUNC):
+                return -EACCES  # synthesized files are read-only
+            vf = VFile(os.path.normpath(path), "", None, tgt, flags)
+            return self._install(vf, flags)
+        real = tgt  # host tree or worker-served native (both absolute)
+        acc = flags & O_ACCMODE
+        try:
+            st = os.lstat(real)
+            exists = True
+            isdir = statmod.S_ISDIR(st.st_mode)
+        except FileNotFoundError:
+            exists = False
+            isdir = False
+        if flags & O_DIRECTORY or (exists and isdir):
+            if not exists:
+                return -ENOENT
+            if not isdir:
+                return -ENOTDIR
+            if acc != 0:
+                return -EISDIR
+            dents = self._snapshot_dir(real)
+            vf = VFile(real, real, None, None, flags, is_dir=True,
+                       dents=dents)
+            return self._install(vf, flags)
+        if not exists and not (flags & O_CREAT):
+            return -ENOENT
+        if exists and (flags & O_CREAT) and (flags & O_EXCL):
+            return -EEXIST
+        try:
+            fd = os.open(real, flags & ~O_DIRECTORY, mode & 0o777 or 0o644)
+        except OSError as e:
+            return -e.errno
+        vf = VFile(real, real, fd, None, flags)
+        if flags & O_APPEND:
+            vf.off = os.fstat(fd).st_size
+        return self._install(vf, flags)
+
+    def _install(self, vf: VFile, flags: int) -> int:
+        from shadow_tpu.native.managed import VSocket
+
+        proc = self.proc
+        vfd = proc._next_vfd
+        proc._next_vfd += 1
+        vs = VSocket(vfd, "dir" if vf.is_dir else "file")
+        vs.vfile = vf
+        proc.fds[vfd] = vs
+        if flags & 0o2000000:  # O_CLOEXEC
+            proc.fd_cloexec.add(vfd)
+        return vfd
+
+    def _snapshot_dir(self, real: str):
+        try:
+            names = sorted(os.listdir(real))
+        except OSError as e:
+            return -e.errno
+        out = [(".", 4, _det_ino(real)),
+               ("..", 4, _det_ino(os.path.dirname(real) or "/"))]
+        for n in names:
+            full = real + "/" + n
+            try:
+                st = os.lstat(full)
+                dt = (4 if statmod.S_ISDIR(st.st_mode)
+                      else 10 if statmod.S_ISLNK(st.st_mode) else 8)
+            except OSError:
+                dt = 0
+            out.append((n, dt, _det_ino(full)))
+        return out
+
+    # -- fd ops (dispatched from managed.py on kind file/dir) ---------------
+    def read(self, vs, n: int) -> bytes | int:
+        vf = vs.vfile
+        if vf.is_dir:
+            return -EISDIR
+        if vf.data is not None:
+            chunk = vf.data[vf.off:vf.off + n]
+        else:
+            if vf.flags & O_ACCMODE == 0o1:  # O_WRONLY
+                return -EBADF
+            try:
+                chunk = os.pread(vf.fd, n, vf.off)
+            except OSError as e:
+                return -e.errno
+        vf.off += len(chunk)
+        return chunk
+
+    def write(self, vs, data: bytes) -> int:
+        vf = vs.vfile
+        if vf.is_dir or vf.data is not None:
+            return -EBADF
+        if vf.flags & O_ACCMODE == 0:  # O_RDONLY
+            return -EBADF
+        try:
+            if vf.flags & O_APPEND:
+                vf.off = os.fstat(vf.fd).st_size
+            k = os.pwrite(vf.fd, data, vf.off)
+        except OSError as e:
+            return -e.errno
+        vf.off += k
+        return k
+
+    def lseek(self, vs, off: int, whence: int) -> int:
+        vf = vs.vfile
+        if off >= 1 << 63:
+            off -= 1 << 64
+        if vf.is_dir:
+            # rewinddir/seekdir: d_off values are snapshot indices
+            if whence != 0 or off < 0:
+                return -EINVAL
+            vf.dent_pos = min(off, len(vf.dents)
+                              if isinstance(vf.dents, list) else 0)
+            vf.off = off
+            return off
+        if whence == 0:
+            new = off
+        elif whence == 1:
+            new = vf.off + off
+        elif whence == 2:
+            size = (len(vf.data) if vf.data is not None
+                    else os.fstat(vf.fd).st_size if vf.fd is not None
+                    else 0)
+            new = size + off
+        else:
+            return -EINVAL
+        if new < 0:
+            return -EINVAL
+        vf.off = new
+        return new
+
+    def fstat_bytes(self, vs) -> bytes:
+        vf = vs.vfile
+        if vf.data is not None:
+            return self._stat_bytes(vf.vpath, size=len(vf.data),
+                                    mode=statmod.S_IFREG | 0o444)
+        st = os.fstat(vf.fd) if vf.fd is not None else os.lstat(vf.path)
+        return self._stat_bytes(vf.vpath, size=st.st_size,
+                                mode=st.st_mode)
+
+    def getdents64(self, vs, bufsize: int) -> bytes | int:
+        vf = vs.vfile
+        if not vf.is_dir:
+            return -ENOTDIR
+        if isinstance(vf.dents, int):
+            return vf.dents
+        out = b""
+        while vf.dent_pos < len(vf.dents):
+            name, dt, ino = vf.dents[vf.dent_pos]
+            nb = name.encode()
+            reclen = (19 + len(nb) + 1 + 7) & ~7
+            if len(out) + reclen > bufsize:
+                break
+            vf.dent_pos += 1
+            rec = struct.pack("<QqHB", ino, vf.dent_pos, reclen, dt)
+            rec += nb + b"\0"
+            rec += b"\0" * (reclen - len(rec))
+            out += rec
+        return out
+
+    def close(self, vs) -> int:
+        vf = vs.vfile
+        if vf is not None and vf.fd is not None:
+            try:
+                os.close(vf.fd)
+            except OSError:
+                pass
+            vf.fd = None
+        return 0
+
+    def ftruncate(self, vs, length: int) -> int:
+        vf = vs.vfile
+        if vf.is_dir or vf.data is not None or vf.fd is None:
+            return -EBADF
+        try:
+            os.ftruncate(vf.fd, length)
+        except OSError as e:
+            return -e.errno
+        return 0
+
+    # -- path ops ------------------------------------------------------------
+    def _stat_bytes(self, vpath: str, size: int, mode: int) -> bytes:
+        """Deterministic struct stat (x86-64): sim-clock times, synthetic
+        dev/ino/uid, real size/mode."""
+        now = emulated(self.proc.host.now)
+        sec, nsec = now // NS_PER_SEC, now % NS_PER_SEC
+        st = bytearray(144)
+        struct.pack_into("<QQQ", st, 0, 42, _det_ino(vpath), 1)
+        struct.pack_into("<III", st, 24, mode, 1000, 1000)
+        struct.pack_into("<qqq", st, 40, 0, size, 4096)
+        struct.pack_into("<q", st, 64, (size + 511) // 512)
+        struct.pack_into("<qqqqqq", st, 72, sec, nsec, sec, nsec, sec, nsec)
+        return bytes(st)
+
+    def statat(self, dirfd: int, path_ptr: int, buf: int,
+               flags: int = 0) -> int:
+        path = self._path_arg(path_ptr)
+        if path is None:
+            return -errno.EFAULT
+        if path == "" and flags & AT_EMPTY_PATH:
+            vs = self.proc.fds.get(dirfd)
+            if vs is not None and vs.kind in ("file", "dir"):
+                self.proc.mem.write(buf, self.fstat_bytes(vs))
+                return 0
+            if vs is not None:  # socket/pipe/timer vfd: the fstat shape
+                return self.proc._fstat(dirfd, buf)
+            return RETRY_NATIVE
+        r = self.resolve(dirfd, path)
+        if r is None:
+            return RETRY_NATIVE
+        kind, tgt = r
+        if kind == "synth":
+            self.proc.mem.write(buf, self._stat_bytes(
+                os.path.normpath(path), len(tgt),
+                statmod.S_IFREG | 0o444))
+            return 0
+        try:
+            st = (os.lstat(tgt) if flags & AT_SYMLINK_NOFOLLOW
+                  else os.stat(tgt))
+        except OSError as e:
+            return -e.errno
+        self.proc.mem.write(buf, self._stat_bytes(tgt, st.st_size,
+                                                  st.st_mode))
+        return 0
+
+    def statx(self, dirfd: int, path_ptr: int, flags: int, buf: int) -> int:
+        """struct statx (256 bytes): same deterministic fields as stat."""
+        path = self._path_arg(path_ptr)
+        if path is None:
+            return -errno.EFAULT
+        if path == "" and flags & AT_EMPTY_PATH:
+            vs = self.proc.fds.get(dirfd)
+            if vs is None or vs.kind not in ("file", "dir"):
+                return RETRY_NATIVE
+            vf = vs.vfile
+            size = (len(vf.data) if vf.data is not None
+                    else os.fstat(vf.fd).st_size if vf.fd is not None
+                    else 0)
+            mode = (statmod.S_IFDIR | 0o755 if vf.is_dir
+                    else statmod.S_IFREG | 0o644)
+            self.proc.mem.write(buf, self._statx_bytes(vf.vpath, size,
+                                                       mode))
+            return 0
+        r = self.resolve(dirfd, path)
+        if r is None:
+            return RETRY_NATIVE
+        kind, tgt = r
+        if kind == "synth":
+            self.proc.mem.write(buf, self._statx_bytes(
+                os.path.normpath(path), len(tgt), statmod.S_IFREG | 0o444))
+            return 0
+        try:
+            st = (os.lstat(tgt) if flags & AT_SYMLINK_NOFOLLOW
+                  else os.stat(tgt))
+        except OSError as e:
+            return -e.errno
+        self.proc.mem.write(buf, self._statx_bytes(tgt, st.st_size,
+                                                   st.st_mode))
+        return 0
+
+    def _statx_bytes(self, vpath: str, size: int, mode: int) -> bytes:
+        now = emulated(self.proc.host.now)
+        sec, nsec = now // NS_PER_SEC, now % NS_PER_SEC
+        sx = bytearray(256)
+        struct.pack_into("<IIQ", sx, 0, 0xFFF, 4096, 0)  # mask, blksize
+        struct.pack_into("<IIIHxxQQQQ", sx, 16,
+                         1, 1000, 1000, mode & 0xFFFF,
+                         _det_ino(vpath), size, (size + 511) // 512, 0)
+        for off in (64, 80, 96, 112):  # btime/atime/ctime/mtime
+            struct.pack_into("<qI", sx, off, sec, nsec)
+        return bytes(sx)
+
+    def access(self, dirfd: int, path_ptr: int, mode: int) -> int:
+        path = self._path_arg(path_ptr)
+        if path is None:
+            return -errno.EFAULT
+        r = self.resolve(dirfd, path)
+        if r is None:
+            return RETRY_NATIVE
+        kind, tgt = r
+        if kind == "synth":
+            return 0 if not (mode & 2) else -EACCES  # W_OK denied
+        return 0 if os.path.exists(tgt) else -ENOENT
+
+    def unlinkat(self, dirfd: int, path_ptr: int, flags: int) -> int:
+        path = self._path_arg(path_ptr)
+        if path is None:
+            return -errno.EFAULT
+        r = self.resolve(dirfd, path)
+        if r is None:
+            return RETRY_NATIVE
+        kind, tgt = r
+        if kind == "synth":
+            return -EROFS
+        try:
+            if flags & 0x200:  # AT_REMOVEDIR
+                os.rmdir(tgt)
+            else:
+                os.unlink(tgt)
+        except OSError as e:
+            return -e.errno
+        return 0
+
+    def mkdirat(self, dirfd: int, path_ptr: int, mode: int) -> int:
+        path = self._path_arg(path_ptr)
+        if path is None:
+            return -errno.EFAULT
+        r = self.resolve(dirfd, path)
+        if r is None:
+            return RETRY_NATIVE
+        kind, tgt = r
+        if kind == "synth":
+            return -EEXIST
+        try:
+            os.mkdir(tgt, mode & 0o777)
+        except OSError as e:
+            return -e.errno
+        return 0
+
+    def renameat(self, olddirfd: int, old_ptr: int, newdirfd: int,
+                 new_ptr: int) -> int:
+        old = self._path_arg(old_ptr)
+        new = self._path_arg(new_ptr)
+        if old is None or new is None:
+            return -errno.EFAULT
+        ro = self.resolve(olddirfd, old)
+        rn = self.resolve(newdirfd, new)
+        if ro is None and rn is None:
+            return RETRY_NATIVE
+        if ro is None or rn is None or ro[0] == "synth" or rn[0] == "synth":
+            return -errno.EXDEV  # across the virtualization boundary
+        try:
+            os.rename(ro[1], rn[1])
+        except OSError as e:
+            return -e.errno
+        return 0
+
+    def readlinkat(self, dirfd: int, path_ptr: int, buf: int,
+                   bufsize: int) -> int:
+        path = self._path_arg(path_ptr)
+        if path is None:
+            return -errno.EFAULT
+        r = self.resolve(dirfd, path)
+        if r is None:
+            return RETRY_NATIVE
+        kind, tgt = r
+        if kind == "synth":
+            return -EINVAL  # not a symlink
+        try:
+            link = os.readlink(tgt)
+        except OSError as e:
+            return -e.errno
+        data = link.encode()[:bufsize]
+        self.proc.mem.write(buf, data)
+        return len(data)
+
+    def chdir(self, path_ptr: int) -> int:
+        """Purely virtual: the worker-tracked cwd is the only one that
+        matters (every relative path absolutizes against it in resolve),
+        so the real process's kernel cwd can stay stale."""
+        path = self._path_arg(path_ptr)
+        if path is None:
+            return -errno.EFAULT
+        r = self.resolve(AT_FDCWD, path)
+        if r is None:
+            tgt = os.path.normpath(path)
+        else:
+            kind, tgt = r
+            if kind == "synth":
+                return -ENOTDIR
+        if not os.path.isdir(tgt):
+            return -ENOENT if not os.path.exists(tgt) else -ENOTDIR
+        self.cwd = tgt
+        return 0
+
+    def fchdir(self, vs) -> int:
+        vf = vs.vfile
+        if vf is None or not vf.is_dir:
+            return -ENOTDIR
+        self.cwd = vf.path
+        return 0
+
+    def getcwd(self, buf: int, size: int) -> int:
+        data = self.cwd.encode() + b"\0"
+        if len(data) > size:
+            return -errno.ERANGE
+        self.proc.mem.write(buf, data)
+        return len(data)
+
+    def truncate(self, path_ptr: int, length: int) -> int:
+        path = self._path_arg(path_ptr)
+        if path is None:
+            return -errno.EFAULT
+        r = self.resolve(AT_FDCWD, path)
+        if r is None:
+            return RETRY_NATIVE
+        kind, tgt = r
+        if kind == "synth":
+            return -EACCES
+        try:
+            os.truncate(tgt, length)
+        except OSError as e:
+            return -e.errno
+        return 0

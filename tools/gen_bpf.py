@@ -11,7 +11,14 @@ from __future__ import annotations
 
 from pathlib import Path
 
-SYS = dict(read=0, write=1, close=3, fstat=5, poll=7, lseek=8,
+SYS = dict(read=0, write=1, open=2, close=3, stat=4, fstat=5, lstat=6,
+           poll=7, lseek=8,
+           access=21, getcwd=79, chdir=80, fchdir=81, rename=82, mkdir=83,
+           rmdir=84, creat=85, unlink=87, readlink=89, truncate=76,
+           ftruncate=77, fsync=74, fdatasync=75, getdents64=217,
+           openat=257, mkdirat=258, unlinkat=263, renameat=264,
+           readlinkat=267, faccessat=269, renameat2=316, statx=332,
+           faccessat2=439,
            rt_sigprocmask=14,
            ioctl=16, readv=19, writev=20, pipe=22, dup=32, dup2=33,
            nanosleep=35,
@@ -43,11 +50,24 @@ UNCONDITIONAL = [
     "rt_sigprocmask", "pipe", "pipe2", "wait4", "exit_group",
     "close_range", "select", "pselect6", "kill", "uname", "times",
     "clock_getres", "sched_getaffinity", "sysinfo", "getrusage",
+    # the virtual file surface: path-taking syscalls ALWAYS trap — the
+    # worker resolves the path against the per-host virtual FS and either
+    # emulates (host data dir, synthesized /etc files) or instructs a
+    # native re-issue through the gadget (system paths: /lib, /proc, ...)
+    "open", "openat", "creat", "stat", "lstat", "statx", "access",
+    "faccessat", "faccessat2", "newfstatat", "unlink", "unlinkat",
+    "mkdir", "mkdirat", "rmdir", "rename", "renameat", "renameat2",
+    "readlink", "readlinkat", "chdir", "getcwd", "truncate",
+    # dup2/dup3 trap ALWAYS: a native dup2 over a fd number that carries a
+    # VIRTUAL mapping (a shell restoring its saved stdout after `cmd >
+    # file`) must clear the worker's mapping or the two fd tables diverge
+    "dup2", "dup3",
 ]
 
 #: syscalls trapped only when arg0 is a virtual fd
-VFD_CONDITIONAL = ["ioctl", "fcntl", "dup", "dup2", "dup3",
-                   "fstat", "lseek", "newfstatat"]
+VFD_CONDITIONAL = ["ioctl", "fcntl", "dup",
+                   "fstat", "lseek", "getdents64", "ftruncate", "fsync",
+                   "fdatasync", "fchdir"]
 
 
 def build(audit: bool = False):
@@ -62,14 +82,16 @@ def build(audit: bool = False):
     prog: list = []
     prog.append(("LD_ARCH",))
     prog.append(("JEQ", "ARCH", None, "ALLOW"))
-    if audit:
-        # syscalls issued from the gadget page run natively; the kernel
-        # reports the IP AFTER the syscall insn, still inside the page
-        prog.append(("LD_IPHI",))
-        prog.append(("JEQ", "GADHI", None, "NRSTART"))
-        prog.append(("LD_IPLO",))
-        prog.append(("JGE", "GADLO", None, "NRSTART"))
-        prog.append(("JGE", "GADEND", "NRSTART", "ALLOW"))
+    # syscalls issued from the gadget page run natively in BOTH filters:
+    # the worker's RETRY_NATIVE sentinel makes the shim re-issue a trapped
+    # syscall through the gadget (virtual-FS passthrough), and audit mode
+    # additionally default-traps everything else. The kernel reports the
+    # IP AFTER the syscall insn, still inside the page.
+    prog.append(("LD_IPHI",))
+    prog.append(("JEQ", "GADHI", None, "NRSTART"))
+    prog.append(("LD_IPLO",))
+    prog.append(("JGE", "GADLO", None, "NRSTART"))
+    prog.append(("JGE", "GADEND", "NRSTART", "ALLOW"))
     labels0 = {}
     labels0["NRSTART"] = len(prog)
     prog.append(("LD_NR",))
@@ -93,10 +115,6 @@ def build(audit: bool = False):
     # thread-style clones run natively (pthread_create is interposed);
     # fork-style trap so the worker can reject them loudly
     prog.append(("JEQ", SYS["clone"], "CLONECHK", None))
-    # execve runs natively ONLY when envp is the shim's own patched array
-    # (the shim re-injects LD_PRELOAD/SHADOW_* and re-execs); any other
-    # execve traps so the worker can reject it
-    prog.append(("JEQ", SYS["execve"], "EXECCHK", None))
     prog.append(("JGE", SYS["socket"], None, A))
     prog.append(("JGE", SYS["clone_end"], A, "TRAP"))
     labels = labels0
@@ -116,9 +134,6 @@ def build(audit: bool = False):
     labels["CLONECHK"] = len(prog)
     prog += [("LD_A0",), ("JSET", CLONE_THREAD, "ALLOW", None),
              ("JSET", CLONE_IO, "ALLOW", "TRAP")]
-    labels["EXECCHK"] = len(prog)
-    prog += [("LD_A2LO",), ("JEQ", "EXECLO", None, "TRAP"),
-             ("LD_A2HI",), ("JEQ", "EXECHI", "ALLOW", "TRAP")]
     labels["CLOSECHK"] = len(prog)
     prog += [("LD_A0",), ("JGE", "IPCLOW", None, "VFDTAIL"),
              ("JGE", "IPCEND", "VFDTAIL", "TRAP")]
@@ -139,8 +154,6 @@ def build(audit: bool = False):
     def val(v):
         return {"ARCH": "AUDIT_ARCH_X86_64", "IPC": "SHIM_IPC_FD",
                 "IPCLOW": "SHIM_IPC_LOW", "IPCEND": "(SHIM_IPC_FD + 1)",
-                "EXECLO": "(uint32_t)(uintptr_t)SHIM_EXEC_ADDR",
-                "EXECHI": "(uint32_t)((uintptr_t)SHIM_EXEC_ADDR >> 32)",
                 "GADLO": "(uint32_t)(uintptr_t)SHIM_GADGET_ADDR",
                 "GADHI": "(uint32_t)((uintptr_t)SHIM_GADGET_ADDR >> 32)",
                 "GADEND": "((uint32_t)(uintptr_t)SHIM_GADGET_ADDR + 4096)",
