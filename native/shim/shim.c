@@ -52,7 +52,7 @@
 #include <unistd.h>
 
 #define SHIM_IPC_FD 995          /* worker dup2()s the socketpair here   */
-#define SHIM_IPC_LOW 964         /* per-thread channels live in [LOW, 995] */
+#define SHIM_IPC_LOW 932         /* per-thread channels live in [LOW, 995] */
 #define SHIM_VFD_BASE 0x100000   /* fds >= this are simulated sockets    */
 #define SHIM_HELLO 0xFFFFFFFFu
 /* thread-management pseudo-syscalls (worker analogs in native/managed.py) */
@@ -263,15 +263,17 @@ static long shim_do_fork(uint64_t nr, greg_t *g) {
   int64_t eid = -1;
   int newfd = shim_recv_fd(&eid);
   if (newfd < 0 || eid < 0) return -EAGAIN;
-  /* replay the clone with CLONE_IO or'd in: a benign marker the seccomp
-   * filter ALLOWs, so the shim's own fork doesn't re-trap (raw SYS_fork
-   * would); original ctid/ptid args are preserved for glibc's TCB fixup */
+  /* replay the clone through the GADGET (IP-allowed by both filters):
+   * the old CLONE_IO marker allowance is gone, so a guest can no longer
+   * mint an unmanaged child by setting that flag itself — every
+   * fork-style clone from guest code traps into this protocol. Original
+   * ctid/ptid args are preserved for glibc's TCB fixup. */
   long child;
   if (nr == SYS_clone)
-    child = raw5(SYS_clone, (long)(g[REG_RDI] | 0x80000000ul), (long)g[REG_RSI],
+    child = raw5(SYS_clone, (long)g[REG_RDI], (long)g[REG_RSI],
                  (long)g[REG_RDX], (long)g[REG_R10], (long)g[REG_R8]);
   else /* raw SYS_fork callers: synthesize fork-flavored clone flags */
-    child = raw5(SYS_clone, 0x80000000l | 17 /*SIGCHLD*/, 0, 0, 0, 0);
+    child = raw5(SYS_clone, 17 /*SIGCHLD*/, 0, 0, 0, 0);
   if (child < 0) {
     raw3(SYS_close, newfd, 0, 0);
     return child; /* worker-side embryo is reclaimed at process exit */
@@ -596,15 +598,15 @@ time_t time(time_t *out) {
  * wakeup channel: pthread_create is interposed; the worker mints a fresh
  * socketpair and hands the guest end back as SCM_RIGHTS ancillary data on
  * the SPAWN reply; the new thread pins it at a reserved fd (995 - slot,
- * inside the seccomp-allowed [964, 995] window), checks in with
+ * inside the seccomp-allowed [932, 995] window), checks in with
  * THREAD_HELLO (its reply is the first turn grant), runs the app start
  * routine, and announces THREAD_EXIT so joiners parked at the worker wake
  * in sim time. CLONE_THREAD clones run natively; futex is trapped and
  * emulated worker-side so lock handoffs between parked threads cannot
- * deadlock the turn-taking. Scope: up to 31 extra threads; raw clone(2)
+ * deadlock the turn-taking. Scope: up to 63 extra threads; raw clone(2)
  * users and fork are still rejected loudly. */
 
-#define SHIM_MAX_THREADS 32
+#define SHIM_MAX_THREADS 64
 struct shim_tramp { void *(*fn)(void *); void *arg; int fd; };
 static pthread_t shim_thread_ids[SHIM_MAX_THREADS]; /* slot -> pthread_t */
 
@@ -823,114 +825,113 @@ void pthread_exit(void *retval) {
 
 static int install_seccomp(void) {
   /* BEGIN GENERATED BPF (tools/gen_bpf.py) */
-  struct sock_filter prog[] = {  /* 115 instructions */
+  struct sock_filter prog[] = {  /* 114 instructions */
       LD(BPF_ARCHF),
-      JEQ(AUDIT_ARCH_X86_64, 0, 112),
+      JEQ(AUDIT_ARCH_X86_64, 0, 111),
       LD(BPF_IPHI),
       JEQ((uint32_t)((uintptr_t)SHIM_GADGET_ADDR >> 32), 0, 3),
       LD(BPF_IPLO),
       JGE((uint32_t)(uintptr_t)SHIM_GADGET_ADDR, 0, 1),
-      JGE(((uint32_t)(uintptr_t)SHIM_GADGET_ADDR + 4096), 0, 107),
+      JGE(((uint32_t)(uintptr_t)SHIM_GADGET_ADDR + 4096), 0, 106),
       LD(BPF_NR),
       JEQ(0, 82, 0),  /* read */
       JEQ(1, 86, 0),  /* write */
-      JEQ(3, 96, 0),  /* close */
+      JEQ(3, 95, 0),  /* close */
       JEQ(19, 79, 0),  /* readv */
       JEQ(20, 83, 0),  /* writev */
-      JEQ(16, 96, 0),  /* ioctl */
-      JEQ(72, 95, 0),  /* fcntl */
-      JEQ(32, 94, 0),  /* dup */
-      JEQ(5, 93, 0),  /* fstat */
-      JEQ(8, 92, 0),  /* lseek */
-      JEQ(217, 91, 0),  /* getdents64 */
-      JEQ(77, 90, 0),  /* ftruncate */
-      JEQ(74, 89, 0),  /* fsync */
-      JEQ(75, 88, 0),  /* fdatasync */
-      JEQ(81, 87, 0),  /* fchdir */
-      JEQ(35, 89, 0),  /* nanosleep */
-      JEQ(230, 88, 0),  /* clock_nanosleep */
-      JEQ(228, 87, 0),  /* clock_gettime */
-      JEQ(96, 86, 0),  /* gettimeofday */
-      JEQ(201, 85, 0),  /* time */
-      JEQ(318, 84, 0),  /* getrandom */
-      JEQ(7, 83, 0),  /* poll */
-      JEQ(271, 82, 0),  /* ppoll */
-      JEQ(213, 81, 0),  /* epoll_create */
-      JEQ(291, 80, 0),  /* epoll_create1 */
-      JEQ(233, 79, 0),  /* epoll_ctl */
-      JEQ(232, 78, 0),  /* epoll_wait */
-      JEQ(281, 77, 0),  /* epoll_pwait */
-      JEQ(288, 76, 0),  /* accept4 */
-      JEQ(435, 75, 0),  /* clone3 */
-      JEQ(39, 74, 0),  /* getpid */
-      JEQ(110, 73, 0),  /* getppid */
-      JEQ(186, 72, 0),  /* gettid */
-      JEQ(283, 71, 0),  /* timerfd_create */
-      JEQ(286, 70, 0),  /* timerfd_settime */
-      JEQ(287, 69, 0),  /* timerfd_gettime */
-      JEQ(284, 68, 0),  /* eventfd */
-      JEQ(290, 67, 0),  /* eventfd2 */
-      JEQ(202, 66, 0),  /* futex */
-      JEQ(14, 65, 0),  /* rt_sigprocmask */
-      JEQ(22, 64, 0),  /* pipe */
-      JEQ(293, 63, 0),  /* pipe2 */
-      JEQ(61, 62, 0),  /* wait4 */
-      JEQ(231, 61, 0),  /* exit_group */
-      JEQ(436, 60, 0),  /* close_range */
-      JEQ(23, 59, 0),  /* select */
-      JEQ(270, 58, 0),  /* pselect6 */
-      JEQ(62, 57, 0),  /* kill */
-      JEQ(63, 56, 0),  /* uname */
-      JEQ(100, 55, 0),  /* times */
-      JEQ(229, 54, 0),  /* clock_getres */
-      JEQ(204, 53, 0),  /* sched_getaffinity */
-      JEQ(99, 52, 0),  /* sysinfo */
-      JEQ(98, 51, 0),  /* getrusage */
-      JEQ(2, 50, 0),  /* open */
-      JEQ(257, 49, 0),  /* openat */
-      JEQ(85, 48, 0),  /* creat */
-      JEQ(4, 47, 0),  /* stat */
-      JEQ(6, 46, 0),  /* lstat */
-      JEQ(332, 45, 0),  /* statx */
-      JEQ(21, 44, 0),  /* access */
-      JEQ(269, 43, 0),  /* faccessat */
-      JEQ(439, 42, 0),  /* faccessat2 */
-      JEQ(262, 41, 0),  /* newfstatat */
-      JEQ(87, 40, 0),  /* unlink */
-      JEQ(263, 39, 0),  /* unlinkat */
-      JEQ(83, 38, 0),  /* mkdir */
-      JEQ(258, 37, 0),  /* mkdirat */
-      JEQ(84, 36, 0),  /* rmdir */
-      JEQ(82, 35, 0),  /* rename */
-      JEQ(264, 34, 0),  /* renameat */
-      JEQ(316, 33, 0),  /* renameat2 */
-      JEQ(89, 32, 0),  /* readlink */
-      JEQ(267, 31, 0),  /* readlinkat */
-      JEQ(80, 30, 0),  /* chdir */
-      JEQ(79, 29, 0),  /* getcwd */
-      JEQ(76, 28, 0),  /* truncate */
-      JEQ(33, 27, 0),  /* dup2 */
-      JEQ(292, 26, 0),  /* dup3 */
+      JEQ(16, 95, 0),  /* ioctl */
+      JEQ(72, 94, 0),  /* fcntl */
+      JEQ(32, 93, 0),  /* dup */
+      JEQ(5, 92, 0),  /* fstat */
+      JEQ(8, 91, 0),  /* lseek */
+      JEQ(217, 90, 0),  /* getdents64 */
+      JEQ(77, 89, 0),  /* ftruncate */
+      JEQ(74, 88, 0),  /* fsync */
+      JEQ(75, 87, 0),  /* fdatasync */
+      JEQ(81, 86, 0),  /* fchdir */
+      JEQ(35, 88, 0),  /* nanosleep */
+      JEQ(230, 87, 0),  /* clock_nanosleep */
+      JEQ(228, 86, 0),  /* clock_gettime */
+      JEQ(96, 85, 0),  /* gettimeofday */
+      JEQ(201, 84, 0),  /* time */
+      JEQ(318, 83, 0),  /* getrandom */
+      JEQ(7, 82, 0),  /* poll */
+      JEQ(271, 81, 0),  /* ppoll */
+      JEQ(213, 80, 0),  /* epoll_create */
+      JEQ(291, 79, 0),  /* epoll_create1 */
+      JEQ(233, 78, 0),  /* epoll_ctl */
+      JEQ(232, 77, 0),  /* epoll_wait */
+      JEQ(281, 76, 0),  /* epoll_pwait */
+      JEQ(288, 75, 0),  /* accept4 */
+      JEQ(435, 74, 0),  /* clone3 */
+      JEQ(39, 73, 0),  /* getpid */
+      JEQ(110, 72, 0),  /* getppid */
+      JEQ(186, 71, 0),  /* gettid */
+      JEQ(283, 70, 0),  /* timerfd_create */
+      JEQ(286, 69, 0),  /* timerfd_settime */
+      JEQ(287, 68, 0),  /* timerfd_gettime */
+      JEQ(284, 67, 0),  /* eventfd */
+      JEQ(290, 66, 0),  /* eventfd2 */
+      JEQ(202, 65, 0),  /* futex */
+      JEQ(14, 64, 0),  /* rt_sigprocmask */
+      JEQ(22, 63, 0),  /* pipe */
+      JEQ(293, 62, 0),  /* pipe2 */
+      JEQ(61, 61, 0),  /* wait4 */
+      JEQ(231, 60, 0),  /* exit_group */
+      JEQ(436, 59, 0),  /* close_range */
+      JEQ(23, 58, 0),  /* select */
+      JEQ(270, 57, 0),  /* pselect6 */
+      JEQ(62, 56, 0),  /* kill */
+      JEQ(63, 55, 0),  /* uname */
+      JEQ(100, 54, 0),  /* times */
+      JEQ(229, 53, 0),  /* clock_getres */
+      JEQ(204, 52, 0),  /* sched_getaffinity */
+      JEQ(99, 51, 0),  /* sysinfo */
+      JEQ(98, 50, 0),  /* getrusage */
+      JEQ(2, 49, 0),  /* open */
+      JEQ(257, 48, 0),  /* openat */
+      JEQ(85, 47, 0),  /* creat */
+      JEQ(4, 46, 0),  /* stat */
+      JEQ(6, 45, 0),  /* lstat */
+      JEQ(332, 44, 0),  /* statx */
+      JEQ(21, 43, 0),  /* access */
+      JEQ(269, 42, 0),  /* faccessat */
+      JEQ(439, 41, 0),  /* faccessat2 */
+      JEQ(262, 40, 0),  /* newfstatat */
+      JEQ(87, 39, 0),  /* unlink */
+      JEQ(263, 38, 0),  /* unlinkat */
+      JEQ(83, 37, 0),  /* mkdir */
+      JEQ(258, 36, 0),  /* mkdirat */
+      JEQ(84, 35, 0),  /* rmdir */
+      JEQ(82, 34, 0),  /* rename */
+      JEQ(264, 33, 0),  /* renameat */
+      JEQ(316, 32, 0),  /* renameat2 */
+      JEQ(89, 31, 0),  /* readlink */
+      JEQ(267, 30, 0),  /* readlinkat */
+      JEQ(80, 29, 0),  /* chdir */
+      JEQ(79, 28, 0),  /* getcwd */
+      JEQ(76, 27, 0),  /* truncate */
+      JEQ(33, 26, 0),  /* dup2 */
+      JEQ(292, 25, 0),  /* dup3 */
       JEQ(47, 13, 0),  /* recvmsg */
       JEQ(56, 15, 0),  /* clone */
-      JGE(41, 0, 24),  /* socket */
-      JGE(60, 23, 22),  /* clone_end */
+      JGE(41, 0, 23),  /* socket */
+      JGE(60, 22, 21),  /* clone_end */
       LD(BPF_ARG0),
       JGE(SHIM_IPC_LOW, 0, 1),
-      JGE((SHIM_IPC_FD + 1), 0, 20),
-      JEQ(0, 18, 0),  /* read */
-      JGE(SHIM_VFD_BASE, 17, 18),
+      JGE((SHIM_IPC_FD + 1), 0, 19),
+      JEQ(0, 17, 0),  /* read */
+      JGE(SHIM_VFD_BASE, 16, 17),
       LD(BPF_ARG0),
       JGE(SHIM_IPC_LOW, 0, 1),
-      JGE((SHIM_IPC_FD + 1), 0, 15),
-      JGE(3, 0, 13),  /* close */
-      JGE(SHIM_VFD_BASE, 12, 13),
+      JGE((SHIM_IPC_FD + 1), 0, 14),
+      JGE(3, 0, 12),  /* close */
+      JGE(SHIM_VFD_BASE, 11, 12),
       LD(BPF_ARG0),
-      JGE(SHIM_IPC_LOW, 0, 10),
-      JGE((SHIM_IPC_FD + 1), 9, 10),
+      JGE(SHIM_IPC_LOW, 0, 9),
+      JGE((SHIM_IPC_FD + 1), 8, 9),
       LD(BPF_ARG0),
-      JSET(65536, 8, 0),  /* CLONE_THREAD */
-      JSET(2147483648, 7, 6),  /* CLONE_IO (shim fork replay) */
+      JSET(65536, 7, 6),  /* CLONE_THREAD */
       LD(BPF_ARG0),
       JGE(SHIM_IPC_LOW, 0, 2),
       JGE((SHIM_IPC_FD + 1), 1, 3),
@@ -940,115 +941,114 @@ static int install_seccomp(void) {
       RET(SECCOMP_RET_TRAP),
       RET(SECCOMP_RET_ALLOW),
   };
-  struct sock_filter prog_audit[] = {  /* 116 instructions */
+  struct sock_filter prog_audit[] = {  /* 115 instructions */
       LD(BPF_ARCHF),
-      JEQ(AUDIT_ARCH_X86_64, 0, 113),
+      JEQ(AUDIT_ARCH_X86_64, 0, 112),
       LD(BPF_IPHI),
       JEQ((uint32_t)((uintptr_t)SHIM_GADGET_ADDR >> 32), 0, 3),
       LD(BPF_IPLO),
       JGE((uint32_t)(uintptr_t)SHIM_GADGET_ADDR, 0, 1),
-      JGE(((uint32_t)(uintptr_t)SHIM_GADGET_ADDR + 4096), 0, 108),
+      JGE(((uint32_t)(uintptr_t)SHIM_GADGET_ADDR + 4096), 0, 107),
       LD(BPF_NR),
-      JEQ(15, 106, 0),
+      JEQ(15, 105, 0),
       JEQ(0, 82, 0),  /* read */
       JEQ(1, 86, 0),  /* write */
-      JEQ(3, 96, 0),  /* close */
+      JEQ(3, 95, 0),  /* close */
       JEQ(19, 79, 0),  /* readv */
       JEQ(20, 83, 0),  /* writev */
-      JEQ(16, 96, 0),  /* ioctl */
-      JEQ(72, 95, 0),  /* fcntl */
-      JEQ(32, 94, 0),  /* dup */
-      JEQ(5, 93, 0),  /* fstat */
-      JEQ(8, 92, 0),  /* lseek */
-      JEQ(217, 91, 0),  /* getdents64 */
-      JEQ(77, 90, 0),  /* ftruncate */
-      JEQ(74, 89, 0),  /* fsync */
-      JEQ(75, 88, 0),  /* fdatasync */
-      JEQ(81, 87, 0),  /* fchdir */
-      JEQ(35, 89, 0),  /* nanosleep */
-      JEQ(230, 88, 0),  /* clock_nanosleep */
-      JEQ(228, 87, 0),  /* clock_gettime */
-      JEQ(96, 86, 0),  /* gettimeofday */
-      JEQ(201, 85, 0),  /* time */
-      JEQ(318, 84, 0),  /* getrandom */
-      JEQ(7, 83, 0),  /* poll */
-      JEQ(271, 82, 0),  /* ppoll */
-      JEQ(213, 81, 0),  /* epoll_create */
-      JEQ(291, 80, 0),  /* epoll_create1 */
-      JEQ(233, 79, 0),  /* epoll_ctl */
-      JEQ(232, 78, 0),  /* epoll_wait */
-      JEQ(281, 77, 0),  /* epoll_pwait */
-      JEQ(288, 76, 0),  /* accept4 */
-      JEQ(435, 75, 0),  /* clone3 */
-      JEQ(39, 74, 0),  /* getpid */
-      JEQ(110, 73, 0),  /* getppid */
-      JEQ(186, 72, 0),  /* gettid */
-      JEQ(283, 71, 0),  /* timerfd_create */
-      JEQ(286, 70, 0),  /* timerfd_settime */
-      JEQ(287, 69, 0),  /* timerfd_gettime */
-      JEQ(284, 68, 0),  /* eventfd */
-      JEQ(290, 67, 0),  /* eventfd2 */
-      JEQ(202, 66, 0),  /* futex */
-      JEQ(14, 65, 0),  /* rt_sigprocmask */
-      JEQ(22, 64, 0),  /* pipe */
-      JEQ(293, 63, 0),  /* pipe2 */
-      JEQ(61, 62, 0),  /* wait4 */
-      JEQ(231, 61, 0),  /* exit_group */
-      JEQ(436, 60, 0),  /* close_range */
-      JEQ(23, 59, 0),  /* select */
-      JEQ(270, 58, 0),  /* pselect6 */
-      JEQ(62, 57, 0),  /* kill */
-      JEQ(63, 56, 0),  /* uname */
-      JEQ(100, 55, 0),  /* times */
-      JEQ(229, 54, 0),  /* clock_getres */
-      JEQ(204, 53, 0),  /* sched_getaffinity */
-      JEQ(99, 52, 0),  /* sysinfo */
-      JEQ(98, 51, 0),  /* getrusage */
-      JEQ(2, 50, 0),  /* open */
-      JEQ(257, 49, 0),  /* openat */
-      JEQ(85, 48, 0),  /* creat */
-      JEQ(4, 47, 0),  /* stat */
-      JEQ(6, 46, 0),  /* lstat */
-      JEQ(332, 45, 0),  /* statx */
-      JEQ(21, 44, 0),  /* access */
-      JEQ(269, 43, 0),  /* faccessat */
-      JEQ(439, 42, 0),  /* faccessat2 */
-      JEQ(262, 41, 0),  /* newfstatat */
-      JEQ(87, 40, 0),  /* unlink */
-      JEQ(263, 39, 0),  /* unlinkat */
-      JEQ(83, 38, 0),  /* mkdir */
-      JEQ(258, 37, 0),  /* mkdirat */
-      JEQ(84, 36, 0),  /* rmdir */
-      JEQ(82, 35, 0),  /* rename */
-      JEQ(264, 34, 0),  /* renameat */
-      JEQ(316, 33, 0),  /* renameat2 */
-      JEQ(89, 32, 0),  /* readlink */
-      JEQ(267, 31, 0),  /* readlinkat */
-      JEQ(80, 30, 0),  /* chdir */
-      JEQ(79, 29, 0),  /* getcwd */
-      JEQ(76, 28, 0),  /* truncate */
-      JEQ(33, 27, 0),  /* dup2 */
-      JEQ(292, 26, 0),  /* dup3 */
+      JEQ(16, 95, 0),  /* ioctl */
+      JEQ(72, 94, 0),  /* fcntl */
+      JEQ(32, 93, 0),  /* dup */
+      JEQ(5, 92, 0),  /* fstat */
+      JEQ(8, 91, 0),  /* lseek */
+      JEQ(217, 90, 0),  /* getdents64 */
+      JEQ(77, 89, 0),  /* ftruncate */
+      JEQ(74, 88, 0),  /* fsync */
+      JEQ(75, 87, 0),  /* fdatasync */
+      JEQ(81, 86, 0),  /* fchdir */
+      JEQ(35, 88, 0),  /* nanosleep */
+      JEQ(230, 87, 0),  /* clock_nanosleep */
+      JEQ(228, 86, 0),  /* clock_gettime */
+      JEQ(96, 85, 0),  /* gettimeofday */
+      JEQ(201, 84, 0),  /* time */
+      JEQ(318, 83, 0),  /* getrandom */
+      JEQ(7, 82, 0),  /* poll */
+      JEQ(271, 81, 0),  /* ppoll */
+      JEQ(213, 80, 0),  /* epoll_create */
+      JEQ(291, 79, 0),  /* epoll_create1 */
+      JEQ(233, 78, 0),  /* epoll_ctl */
+      JEQ(232, 77, 0),  /* epoll_wait */
+      JEQ(281, 76, 0),  /* epoll_pwait */
+      JEQ(288, 75, 0),  /* accept4 */
+      JEQ(435, 74, 0),  /* clone3 */
+      JEQ(39, 73, 0),  /* getpid */
+      JEQ(110, 72, 0),  /* getppid */
+      JEQ(186, 71, 0),  /* gettid */
+      JEQ(283, 70, 0),  /* timerfd_create */
+      JEQ(286, 69, 0),  /* timerfd_settime */
+      JEQ(287, 68, 0),  /* timerfd_gettime */
+      JEQ(284, 67, 0),  /* eventfd */
+      JEQ(290, 66, 0),  /* eventfd2 */
+      JEQ(202, 65, 0),  /* futex */
+      JEQ(14, 64, 0),  /* rt_sigprocmask */
+      JEQ(22, 63, 0),  /* pipe */
+      JEQ(293, 62, 0),  /* pipe2 */
+      JEQ(61, 61, 0),  /* wait4 */
+      JEQ(231, 60, 0),  /* exit_group */
+      JEQ(436, 59, 0),  /* close_range */
+      JEQ(23, 58, 0),  /* select */
+      JEQ(270, 57, 0),  /* pselect6 */
+      JEQ(62, 56, 0),  /* kill */
+      JEQ(63, 55, 0),  /* uname */
+      JEQ(100, 54, 0),  /* times */
+      JEQ(229, 53, 0),  /* clock_getres */
+      JEQ(204, 52, 0),  /* sched_getaffinity */
+      JEQ(99, 51, 0),  /* sysinfo */
+      JEQ(98, 50, 0),  /* getrusage */
+      JEQ(2, 49, 0),  /* open */
+      JEQ(257, 48, 0),  /* openat */
+      JEQ(85, 47, 0),  /* creat */
+      JEQ(4, 46, 0),  /* stat */
+      JEQ(6, 45, 0),  /* lstat */
+      JEQ(332, 44, 0),  /* statx */
+      JEQ(21, 43, 0),  /* access */
+      JEQ(269, 42, 0),  /* faccessat */
+      JEQ(439, 41, 0),  /* faccessat2 */
+      JEQ(262, 40, 0),  /* newfstatat */
+      JEQ(87, 39, 0),  /* unlink */
+      JEQ(263, 38, 0),  /* unlinkat */
+      JEQ(83, 37, 0),  /* mkdir */
+      JEQ(258, 36, 0),  /* mkdirat */
+      JEQ(84, 35, 0),  /* rmdir */
+      JEQ(82, 34, 0),  /* rename */
+      JEQ(264, 33, 0),  /* renameat */
+      JEQ(316, 32, 0),  /* renameat2 */
+      JEQ(89, 31, 0),  /* readlink */
+      JEQ(267, 30, 0),  /* readlinkat */
+      JEQ(80, 29, 0),  /* chdir */
+      JEQ(79, 28, 0),  /* getcwd */
+      JEQ(76, 27, 0),  /* truncate */
+      JEQ(33, 26, 0),  /* dup2 */
+      JEQ(292, 25, 0),  /* dup3 */
       JEQ(47, 13, 0),  /* recvmsg */
       JEQ(56, 15, 0),  /* clone */
-      JGE(41, 0, 23),  /* socket */
-      JGE(60, 22, 22),  /* clone_end */
+      JGE(41, 0, 22),  /* socket */
+      JGE(60, 21, 21),  /* clone_end */
       LD(BPF_ARG0),
       JGE(SHIM_IPC_LOW, 0, 1),
-      JGE((SHIM_IPC_FD + 1), 0, 20),
-      JEQ(0, 18, 0),  /* read */
-      JGE(SHIM_VFD_BASE, 17, 17),
+      JGE((SHIM_IPC_FD + 1), 0, 19),
+      JEQ(0, 17, 0),  /* read */
+      JGE(SHIM_VFD_BASE, 16, 16),
       LD(BPF_ARG0),
       JGE(SHIM_IPC_LOW, 0, 1),
-      JGE((SHIM_IPC_FD + 1), 0, 15),
-      JGE(3, 0, 13),  /* close */
-      JGE(SHIM_VFD_BASE, 12, 12),
+      JGE((SHIM_IPC_FD + 1), 0, 14),
+      JGE(3, 0, 12),  /* close */
+      JGE(SHIM_VFD_BASE, 11, 11),
       LD(BPF_ARG0),
-      JGE(SHIM_IPC_LOW, 0, 10),
-      JGE((SHIM_IPC_FD + 1), 9, 10),
+      JGE(SHIM_IPC_LOW, 0, 9),
+      JGE((SHIM_IPC_FD + 1), 8, 9),
       LD(BPF_ARG0),
-      JSET(65536, 8, 0),  /* CLONE_THREAD */
-      JSET(2147483648, 7, 6),  /* CLONE_IO (shim fork replay) */
+      JSET(65536, 7, 6),  /* CLONE_THREAD */
       LD(BPF_ARG0),
       JGE(SHIM_IPC_LOW, 0, 2),
       JGE((SHIM_IPC_FD + 1), 1, 3),
@@ -1112,12 +1112,12 @@ __attribute__((constructor)) static void shim_init(void) {
   if (sigaction(SIGSEGV, &tsa, NULL) == 0)
     prctl(PR_SET_TSC, PR_TSC_SIGSEGV, 0, 0, 0);
 
-  /* audit mode needs the gadget page (mapped at ctor start) */
+  /* the gadget is now LOAD-BEARING (fork replay, RETRY_NATIVE
+   * re-issues, audit): without it those paths would re-trap and corrupt
+   * the worker protocol — fail loudly instead of running degraded */
+  if (shim_gadget == NULL) _exit(122);
   const char *audit = getenv("SHADOW_AUDIT");
   shim_audit_on = audit && audit[0] == '1';
-  if (shim_audit_on && shim_gadget == NULL)
-    _exit(122); /* audit requested but no gadget: fail loudly, never run
-                   an unobserved simulation the config asked to observe */
 
   shim_active = 1;
   shim_tls_ready = 1;

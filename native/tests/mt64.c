@@ -1,0 +1,33 @@
+/* mt64 — 48 concurrent pthreads (beyond the old 31-slot channel window):
+ * each sleeps a staggered sim duration and bumps a counter under a mutex.
+ * Dual-run: native Linux oracle + managed (worker-emulated futexes, one
+ * channel per thread in the widened [932, 995] fd window). */
+#include <pthread.h>
+#include <stdio.h>
+#include <time.h>
+
+#define N 48
+static int done;
+static pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
+
+static void *worker(void *arg) {
+  long i = (long)arg;
+  struct timespec ts = {0, (long)(1000000 * (1 + i % 7))};
+  nanosleep(&ts, NULL);
+  pthread_mutex_lock(&mu);
+  done++;
+  pthread_mutex_unlock(&mu);
+  return NULL;
+}
+
+int main(void) {
+  pthread_t th[N];
+  for (long i = 0; i < N; i++)
+    if (pthread_create(&th[i], NULL, worker, (void *)i) != 0) {
+      fprintf(stderr, "create %ld failed\n", i);
+      return 1;
+    }
+  for (int i = 0; i < N; i++) pthread_join(th[i], NULL);
+  printf("mt64 done=%d\n", done);
+  return done == N ? 0 : 1;
+}

@@ -1,0 +1,28 @@
+/* thread_exec — execve from a NON-MAIN thread (the old magic-envp exec
+ * only supported the main thread; the worker-mediated respawn supports
+ * any): a pthread exec's the given program, replacing the whole process. */
+#include <pthread.h>
+#include <stdio.h>
+#include <unistd.h>
+
+static char **g_argv;
+
+static void *execer(void *arg) {
+  (void)arg;
+  execv(g_argv[1], g_argv + 1);
+  perror("execv");
+  _exit(127);
+}
+
+int main(int argc, char **argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <path> [args...]\n", argv[0]);
+    return 2;
+  }
+  g_argv = argv;
+  pthread_t th;
+  pthread_create(&th, NULL, execer, NULL);
+  pthread_join(th, NULL);  /* never returns: exec replaces the process */
+  fprintf(stderr, "exec did not happen\n");
+  return 1;
+}

@@ -246,6 +246,23 @@ def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
 
 
 def load_config(path: str, overrides: Optional[dict] = None) -> ConfigOptions:
+    import os
+
     with open(path, "r") as f:
         doc = yaml.safe_load(f)
-    return parse_config(doc, overrides)
+    cfg = parse_config(doc, overrides)
+    # a network.graph file reference resolves relative to the CONFIG file
+    # (the reference convention; lets committed configs carry committed
+    # topology fixtures)
+    g = cfg.network.get("graph", {})
+    f = g.get("file")
+    fpath = f.get("path") if isinstance(f, dict) else f
+    if fpath and not os.path.isabs(fpath):
+        resolved = os.path.join(os.path.dirname(os.path.abspath(path)),
+                                fpath)
+        if os.path.exists(resolved):
+            if isinstance(f, dict):
+                f["path"] = resolved
+            else:
+                g["file"] = resolved
+    return cfg

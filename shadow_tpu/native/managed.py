@@ -42,7 +42,7 @@ from shadow_tpu.native.memory import ProcessMemory
 from shadow_tpu.native.vfs import RETRY_NATIVE, HostVFS
 
 SHIM_IPC_FD = 995
-IPC_LOW = 964  # per-thread channel window [IPC_LOW, SHIM_IPC_FD]
+IPC_LOW = 932  # per-thread channel window [IPC_LOW, SHIM_IPC_FD]
 VFD_BASE = 0x100000
 HELLO = 0xFFFFFFFF
 # thread-management pseudo-syscalls (shim-side analogs in native/shim/shim.c)
@@ -90,7 +90,7 @@ _TERM_SIGS = ({1, 2, 3, 4, 5, 6, 7, 8, 10, 11, 12, 13, 14, 15, 16,
                24, 25, 26, 27, 29, 30, 31} | set(range(34, 65)))
 _IGN_SIGS = {17, 23, 28}  # CHLD URG WINCH: default-ignore
 WNOHANG, ECHILD, ESRCH = 1, 10, 3
-MAX_THREADS = 32           # slots 1..31 map to shim fds 994..964
+MAX_THREADS = 64           # slots 1..63 map to shim fds 994..932
 SYS_futex = 202
 FUTEX_WAIT, FUTEX_WAKE, FUTEX_REQUEUE, FUTEX_CMP_REQUEUE = 0, 1, 3, 4
 FUTEX_WAKE_OP, FUTEX_WAIT_BITSET, FUTEX_WAKE_BITSET = 5, 9, 10

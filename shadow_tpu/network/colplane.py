@@ -23,14 +23,18 @@ represents traffic as batch-level data end-to-end:
   passes, then ALL accumulated batches resolve in ONE threefry call —
   flags are pure functions of unit identity, so resolving early is
   result-identical. Device-routed batches read back asynchronously.
-- **Resolved rows live in per-destination pending lists** on the hosts
-  themselves, with a global head-heap of (time, host) marking when each
-  host next has deliverable traffic. Extraction is just popping the due
-  heads and flagging those hosts runnable; each host's event loop merges
-  its pending rows with its timer heap by (time, band, key) — the same
-  canonical order the per-unit plane produces (core/events.py BAND_NET) —
-  and charges the ingress token bucket per row at dispatch time, in event
-  order.
+- **Resolved rows live in a sorted pending store**: each flushed batch
+  becomes a (time, key)-sorted row list; every round the engine extracts
+  the due prefixes (bisect), buckets them per destination host (TimSort
+  merges the few overlapping runs), and each host's event loop merges its
+  inbox with its timer heap by (time, band, key) — the same canonical
+  order the per-unit plane produces (core/events.py BAND_NET) — charging
+  the ingress token bucket per row at dispatch time, in event order.
+- **The mesh plane (tpu_mesh) rides the same machinery**: the whole round
+  (departures, draws, all_to_all arrival exchange, pmin barrier) runs as
+  one sharded XLA program per chunk; exchange tables stream back
+  asynchronously and materialize at the g_min barrier, and blackholed
+  units charge their buckets device-side without producing arrivals.
 
 Equivalence argument (why the two planes cannot diverge): unit identity
 (uids), event keys, egress-bucket charge order, ingress charge order, and
@@ -300,6 +304,28 @@ class ColumnarPlane(DeviceRoutedPlane):
             self._barrier_vector(rows, segs, round_start, round_end, uids_l)
         self.phase_wall["barrier"] += _walltime.perf_counter() - t0
 
+    def _mesh_dispatch(self, mesh_full, round_start: SimTime):
+        """Chunk the FULL (pre-blackhole-filter) batch through the mesh
+        round program; returns (device tables, earliest-arrival deadline).
+        Sequential chunks at one t_now advance the device bucket state
+        exactly like a single batched call (per-source FIFO preserved by
+        chunking in emission order)."""
+        ups = self.mesh_plane.units_per_shard
+        fs, fd, fsz, fte, fu, frk = mesh_full
+        parts = []
+        deadline = T_NEVER
+        for i in range(0, len(fs), ups):
+            j = min(len(fs), i + ups)
+            recv_dev, gmin = self.mesh_plane.round_step_async(
+                self.mesh_plane.shard_units(
+                    fs[i:j], fd[i:j], fsz[i:j], fte[i:j], fu[i:j],
+                    frk[i:j]),
+                t_now=int(round_start))
+            parts.append(recv_dev)
+            if gmin < deadline:
+                deadline = gmin
+        return parts, deadline
+
     # -- scalar barrier (exact twin of the vector math, for tiny rounds) ---
     def _barrier_scalar(self, rows, segs, round_start: SimTime,
                         round_end: SimTime, uids_l=None) -> None:
@@ -416,50 +442,29 @@ class ColumnarPlane(DeviceRoutedPlane):
         reach = lat < INF_I64
         n_bh = n - int(reach.sum())
         keep_rows = rows
+        if use_mesh:
+            # the DEVICE buckets must be charged for blackholed units too
+            # (host planes charge before the reach filter): feed the FULL
+            # batch with routable flags, consume results for survivors
+            mesh_full = (src, dst, size, t_emit, uid.astype(np.int64),
+                         reach.astype(np.int64))
         if n_bh:
-            if use_mesh:
-                # unreachable routes never charge the DEVICE buckets, but
-                # host planes charge theirs before the reach filter —
-                # results would diverge. Surface it instead of drifting.
-                raise ValueError(
-                    "scheduler_policy tpu_mesh requires fully-routable "
-                    f"topologies ({n_bh} units have no route)")
             self.units_blackholed += n_bh
             keep = np.flatnonzero(reach)
             kl = keep.tolist()
             keep_rows = [rows[i] for i in kl]
             src, dst, sn, dn = src[keep], dst[keep], sn[keep], dn[keep]
-            depart, lat = depart[keep], lat[keep]
+            lat = lat[keep]
+            if depart is not None:
+                depart = depart[keep]
             size, t_emit, uid = size[keep], t_emit[keep], uid[keep]
             n = len(kl)
             if n == 0:
+                if use_mesh:
+                    # charge-only dispatch: every unit was unroutable
+                    self._mesh_dispatch(mesh_full, round_start)
                 return
 
-        if use_mesh:
-            from shadow_tpu.parallel.mesh import F_FLAGS, F_TARR, F_UID
-
-            uid_i64 = uid.astype(np.int64)
-            ups = self.mesh_plane.units_per_shard
-            arrival = np.empty(n, dtype=np.int64)
-            mesh_flags = np.empty(n, dtype=bool)
-            sz32 = size.astype(np.int32)
-            for i in range(0, n, ups):
-                j = min(n, i + ups)
-                received, _gmin, _cnt = self.mesh_plane.round_step(
-                    self.mesh_plane.shard_units(
-                        src[i:j], dst[i:j], sz32[i:j], t_emit[i:j],
-                        uid_i64[i:j]),
-                    t_now=int(round_start))
-                tab = received.reshape(-1, received.shape[-1])
-                tab = tab[tab[:, F_FLAGS] >= 2]  # valid rows
-                order = np.argsort(tab[:, F_UID])
-                tab = tab[order]
-                idx = np.searchsorted(tab[:, F_UID], uid_i64[i:j])
-                arrival[i:j] = tab[idx, F_TARR]
-                mesh_flags[i:j] = (tab[idx, F_FLAGS] & 1).astype(bool)
-        else:
-            mesh_flags = None
-            arrival = depart + lat
         ml = int(lat.min())
         if ml < self.min_used_latency:
             self.min_used_latency = ml
@@ -479,15 +484,19 @@ class ColumnarPlane(DeviceRoutedPlane):
             if not any(forced):
                 forced = None
 
-        arrival_l = arrival.tolist()
-        if mesh_flags is not None:
-            flags = mesh_flags
-            if forced is not None:
-                flags = flags | np.array(forced, dtype=bool)
-            self._store_resolved(keep_rows, src_l, arrival_l, keys_l,
-                                 flags.tolist() if flags.any() else None,
-                                 round_end)
+        if use_mesh:
+            # dispatch the whole-round sharded program per chunk; bucket
+            # state advances on device, the exchange tables stream back in
+            # the background and materialize at the g_min barrier (the
+            # causal deadline) like the single-chip plane's draw batches
+            parts, deadline = self._mesh_dispatch(mesh_full, round_start)
+            handle = _MeshHandle(parts, uid.astype(np.int64))
+            self.outstanding.append(_Outstanding(
+                keep_rows, src_l, None, keys_l, None, None, None, None,
+                forced, round_end, max(round_end, deadline), handle))
             return
+        arrival = depart + lat
+        arrival_l = arrival.tolist()
 
         live = bool((thresh > 0).any())
         use_device = (self.device is not None and live
@@ -562,6 +571,10 @@ class ColumnarPlane(DeviceRoutedPlane):
             if b.handle is None:
                 flags = next(it)
                 flags_l = flags.tolist() if flags.any() else None
+            elif isinstance(b.handle, _MeshHandle):
+                arrival_a, mflags = b.handle.read()
+                b.arrival = arrival_a.tolist()
+                flags_l = mflags.tolist() if mflags.any() else None
             else:
                 r0 = _walltime.perf_counter()
                 flags = b.handle.read()
@@ -636,6 +649,30 @@ class ColumnarPlane(DeviceRoutedPlane):
         if out:
             out.sort(key=_row_tk)
             self.pending.append(StoreBatch(out))
+
+
+class _MeshHandle:
+    """In-flight mesh-round exchange tables: read() materializes them and
+    yields per-unit (arrival, dropped) for the surviving uids."""
+
+    __slots__ = ("parts", "uids")
+
+    def __init__(self, parts, uids):
+        self.parts = parts  # device arrays, host copies streaming
+        self.uids = uids  # (n,) int64, batch order (post blackhole filter)
+
+    def read(self):
+        from shadow_tpu.parallel.mesh import F_FLAGS, F_TARR, F_UID
+
+        tabs = []
+        for r in self.parts:
+            t = np.asarray(r).reshape(-1, r.shape[-1])
+            tabs.append(t[t[:, F_FLAGS] >= 2])  # valid rows only
+        tab = np.concatenate(tabs) if len(tabs) > 1 else tabs[0]
+        order = np.argsort(tab[:, F_UID])
+        tab = tab[order]
+        idx = np.searchsorted(tab[:, F_UID], self.uids)
+        return tab[idx, F_TARR], (tab[idx, F_FLAGS] & 1).astype(bool)
 
 
 class _RowView:

@@ -167,12 +167,8 @@ class NetworkEngine(DeviceRoutedPlane):
         src = np.fromiter((u.src for u in units), dtype=np.int32, count=n)
         size = np.fromiter((u.size for u in units), dtype=np.int32, count=n)
         t_emit = np.fromiter((u.t_emit for u in units), dtype=np.int64, count=n)
-        use_mesh = (self.mesh_plane is not None
-                    and round_start >= self.bootstrap_end)
         if round_start < self.bootstrap_end:
             depart = t_emit.copy()  # bootstrap: unlimited bandwidth
-        elif use_mesh:
-            depart = None  # the sharded program computes departures
         else:
             depart = self.buckets.depart_times(src, size, t_emit, round_start)
 
@@ -184,13 +180,6 @@ class NetworkEngine(DeviceRoutedPlane):
         reach = lat < INF_I64
         n_bh = n - int(reach.sum())
         if n_bh:
-            if use_mesh:
-                # unreachable routes never charge the DEVICE buckets, but
-                # host policies charge theirs before the reach filter —
-                # results would diverge. Surface it instead of drifting.
-                raise ValueError(
-                    "scheduler_policy tpu_mesh requires fully-routable "
-                    f"topologies ({n_bh} units have no route)")
             self.units_blackholed += n_bh
             units = [u for u, ok in zip(units, reach) if ok]
             if not units:
@@ -199,34 +188,7 @@ class NetworkEngine(DeviceRoutedPlane):
             depart, lat = depart[reach], lat[reach]
             n = len(units)
 
-        if use_mesh:
-            from shadow_tpu.parallel.mesh import F_FLAGS, F_TARR, F_UID
-
-            uid = np.fromiter((u.uid for u in units), dtype=np.int64, count=n)
-            # chunk so no shard can overflow its padded slots (sequential
-            # round_step calls at one t_now advance the closed-form bucket
-            # state exactly like a single batched call — per-source FIFO
-            # order is preserved by chunking in emission order)
-            ups = self.mesh_plane.units_per_shard
-            arrival = np.empty(n, dtype=np.int64)
-            mesh_flags = np.empty(n, dtype=bool)
-            for i in range(0, n, ups):
-                j = min(n, i + ups)
-                received, _gmin, _cnt = self.mesh_plane.round_step(
-                    self.mesh_plane.shard_units(
-                        src[i:j], dst[i:j], size[i:j], t_emit[i:j],
-                        uid[i:j]),
-                    t_now=int(round_start))
-                tab = received.reshape(-1, received.shape[-1])
-                tab = tab[tab[:, F_FLAGS] >= 2]  # valid rows
-                order = np.argsort(tab[:, F_UID])
-                tab = tab[order]
-                idx = np.searchsorted(tab[:, F_UID], uid[i:j])
-                arrival[i:j] = tab[idx, F_TARR]
-                mesh_flags[i:j] = (tab[idx, F_FLAGS] & 1).astype(bool)
-        else:
-            mesh_flags = None
-            arrival = depart + lat
+        arrival = depart + lat
         if n:
             ml = int(lat.min())
             if ml < self.min_used_latency:
@@ -247,14 +209,6 @@ class NetworkEngine(DeviceRoutedPlane):
             if not forced.any():
                 forced = None
 
-        if mesh_flags is not None:
-            # loss was drawn inside the sharded round program
-            flags = mesh_flags
-            if forced is not None:
-                flags = flags | forced
-            self._schedule_batch(units, arrival, notify, flags, keys,
-                                 round_end)
-            return
         use_device = (
             self.device is not None
             and n >= self.device_floor

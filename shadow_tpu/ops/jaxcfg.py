@@ -20,6 +20,18 @@ def configure() -> None:
     _done = True
     import jax
 
+    # SHADOW_FORCE_CPU_DEVICES=N: run on an N-virtual-device CPU platform
+    # (the pod stand-in for mesh benchmarks/tests — SURVEY.md §4). Env
+    # vars like JAX_PLATFORMS are read at jax import, which sitecustomize
+    # may have pinned already; config updates work until backend init.
+    force_cpu = os.environ.get("SHADOW_FORCE_CPU_DEVICES")
+    if force_cpu:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", int(force_cpu))
+        except RuntimeError:
+            pass  # backends already initialized; run on what exists
+
     cache = os.environ.get(
         "SHADOW_TPU_JAX_CACHE",
         os.path.join(os.path.expanduser("~"), ".cache", "shadow_tpu", "jax"),

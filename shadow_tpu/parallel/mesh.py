@@ -72,9 +72,12 @@ def _ceil_ns(need, rate):
 def _round_step(n_shards, seed, max_pkts, state, units, tables, t_now):
     """One shard's view of the round. All ``units`` arrays are (1, C) blocks
     (shard_map splits the global (N, C)); state is (1, Hs). tables
-    (host_node, lat, thresh, rate, cap) are replicated."""
+    (host_node, lat, thresh, rate, cap) are replicated. ``rok`` marks
+    routable units: blackholed ones (no route in the APSP) still charge
+    their source bucket — matching the host planes, which filter AFTER the
+    closed-form commit — but produce no arrival row."""
     t_base, tokens, debt = (s[0] for s in state)
-    src_l, dst_g, size, t_emit, uid = (u[0] for u in units)
+    src_l, dst_g, size, t_emit, uid, rok = (u[0] for u in units)
     host_node, lat_ns, thresh, rate_all, cap_all = tables
     me = lax.axis_index(AXIS)
     hs = t_base.shape[0]
@@ -131,16 +134,17 @@ def _round_step(n_shards, seed, max_pkts, state, units, tables, t_now):
                             c0, c1, xp=jnp)
     draws = (draws >> jnp.uint32(8)).astype(jnp.uint32)
     hit = (draws < th[:, None]) & (pkt < npkts.astype(jnp.uint32)[:, None])
-    dropped = jnp.any(hit, axis=1) & valid
+    route = valid & (rok != 0)
+    dropped = jnp.any(hit, axis=1) & route
 
     # route arrivals to their destination shards: stable-sort by dst shard,
     # rank within group, scatter into the (N, C) exchange table, all_to_all
-    dst_shard = jnp.where(valid, dst_g % n_shards, n_shards)  # pad -> dropped
+    dst_shard = jnp.where(route, dst_g % n_shards, n_shards)  # pad -> drop
     order = jnp.argsort(dst_shard, stable=True)
     ds = dst_shard[order]
     first = jnp.searchsorted(ds, ds, side="left")
     rank = jnp.arange(c) - first
-    flags = (dropped.astype(jnp.int64) | (valid.astype(jnp.int64) << 1))
+    flags = (dropped.astype(jnp.int64) | (route.astype(jnp.int64) << 1))
     payload = jnp.stack(
         [(dst_g // n_shards).astype(jnp.int64), t_arr, uid, flags], axis=-1
     )[order]
@@ -151,13 +155,13 @@ def _round_step(n_shards, seed, max_pkts, state, units, tables, t_now):
     # the conservative-lookahead barrier: global earliest arrival (pmin) —
     # the controller's next-round window bound in a multi-controller setup
     inf = jnp.int64(1) << jnp.int64(62)
-    local_min = jnp.min(jnp.where(valid, t_arr, inf))
+    local_min = jnp.min(jnp.where(route, t_arr, inf))
     # min-reduce via all_gather + local min: some TPU AOT toolchains lower
     # only Sum all-reduces (observed on the tunneled v5e compile helper);
     # AllGather lowers everywhere and the result is identical
     g_min = jnp.min(lax.all_gather(local_min, AXIS))
 
-    sent_ct = lax.psum(jnp.sum(valid & ~dropped), AXIS)
+    sent_ct = lax.psum(jnp.sum(route & ~dropped), AXIS)
     drop_ct = lax.psum(jnp.sum(dropped), AXIS)
 
     state_out = (t_base[None], tokens[None], debt[None])
@@ -225,7 +229,7 @@ class MeshDataPlane:
                 partial(_round_step, n, int(params.seed), int(max_pkts)),
                 mesh=self.mesh,
                 in_specs=((P(AXIS), P(AXIS), P(AXIS)),
-                          (P(AXIS),) * 5,
+                          (P(AXIS),) * 6,
                           (P(), P(), P(), P(), P()),
                           P()),
                 out_specs=(P(AXIS), (P(AXIS), P(AXIS), P(AXIS)), P(), P()),
@@ -236,15 +240,18 @@ class MeshDataPlane:
             static_argnums=(),
         )
 
-    def shard_units(self, src, dst, size, t_emit, uid):
+    def shard_units(self, src, dst, size, t_emit, uid, rok=None):
         """Pack a (src-sorted FIFO) host batch into per-shard padded slots.
-        Returns the (N, C) int64/int32 arrays ``round_step`` consumes."""
+        ``rok`` (optional bool array) marks routable units; unroutable ones
+        charge buckets but produce no arrival. Returns the (N, C) int64
+        arrays ``round_step`` consumes."""
         n, c, hs = self.n_shards, self.units_per_shard, self.hs
         out_src = np.full((n, c), hs, dtype=np.int64)  # hs = invalid sentinel
         out_dst = np.zeros((n, c), dtype=np.int64)
         out_size = np.zeros((n, c), dtype=np.int64)
         out_emit = np.zeros((n, c), dtype=np.int64)
         out_uid = np.zeros((n, c), dtype=np.int64)
+        out_rok = np.zeros((n, c), dtype=np.int64)
         sh = np.asarray(src, dtype=np.int64) % n
         counts = np.bincount(sh, minlength=n)
         if counts.max(initial=0) > c:
@@ -259,17 +266,35 @@ class MeshDataPlane:
             out_size[shs, ks] = np.asarray(size, dtype=np.int64)[order]
             out_emit[shs, ks] = np.asarray(t_emit, dtype=np.int64)[order]
             out_uid[shs, ks] = np.asarray(uid, dtype=np.int64)[order]
+            if rok is None:
+                out_rok[shs, ks] = 1
+            else:
+                out_rok[shs, ks] = np.asarray(rok, dtype=np.int64)[order]
         return tuple(jnp.asarray(a) for a in
-                     (out_src, out_dst, out_size, out_emit, out_uid))
+                     (out_src, out_dst, out_size, out_emit, out_uid,
+                      out_rok))
+
+    def round_step_async(self, units, t_now: int):
+        """Run one round; bucket state advances ON DEVICE and only the
+        scalar barrier min is read synchronously. Returns (received_dev,
+        g_min): the (N, N, C, 4) exchange table stays on device with its
+        host copy streaming in the background — the caller materializes
+        it when the simulation clock reaches g_min (the causal deadline,
+        exactly the single-chip plane's deferred-readback discipline)."""
+        received, state, g_min, _counters = self._step(
+            (self.t_base, self.tokens, self.debt), units, self._tables,
+            jnp.int64(t_now))
+        self.t_base, self.tokens, self.debt = state
+        try:
+            received.copy_to_host_async()
+        except AttributeError:
+            pass
+        return received, int(g_min)
 
     def round_step(self, units, t_now: int):
-        """Run one round; returns (received, g_min, counters). Cost note:
-        the exchange table reads back at its padded worst case (N*N*C
-        rows) synchronously — the mesh plane trades the single-chip
-        backend's async compact readback for the on-device all_to_all;
-        device-side compaction is the known follow-up. ``received`` is
-        ``received`` a (N, N, C, 4) int64 numpy array: received[i, j, c] =
-        the c-th arrival shard j routed to shard i (see F_* field order)."""
+        """Synchronous round (tests): returns (received, g_min, counters)
+        with ``received`` materialized — received[i, j, c] = the c-th
+        arrival shard j routed to shard i (see F_* field order)."""
         received, state, g_min, counters = self._step(
             (self.t_base, self.tokens, self.debt), units, self._tables,
             jnp.int64(t_now))

@@ -15,6 +15,9 @@ from __future__ import annotations
 _BIT_PREFIX = {
     "": 1, "k": 10**3, "kilo": 10**3, "m": 10**6, "mega": 10**6,
     "g": 10**9, "giga": 10**9, "t": 10**12, "tera": 10**12,
+    # base-1024 bit prefixes (tornettools emits "... Kibit" bandwidths)
+    "ki": 2**10, "kibi": 2**10, "mi": 2**20, "mebi": 2**20,
+    "gi": 2**30, "gibi": 2**30, "ti": 2**40, "tebi": 2**40,
 }
 
 _BYTE_UNITS = {

@@ -241,3 +241,61 @@ def test_phase_wall_breakdown_present():
     for k in ("events", "barrier", "draw_flush", "extract",
               "ingress_deferred"):
         assert k in b["phase_wall"], k
+
+
+PARTITIONED = """
+general:
+  stop_time: 20s
+  seed: 13
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "50 Mbit" host_bandwidth_down "50 Mbit" ]
+        node [ id 1 host_bandwidth_up "50 Mbit" host_bandwidth_down "50 Mbit" ]
+        node [ id 2 host_bandwidth_up "50 Mbit" host_bandwidth_down "50 Mbit" ]
+        edge [ source 0 target 1 latency "15 ms" ]
+        edge [ source 0 target 0 latency "5 ms" ]
+        edge [ source 1 target 1 latency "5 ms" ]
+        edge [ source 2 target 2 latency "5 ms" ]
+      ]
+hosts:
+  main:
+    network_node_id: 0
+    quantity: 10
+    processes:
+      - path: pyapp:shadow_tpu.models.gossip:GossipNode
+        args: ["7000", "16", "5", "1", "0.5"]
+  island:
+    network_node_id: 2
+    quantity: 6
+    processes:
+      - path: pyapp:shadow_tpu.models.gossip:GossipNode
+        args: ["7000", "16", "5", "1", "0.5"]
+"""
+
+
+def test_partitioned_topology_blackholes_identical():
+    """A partitioned topology (island nodes with NO route to the rest):
+    unroutable units blackhole — counted, discarded, buckets still
+    charged — identically on the per-unit plane, the columnar plane, AND
+    the mesh plane (which previously hard-rejected such topologies)."""
+    ctl_a, a = _run(PARTITIONED, "thread_per_core", "bh")
+    ctl_b, b = _run(PARTITIONED, "tpu_batch", "bh")
+    ctl_c, c = _run(PARTITIONED, "tpu_mesh", "bh")
+    for k in EQ_KEYS:
+        assert a[k] == b[k] == c[k], (k, a[k], b[k], c[k])
+    assert ctl_a.engine.units_blackholed > 0
+    assert (ctl_a.engine.units_blackholed == ctl_b.engine.units_blackholed
+            == ctl_c.engine.units_blackholed)
+
+
+def test_mesh_e2e_matches_host_planes():
+    """tpu_mesh end-to-end (async exchange readback at the g_min barrier)
+    bit-matches both host planes on a lossy stream workload."""
+    _, a = _run(TGEN_LOSSY, "thread_per_core", "mesheq")
+    _, b = _run(TGEN_LOSSY, "tpu_mesh", "mesheq")
+    for k in EQ_KEYS:
+        assert a[k] == b[k], (k, a[k], b[k])

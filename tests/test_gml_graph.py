@@ -87,3 +87,27 @@ def test_long_chain_apsp():
     ng = from_gml(parse_gml(f"graph [ directed 0\n{nodes}\n{edges}\n]"))
     assert ng.latency(0, n - 1) == (n - 1) * NS_PER_MS
     assert np.all(ng.latency_ns < INF_I64)
+
+
+def test_tornettools_format_fixture():
+    """BASELINE #3's committed topology is in the tornettools output
+    schema: city labels, country codes, base-1024 Kibit bandwidths,
+    microsecond latencies, float packet_loss — all parsed, with the
+    config's relative file reference resolving against the config dir."""
+    from pathlib import Path
+
+    from shadow_tpu.config import load_config
+    from shadow_tpu.network.graph import load_graph
+    from shadow_tpu.utils.units import parse_bandwidth
+
+    root = Path(__file__).resolve().parents[1]
+    g = load_graph({"type": "gml",
+                    "file": str(root / "examples/topology_tornet400.gml")})
+    assert g.n_nodes == 30
+    assert g.min_latency_ns == 2_000_000  # the 2000 us self-edges
+    # node defaults came from the Kibit strings (base-1024 bits)
+    d = g.node_defaults[0]
+    assert d.bandwidth_up == int(710022 * 1024 / 8)
+    cfg = load_config(str(root / "examples/tor_400relay.yaml"))
+    assert cfg.network["graph"]["file"].endswith("topology_tornet400.gml")
+    assert parse_bandwidth("1 Mibit") == 2**20 // 8

@@ -1070,3 +1070,43 @@ def test_native_audit_cpython_stable():
     name = Path(sys.executable).name
     out = Path(f"/tmp/st-audit-py-a/hosts/box/{name}.0.stdout").read_text()
     assert "order=[0, 1, 2, 3] n=4 elapsed_ms=200" in out, out
+
+
+def test_mt64_native_oracle():
+    r = subprocess.run([str(BUILD / "mt64")], capture_output=True,
+                       text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "mt64 done=48" in r.stdout
+
+
+def test_mt64_managed():
+    """48 concurrent pthreads — beyond the old 31-slot ceiling — each on
+    its own channel in the widened [932, 995] window, mutex handoffs
+    through the emulated futex."""
+    cfg_text = SLEEP_CFG.replace("sleep_clock", "mt64")
+    cfg = parse_config(yaml.safe_load(cfg_text), {
+        "general.data_directory": "/tmp/st-mt64",
+    })
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    out = Path("/tmp/st-mt64/hosts/box/mt64.0.stdout").read_text()
+    assert "mt64 done=48" in out, out
+
+
+def test_exec_from_non_main_thread_managed():
+    """execve from a pthread (not main): the worker-mediated respawn
+    replaces the whole process regardless of which thread execs — the old
+    in-place re-exec only supported the main thread."""
+    cfg_text = SLEEP_CFG.replace(
+        f"path: {BUILD}/sleep_clock",
+        f"path: {BUILD}/thread_exec\n        args: [\"{BUILD}/sleep_clock\"]")
+    cfg = parse_config(yaml.safe_load(cfg_text), {
+        "general.data_directory": "/tmp/st-threadexec",
+    })
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    out = Path("/tmp/st-threadexec/hosts/box/thread_exec.0.stdout").read_text()
+    assert out.count("elapsed_ms=250") == 3, out
+    assert "ok" in out

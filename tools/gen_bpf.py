@@ -37,8 +37,6 @@ SYS = dict(read=0, write=1, open=2, close=3, stat=4, fstat=5, lstat=6,
            sched_getaffinity=204, sysinfo=99, getrusage=98)
 
 CLONE_THREAD = 0x10000
-CLONE_IO = 0x80000000  # shim's own fork-replay marker: benign, lets the
-# handler's raw clone through the filter without re-trapping
 
 #: syscalls trapped unconditionally (beyond the 41..59 socket/clone range)
 UNCONDITIONAL = [
@@ -132,8 +130,10 @@ def build(audit: bool = False):
     prog += [("LD_A0",), ("JGE", "IPCLOW", None, "TRAP"),
              ("JGE", "IPCEND", "TRAP", "ALLOW")]
     labels["CLONECHK"] = len(prog)
-    prog += [("LD_A0",), ("JSET", CLONE_THREAD, "ALLOW", None),
-             ("JSET", CLONE_IO, "ALLOW", "TRAP")]
+    # thread-style clones run natively (pthread_create is interposed);
+    # everything else traps — the shim's own fork replay rides the gadget
+    # IP allowance, so no marker-flag escape hatch exists anymore
+    prog += [("LD_A0",), ("JSET", CLONE_THREAD, "ALLOW", "TRAP")]
     labels["CLOSECHK"] = len(prog)
     prog += [("LD_A0",), ("JGE", "IPCLOW", None, "VFDTAIL"),
              ("JGE", "IPCEND", "VFDTAIL", "TRAP")]
