@@ -121,7 +121,7 @@ def managed_bench(n_servers: int = 10, n_clients: int = 40,
     return out
 
 
-def mesh_scaling(config: str = "examples/tgen_1k.yaml") -> dict:
+def mesh_scaling(config: str = "examples/tgen_100host.yaml") -> dict:
     """tpu_mesh scaling table (VERDICT r2 item #2): the whole-round
     sharded program over 1/2/4/8 shards of an 8-virtual-device CPU mesh
     (the image has one real chip; the driver validates the same path via
