@@ -332,7 +332,7 @@ static int shim_nr_emulated(long nr, const greg_t *g) {
   case SYS_close:
     return vfd || (a0 >= SHIM_IPC_LOW && a0 <= SHIM_IPC_FD);
   /* BEGIN GENERATED VFD CASES (tools/gen_bpf.py) */
-  case 16: case 72: case 32: case 5: case 8: case 217: case 77: case 74: case 75: case 81:  /* ioctl fcntl dup fstat lseek getdents64 ftruncate fsync fdatasync fchdir */
+  case 16: case 72: case 32: case 5: case 8: case 217: case 77: case 74: case 75: case 81: case 17: case 18:  /* ioctl fcntl dup fstat lseek getdents64 ftruncate fsync fdatasync fchdir pread64 pwrite64 */
   /* END GENERATED VFD CASES */
     return vfd;
   default:
@@ -355,6 +355,23 @@ static void sigsys_handler(int signo, siginfo_t *info, void *vctx) {
   (void)signo;
   ucontext_t *ctx = vctx;
   greg_t *g = ctx->uc_mcontext.gregs;
+  if (info->si_syscall == SYS_rt_sigprocmask) {
+    /* handled purely locally (see below) — safe at ANY thread stage */
+    goto sigprocmask;
+  }
+  if (!shim_tls_ready) {
+    /* a freshly cloned thread runs glibc bootstrap BEFORE the trampoline
+     * pins its own channel; its thread-local channel fd still points at
+     * the MAIN thread's, so forwarding would interleave with (and steal
+     * replies from) the spawner's own request stream — the race that
+     * intermittently broke the 10th pthread_create of a burst. These are
+     * glibc-internal setup calls: run them natively via the gadget. */
+    g[REG_RAX] = (greg_t)shim_gadget(info->si_syscall, (long)g[REG_RDI],
+                                     (long)g[REG_RSI], (long)g[REG_RDX],
+                                     (long)g[REG_R10], (long)g[REG_R8],
+                                     (long)g[REG_R9]);
+    return;
+  }
   if (info->si_syscall == SYS_fork ||
       (info->si_syscall == SYS_clone && !(g[REG_RDI] & 0x10000))) {
     if (info->si_syscall == SYS_clone && (g[REG_RDI] & 0x100 /*CLONE_VM*/)) {
@@ -377,6 +394,7 @@ static void sigsys_handler(int signo, siginfo_t *info, void *vctx) {
     raw3(SYS_exit, (long)g[REG_RDI], 0, 0);
   }
   if (info->si_syscall == SYS_rt_sigprocmask) {
+  sigprocmask:
     /* Emulated SHIM-SIDE by editing the signal frame's uc_sigmask (the
      * mask sigreturn restores) — never with a real syscall, which would
      * re-trap forever. Crucially SIGSYS/SIGSEGV are ALWAYS left unblocked:
@@ -825,30 +843,32 @@ void pthread_exit(void *retval) {
 
 static int install_seccomp(void) {
   /* BEGIN GENERATED BPF (tools/gen_bpf.py) */
-  struct sock_filter prog[] = {  /* 114 instructions */
+  struct sock_filter prog[] = {  /* 116 instructions */
       LD(BPF_ARCHF),
-      JEQ(AUDIT_ARCH_X86_64, 0, 111),
+      JEQ(AUDIT_ARCH_X86_64, 0, 113),
       LD(BPF_IPHI),
       JEQ((uint32_t)((uintptr_t)SHIM_GADGET_ADDR >> 32), 0, 3),
       LD(BPF_IPLO),
       JGE((uint32_t)(uintptr_t)SHIM_GADGET_ADDR, 0, 1),
-      JGE(((uint32_t)(uintptr_t)SHIM_GADGET_ADDR + 4096), 0, 106),
+      JGE(((uint32_t)(uintptr_t)SHIM_GADGET_ADDR + 4096), 0, 108),
       LD(BPF_NR),
-      JEQ(0, 82, 0),  /* read */
-      JEQ(1, 86, 0),  /* write */
-      JEQ(3, 95, 0),  /* close */
-      JEQ(19, 79, 0),  /* readv */
-      JEQ(20, 83, 0),  /* writev */
-      JEQ(16, 95, 0),  /* ioctl */
-      JEQ(72, 94, 0),  /* fcntl */
-      JEQ(32, 93, 0),  /* dup */
-      JEQ(5, 92, 0),  /* fstat */
-      JEQ(8, 91, 0),  /* lseek */
-      JEQ(217, 90, 0),  /* getdents64 */
-      JEQ(77, 89, 0),  /* ftruncate */
-      JEQ(74, 88, 0),  /* fsync */
-      JEQ(75, 87, 0),  /* fdatasync */
-      JEQ(81, 86, 0),  /* fchdir */
+      JEQ(0, 84, 0),  /* read */
+      JEQ(1, 88, 0),  /* write */
+      JEQ(3, 97, 0),  /* close */
+      JEQ(19, 81, 0),  /* readv */
+      JEQ(20, 85, 0),  /* writev */
+      JEQ(16, 97, 0),  /* ioctl */
+      JEQ(72, 96, 0),  /* fcntl */
+      JEQ(32, 95, 0),  /* dup */
+      JEQ(5, 94, 0),  /* fstat */
+      JEQ(8, 93, 0),  /* lseek */
+      JEQ(217, 92, 0),  /* getdents64 */
+      JEQ(77, 91, 0),  /* ftruncate */
+      JEQ(74, 90, 0),  /* fsync */
+      JEQ(75, 89, 0),  /* fdatasync */
+      JEQ(81, 88, 0),  /* fchdir */
+      JEQ(17, 87, 0),  /* pread64 */
+      JEQ(18, 86, 0),  /* pwrite64 */
       JEQ(35, 88, 0),  /* nanosleep */
       JEQ(230, 87, 0),  /* clock_nanosleep */
       JEQ(228, 86, 0),  /* clock_gettime */
@@ -941,31 +961,33 @@ static int install_seccomp(void) {
       RET(SECCOMP_RET_TRAP),
       RET(SECCOMP_RET_ALLOW),
   };
-  struct sock_filter prog_audit[] = {  /* 115 instructions */
+  struct sock_filter prog_audit[] = {  /* 117 instructions */
       LD(BPF_ARCHF),
-      JEQ(AUDIT_ARCH_X86_64, 0, 112),
+      JEQ(AUDIT_ARCH_X86_64, 0, 114),
       LD(BPF_IPHI),
       JEQ((uint32_t)((uintptr_t)SHIM_GADGET_ADDR >> 32), 0, 3),
       LD(BPF_IPLO),
       JGE((uint32_t)(uintptr_t)SHIM_GADGET_ADDR, 0, 1),
-      JGE(((uint32_t)(uintptr_t)SHIM_GADGET_ADDR + 4096), 0, 107),
+      JGE(((uint32_t)(uintptr_t)SHIM_GADGET_ADDR + 4096), 0, 109),
       LD(BPF_NR),
-      JEQ(15, 105, 0),
-      JEQ(0, 82, 0),  /* read */
-      JEQ(1, 86, 0),  /* write */
-      JEQ(3, 95, 0),  /* close */
-      JEQ(19, 79, 0),  /* readv */
-      JEQ(20, 83, 0),  /* writev */
-      JEQ(16, 95, 0),  /* ioctl */
-      JEQ(72, 94, 0),  /* fcntl */
-      JEQ(32, 93, 0),  /* dup */
-      JEQ(5, 92, 0),  /* fstat */
-      JEQ(8, 91, 0),  /* lseek */
-      JEQ(217, 90, 0),  /* getdents64 */
-      JEQ(77, 89, 0),  /* ftruncate */
-      JEQ(74, 88, 0),  /* fsync */
-      JEQ(75, 87, 0),  /* fdatasync */
-      JEQ(81, 86, 0),  /* fchdir */
+      JEQ(15, 107, 0),
+      JEQ(0, 84, 0),  /* read */
+      JEQ(1, 88, 0),  /* write */
+      JEQ(3, 97, 0),  /* close */
+      JEQ(19, 81, 0),  /* readv */
+      JEQ(20, 85, 0),  /* writev */
+      JEQ(16, 97, 0),  /* ioctl */
+      JEQ(72, 96, 0),  /* fcntl */
+      JEQ(32, 95, 0),  /* dup */
+      JEQ(5, 94, 0),  /* fstat */
+      JEQ(8, 93, 0),  /* lseek */
+      JEQ(217, 92, 0),  /* getdents64 */
+      JEQ(77, 91, 0),  /* ftruncate */
+      JEQ(74, 90, 0),  /* fsync */
+      JEQ(75, 89, 0),  /* fdatasync */
+      JEQ(81, 88, 0),  /* fchdir */
+      JEQ(17, 87, 0),  /* pread64 */
+      JEQ(18, 86, 0),  /* pwrite64 */
       JEQ(35, 88, 0),  /* nanosleep */
       JEQ(230, 87, 0),  /* clock_nanosleep */
       JEQ(228, 86, 0),  /* clock_gettime */

@@ -20,6 +20,11 @@ print("isfile:", os.path.isfile("data/b.txt"),
 with open("data/sub/c.bin", "rb") as f:
     f.seek(100)
     print("seek-read:", f.read(8).hex())
+fd = os.open("data/sub/c.bin", os.O_RDWR)
+print("pread:", os.pread(fd, 6, 64).hex())
+os.pwrite(fd, b"ZZ", 10)
+print("after-pwrite:", os.pread(fd, 4, 9).hex())
+os.close(fd)
 os.unlink("data/b.txt")
 print("after-unlink:", sorted(os.listdir("data")))
 os.rmdir("data/sub") if not os.listdir("data/sub") else None

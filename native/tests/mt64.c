@@ -2,8 +2,10 @@
  * each sleeps a staggered sim duration and bumps a counter under a mutex.
  * Dual-run: native Linux oracle + managed (worker-emulated futexes, one
  * channel per thread in the widened [932, 995] fd window). */
+#include <errno.h>
 #include <pthread.h>
 #include <stdio.h>
+#include <string.h>
 #include <time.h>
 
 #define N 48
@@ -22,11 +24,13 @@ static void *worker(void *arg) {
 
 int main(void) {
   pthread_t th[N];
-  for (long i = 0; i < N; i++)
-    if (pthread_create(&th[i], NULL, worker, (void *)i) != 0) {
-      fprintf(stderr, "create %ld failed\n", i);
+  for (long i = 0; i < N; i++) {
+    int rc = pthread_create(&th[i], NULL, worker, (void *)i);
+    if (rc != 0) {
+      fprintf(stderr, "create %ld failed: %s\n", i, strerror(rc));
       return 1;
     }
+  }
   for (int i = 0; i < N; i++) pthread_join(th[i], NULL);
   printf("mt64 done=%d\n", done);
   return done == N ? 0 : 1;

@@ -58,6 +58,7 @@ SYS_wait4, SYS_exit_group, SYS_pipe, SYS_pipe2 = 61, 231, 22, 293
 SYS_dup, SYS_dup2, SYS_dup3 = 32, 33, 292
 SYS_fstat, SYS_lseek, SYS_newfstatat = 5, 8, 262
 # the virtual file surface (native/vfs.py)
+SYS_pread64, SYS_pwrite64 = 17, 18
 SYS_open, SYS_stat, SYS_lstat, SYS_access = 2, 4, 6, 21
 SYS_fsync, SYS_fdatasync, SYS_truncate, SYS_ftruncate = 74, 75, 76, 77
 SYS_getcwd, SYS_chdir, SYS_fchdir, SYS_rename, SYS_mkdir = 79, 80, 81, 82, 83
@@ -1824,6 +1825,26 @@ class ManagedProcess(ProcessLifecycle):
             if vs is not None and vs.kind in ("file", "dir"):
                 return self.vfs.lseek(vs, args[1], args[2])
             return -29 if args[0] in self.fds else -EBADF  # ESPIPE
+        if nr == SYS_pread64:
+            vs = self.fds.get(args[0])
+            if vs is None:
+                return -EBADF
+            if vs.kind not in ("file", "dir"):
+                return -29  # ESPIPE
+            data = self.vfs.pread(vs, min(args[2], 1 << 20), _sfd(args[3]))
+            if isinstance(data, int):
+                return data
+            self.mem.write(args[1], data)
+            return len(data)
+        if nr == SYS_pwrite64:
+            vs = self.fds.get(args[0])
+            if vs is None:
+                return -EBADF
+            if vs.kind not in ("file", "dir"):
+                return -29  # ESPIPE
+            return self.vfs.pwrite(
+                vs, self.mem.read(args[1], min(args[2], 1 << 20)),
+                _sfd(args[3]))
         if nr in (SYS_open, SYS_creat):
             flags = (0o1101 if nr == SYS_creat  # O_WRONLY|O_CREAT|O_TRUNC
                      else args[1])

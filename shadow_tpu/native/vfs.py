@@ -244,6 +244,32 @@ class HostVFS:
         vf.off += len(chunk)
         return chunk
 
+    def pread(self, vs, n: int, off: int) -> bytes | int:
+        vf = vs.vfile
+        if vf.is_dir:
+            return -EISDIR
+        if off < 0:
+            return -EINVAL
+        if vf.data is not None:
+            return vf.data[off:off + n]
+        if vf.flags & O_ACCMODE == 0o1:  # O_WRONLY
+            return -EBADF
+        try:
+            return os.pread(vf.fd, n, off)
+        except OSError as e:
+            return -e.errno
+
+    def pwrite(self, vs, data: bytes, off: int) -> int:
+        vf = vs.vfile
+        if vf.is_dir or vf.data is not None:
+            return -EBADF
+        if vf.flags & O_ACCMODE == 0:  # O_RDONLY
+            return -EBADF
+        try:
+            return os.pwrite(vf.fd, data, off)
+        except OSError as e:
+            return -e.errno
+
     def write(self, vs, data: bytes) -> int:
         vf = vs.vfile
         if vf.is_dir or vf.data is not None:

@@ -12,7 +12,7 @@ from __future__ import annotations
 from pathlib import Path
 
 SYS = dict(read=0, write=1, open=2, close=3, stat=4, fstat=5, lstat=6,
-           poll=7, lseek=8,
+           poll=7, lseek=8, pread64=17, pwrite64=18,
            access=21, getcwd=79, chdir=80, fchdir=81, rename=82, mkdir=83,
            rmdir=84, creat=85, unlink=87, readlink=89, truncate=76,
            ftruncate=77, fsync=74, fdatasync=75, getdents64=217,
@@ -65,7 +65,7 @@ UNCONDITIONAL = [
 #: syscalls trapped only when arg0 is a virtual fd
 VFD_CONDITIONAL = ["ioctl", "fcntl", "dup",
                    "fstat", "lseek", "getdents64", "ftruncate", "fsync",
-                   "fdatasync", "fchdir"]
+                   "fdatasync", "fchdir", "pread64", "pwrite64"]
 
 
 def build(audit: bool = False):
