@@ -210,24 +210,48 @@ def main() -> None:
     detail: dict = {"machine_note": "tpu_batch uses the local JAX default "
                     "device; thread_per_core is the CPU baseline policy"}
 
-    # best-of-2 per policy, INTERLEAVED: shared-machine load drifts on the
-    # scale of one run, so grouping a policy's repetitions correlates the
-    # noise with the policy and corrupts the ratio
+    # median-of-3 per policy, INTERLEAVED (VERDICT r3 weak #1): shared-
+    # machine load drifts on the scale of one run, so grouping a policy's
+    # repetitions correlates the noise with the policy and corrupts the
+    # ratio; best-of-N overstates whichever policy got the quiet slot.
+    # The ratio of record is median/median, and the raw rates ship in the
+    # headline's neighborhood so any reviewer can recompute it.
+    N = 3
     runs = {"thread_per_core": [], "tpu_batch": []}
-    for _ in range(2):
+    for _ in range(N):
         for pol, tag in (("thread_per_core", "tpc"), ("tpu_batch", "tpu")):
             runs[pol].append(run_config(args.config, pol, tag))
-    base = max(runs["thread_per_core"],
-               key=lambda r: r["sim_sec_per_wall_sec"])
-    tpu = max(runs["tpu_batch"], key=lambda r: r["sim_sec_per_wall_sec"])
+
+    def med(rs):
+        s = sorted(rs, key=lambda r: r["sim_sec_per_wall_sec"])
+        return s[len(s) // 2]
+
+    def rates(rs):
+        return [round(r["sim_sec_per_wall_sec"], 3) for r in rs]
+
+    base, tpu = med(runs["thread_per_core"]), med(runs["tpu_batch"])
+    spread = {
+        pol: round((max(v) - min(v)) / max(v[len(v) // 2], 1e-9), 4)
+        for pol, v in ((p, sorted(rates(r))) for p, r in runs.items())
+    }
+    log(f"raw rates (interleaved x{N}): "
+        f"tpc={rates(runs['thread_per_core'])} "
+        f"tpu={rates(runs['tpu_batch'])} spread={spread}")
     headline = {
         "metric": "sim_sec_per_wall_sec_tgen1k_tpu_batch",
         "value": round(tpu["sim_sec_per_wall_sec"], 4),
         "unit": "sim-sec/wall-sec",
         "vs_baseline": round(
             tpu["sim_sec_per_wall_sec"] / base["sim_sec_per_wall_sec"], 4),
+        "raw_tpu": rates(runs["tpu_batch"]),
+        "raw_baseline": rates(runs["thread_per_core"]),
+        "aggregation": f"median-of-{N}, interleaved",
     }
-    detail["tgen_1k"] = {"thread_per_core": base, "tpu_batch": tpu}
+    detail["tgen_1k"] = {
+        "thread_per_core": base, "tpu_batch": tpu,
+        "raw_rates": {p: rates(r) for p, r in runs.items()},
+        "spread_rel": spread,
+    }
 
     # results must be identical across policies — a benchmark that diverged
     # would be measuring two different simulations
@@ -250,8 +274,9 @@ def main() -> None:
         detail["draw_plane"] = draw_plane_throughput()
         for tag in ("tgen_1k", "tgen_100", "tor_400", "gossip_10k"):
             for pol in detail[tag]:
-                detail[tag][pol].pop("counters", None)
-                detail[tag][pol].pop("process_errors", None)
+                if isinstance(detail[tag][pol], dict):
+                    detail[tag][pol].pop("counters", None)
+                    detail[tag][pol].pop("process_errors", None)
         (ROOT / "BENCH_DETAIL.json").write_text(json.dumps(detail, indent=2))
         log("wrote BENCH_DETAIL.json")
 

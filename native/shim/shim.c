@@ -95,7 +95,19 @@ static __thread int shim_tls_ready;
  * compile-time; it holds one stub translating the function-call ABI to
  * the syscall ABI:  gadget(nr, a1..a6) -> syscall(nr, a1..a6).
  * Outside audit mode the gadget is still used (one indirect call per raw
- * syscall) but the filter never consults the IP. */
+ * syscall) but the filter never consults the IP.
+ *
+ * COOPERATIVE-GUEST ASSUMPTION: the gadget page's address is fixed and
+ * both filters ALLOW any syscall issued from it, so code that KNOWS the
+ * address can jump there directly and bypass every trap. Audit mode's
+ * "every native passthrough is observed" guarantee therefore holds for
+ * guests that go through libc/the vDSO (everything we run), not for
+ * adversarial code hunting the gadget. Upstream Shadow's shim has the
+ * same property (its shim text is at a knowable address and its filter
+ * must allow the shim's own raw syscalls); a simulator is not a sandbox.
+ * Randomizing the page per process (and passing the address into the
+ * BPF at install time) would narrow this to guessing, at the cost of a
+ * filter rebuild per process — documented, deliberately not done. */
 #define SHIM_GADGET_ADDR ((void *)0x5D5E00000000ul)
 typedef long (*shim_gadget_fn)(long, long, long, long, long, long, long);
 static shim_gadget_fn shim_gadget; /* == SHIM_GADGET_ADDR once mapped */
@@ -642,9 +654,16 @@ static long shim_spawn_channel(void) {
   return slot;
 }
 
+/* trampoline args live in a static per-slot table, NOT a malloc block:
+ * free() in the trampoline could contend the malloc arena lock and issue
+ * futex(FUTEX_WAIT) either natively before shim_tls_ready (never woken —
+ * the holder's FUTEX_WAKE is worker-emulated) or emulated before
+ * THREAD_HELLO (protocol violation). Slots are only reused after the
+ * prior thread exits, long after it copied its entry. */
+static struct shim_tramp shim_tramp_slots[SHIM_MAX_THREADS];
+
 static void *shim_thread_tramp(void *p) {
   struct shim_tramp t = *(struct shim_tramp *)p;
-  free(p);
   shim_tls_fd = t.fd;
   shim_tls_ready = 1;
   forward(SHIM_THREAD_HELLO, 0, 0, 0, 0, 0, 0); /* blocks for first turn */
@@ -775,14 +794,13 @@ int pthread_create(pthread_t *out, const pthread_attr_t *attr,
   if (!shim_active) return real(out, attr, fn, arg);
   long slot = shim_spawn_channel();
   if (slot < 0) return EAGAIN;
-  struct shim_tramp *t = malloc(sizeof *t);
-  if (!t) return EAGAIN;
+  struct shim_tramp *t = &shim_tramp_slots[slot];
   t->fn = fn;
   t->arg = arg;
   t->fd = SHIM_IPC_FD - (int)slot;
   int rc = real(out, attr, shim_thread_tramp, t);
-  if (rc != 0) free(t); /* worker-side slot leaks; process is dying anyway */
-  else shim_thread_ids[slot] = *out;
+  if (rc == 0) shim_thread_ids[slot] = *out;
+  /* on failure the worker-side slot leaks; the process is dying anyway */
   return rc;
 }
 

@@ -146,6 +146,11 @@ class Controller:
             h.engine = self.engine
             h.equeue.on_first = partial(self._active.add, h.id)
         self.scheduler = make_scheduler(policy, self.hosts, cfg.general.parallelism)
+        # C engine (native colcore): owns the per-round host loop and
+        # maintains the active set directly
+        self._c_core = getattr(self.engine, "_c", None)
+        if self._c_core is not None:
+            self._c_core.bind_active(self._active)
 
         # processes: pyapp: plugins run in-process; any other path is a real
         # executable run under the native preload shim (SURVEY.md §7 phase 4)
@@ -228,13 +233,18 @@ class Controller:
             round_end = min(now + w, stop)
             self.engine.start_of_round(now, round_end)
             hosts = self.hosts
-            active = [hosts[i] for i in sorted(self._active)]
             t_ev = _walltime.perf_counter()
-            executed = self.scheduler.run_round(round_end, active)
+            if self._c_core is not None:
+                # the C loop snapshots + sorts the active set, merges each
+                # host's inbox/heap, and discards drained hosts itself
+                executed = self._c_core.run_round(round_end)
+            else:
+                active = [hosts[i] for i in sorted(self._active)]
+                executed = self.scheduler.run_round(round_end, active)
+                for h in active:
+                    if not h.equeue._heap:
+                        self._active.discard(h.id)
             self._events_wall += _walltime.perf_counter() - t_ev
-            for h in active:
-                if not h.equeue._heap:
-                    self._active.discard(h.id)
             self.engine.end_of_round(now, round_end)
             self.rounds += 1
             self.events += executed

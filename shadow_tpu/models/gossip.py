@@ -32,6 +32,7 @@ class GossipNode:
         self.seen: set[bytes] = set()
         self.received_tx = 0
         self.originated = 0
+        self._c = None  # C gossip state (set in start when available)
 
     def start(self):
         self.sock = self.api.udp_socket(self.port)
@@ -44,6 +45,16 @@ class GossipNode:
             if p != me:
                 peers.add(p)
         self.peers = sorted(peers)
+        # delegate the hot half (message handling, announce fan-out, the
+        # seen set) to the C engine when the plane runs one — identical
+        # logic, identical emissions (tests/test_colcore.py asserts the
+        # whole output tree matches the pure-Python run)
+        self._c = None
+        host = getattr(self.api, "_host", None)
+        cp = getattr(host, "colplane", None)
+        core = getattr(cp, "_c", None)
+        if core is not None and host.pcap is None:
+            self._c = core.gossip_register(host.id, self.port, self.peers)
         if self.originate > 0:
             delay = int((0.25 + 0.5 * float(rng.random())) * self.interval * NS_PER_SEC)
             self.api.after(delay, self._originate)
@@ -51,8 +62,11 @@ class GossipNode:
     def _originate(self):
         self.originated += 1
         txid = f"{self.api.host_id}:{self.originated}".encode()
-        self.seen.add(txid)
-        self._announce(txid)
+        if self._c is not None:
+            self._c.originate(txid)
+        else:
+            self.seen.add(txid)
+            self._announce(txid)
         if self.originated < self.originate:
             self.api.after(int(self.interval * NS_PER_SEC), self._originate)
 
@@ -62,6 +76,11 @@ class GossipNode:
                 self.sock.sendto(p, self.port, payload=INV + txid, nbytes=64)
 
     def _on_msg(self, nbytes, payload, src_addr, now):
+        if self._c is not None:
+            # Python-delivered paths (deferred-ingress drains, fragmented
+            # datagrams) re-enter the C state so seen/counters stay single
+            self._c.on_msg(payload, src_addr[0], now)
+            return
         if payload is None:
             return
         kind, txid = payload[:1], payload[1:]
@@ -78,7 +97,10 @@ class GossipNode:
                 self._announce(txid, exclude=src_host)
 
     def stop(self):
+        received, known = self.received_tx, len(self.seen)
+        if self._c is not None:
+            received, known = self._c.stats()
         self.api.log(
-            f"gossip done: originated={self.originated} received={self.received_tx} "
-            f"known={len(self.seen)}"
+            f"gossip done: originated={self.originated} received={received} "
+            f"known={known}"
         )

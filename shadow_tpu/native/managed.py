@@ -132,6 +132,7 @@ FIONREAD, FIONBIO = 0x541B, 0x5421
 SYS_clone, SYS_fork, SYS_vfork, SYS_execve, SYS_clone3 = 56, 57, 58, 59, 435
 
 EPERM, EBADF, EAGAIN, EFAULT, EINVAL, EPIPE = 1, 9, 11, 14, 22, 32
+E2BIG = 7
 ENOSYS, ENOTCONN, ECONNRESET, ETIMEDOUT, EAFNOSUPPORT, ENETUNREACH = (
     38, 107, 104, 110, 97, 101)
 
@@ -506,8 +507,13 @@ class ManagedProcess(ProcessLifecycle):
             self._exited()
 
     # -- execve: worker-mediated respawn -----------------------------------
-    def _read_ptr_array(self, ptr: int, cap: int = 1024):
-        """Read a NULL-terminated array of C-string pointers (argv/envp)."""
+    def _read_ptr_array(self, ptr: int, cap: int = 65536):
+        """Read a NULL-terminated array of C-string pointers (argv/envp).
+        Returns the list, None on a bad read (EFAULT), or the string
+        "2BIG" when the array exceeds ``cap`` entries (real kernels bound
+        the TOTAL argv+envp bytes, not the entry count; 64k entries is
+        far past any real environment, so hitting it means a runaway or
+        unterminated array — report E2BIG like the kernel's limit)."""
         out = []
         for i in range(cap):
             v = struct.unpack("<Q", self.mem.read(ptr + 8 * i, 8))[0]
@@ -517,7 +523,7 @@ class ManagedProcess(ProcessLifecycle):
             if cs is None:
                 return None
             out.append(cs)
-        return None
+        return "2BIG"
 
     def _do_exec(self, args):
         """execve as a respawn: spawn a fresh managed process (clean
@@ -536,16 +542,20 @@ class ManagedProcess(ProcessLifecycle):
             return -EFAULT
         if envp is None:
             return -EFAULT
+        if argv == "2BIG" or envp == "2BIG":
+            return -E2BIG
         if not argv:
             argv = [path]
         real = path
         r = self.vfs.resolve(AT_FDCWD, path)
         if r is not None:
-            if r[0] != "host":
+            if r[0] == "synth":
                 return -EACCES  # synthesized files are not executable
+            # "host" (data-dir) and "wnative" (worker-tracked cwd outside
+            # the root) both carry the absolute real path — exec either;
+            # relative paths after a chdir outside the data dir resolve
+            # to "wnative" and must keep working
             real = r[1]
-        elif not path.startswith("/"):
-            real = os.path.normpath(self.vfs.cwd + "/" + path)
         if not os.path.isfile(real):
             return -2  # ENOENT
         if not os.access(real, os.X_OK):

@@ -464,7 +464,11 @@ class HostVFS:
         kind, tgt = r
         if kind == "synth":
             return 0 if not (mode & 2) else -EACCES  # W_OK denied
-        return 0 if os.path.exists(tgt) else -ENOENT
+        if not os.path.exists(tgt):
+            return -ENOENT
+        m = ((os.R_OK if mode & 4 else 0) | (os.W_OK if mode & 2 else 0)
+             | (os.X_OK if mode & 1 else 0))
+        return 0 if os.access(tgt, m) else -EACCES
 
     def unlinkat(self, dirfd: int, path_ptr: int, flags: int) -> int:
         path = self._path_arg(path_ptr)

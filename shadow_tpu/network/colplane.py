@@ -85,13 +85,17 @@ SMALL_BARRIER = 48
 
 class StoreBatch:
     """One resolved batch: store-row tuples pre-sorted by (t, key),
-    consumed as a moving prefix by per-round extraction."""
+    consumed as a moving prefix by per-round extraction.  ``cdata`` is an
+    optional packed side-car the C engine writes at build time (one
+    32-byte record per row) so extraction reads sequential memory instead
+    of chasing cold tuple fields; Python paths ignore it."""
 
-    __slots__ = ("rows", "pos")
+    __slots__ = ("rows", "pos", "cdata")
 
-    def __init__(self, rows: list) -> None:
+    def __init__(self, rows: list, cdata=None) -> None:
         self.rows = rows
         self.pos = 0
+        self.cdata = cdata
 
     def head_time(self) -> SimTime:
         return self.rows[self.pos][0] if self.pos < len(self.rows) else T_NEVER
@@ -163,6 +167,19 @@ class ColumnarPlane(DeviceRoutedPlane):
         for h in hosts:
             h.colplane = self
         self._init_device_routing(backend, tpu_options, params)
+        #: C engine (native/colcore/colcore.c): same structures, C hot
+        #: loops. Bit-identical to this file's Python paths (enforced by
+        #: tests/test_colcore.py + the cross-plane suite); absent or
+        #: disabled, everything below runs pure Python.
+        self._c = None
+        if (backend == "tpu" and self.qdisc == "fifo"
+                and getattr(tpu_options, "native_colcore", True)):
+            try:
+                from shadow_tpu.native import _colcore
+
+                self._c = _colcore.Core(self)
+            except ImportError:
+                pass
 
     # state queries (controller) -------------------------------------------
     def pending_head(self) -> SimTime:
@@ -186,7 +203,10 @@ class ColumnarPlane(DeviceRoutedPlane):
                 _walltime.perf_counter() - t0)
         if self.pending:
             t0 = _walltime.perf_counter()
-            self._extract(round_end)
+            if self._c is not None:
+                self._c.extract(round_end)
+            else:
+                self._extract(round_end)
             self.phase_wall["extract"] += _walltime.perf_counter() - t0
 
     def _extract(self, round_end: SimTime) -> None:
@@ -230,7 +250,11 @@ class ColumnarPlane(DeviceRoutedPlane):
         """Retry ingress-deferred rows against the refilled buckets, in
         host-id order, delivering inline at round_start — mirroring the
         per-unit plane's direct deliver() calls before any host event."""
-        drain, self._deferred = self._deferred, set()
+        # copy + clear in place: the set's object identity is load-bearing
+        # when the C engine is attached (it caches the set; see
+        # native/colcore/colcore.c)
+        drain = list(self._deferred)
+        self._deferred.clear()
         tokens = self.tokens_down
         boot = round_start < self.bootstrap_end
         for host in sorted(drain, key=lambda h: h.id):
@@ -262,6 +286,19 @@ class ColumnarPlane(DeviceRoutedPlane):
                 for ep in eps:
                     if ep.state != 0:  # not CLOSED
                         ep.receiver.flush_ack()
+        if self._c is not None and self.fault_filter is None:
+            # C barrier protocol: tuple = big live batch for the device
+            # dispatch machinery; True = kept rows stored inline (tick the
+            # floor cooldown, like the vector twin's non-device branch);
+            # None = nothing survived (no tick — the twin never ticks on
+            # empty rounds)
+            r = self._c.barrier(round_start, round_end)
+            if isinstance(r, tuple):
+                self._dispatch_device_batch(r, round_end)
+            elif r and self.device is not None:
+                self._floor_cooldown_tick()
+            self.phase_wall["barrier"] += _walltime.perf_counter() - t0
+            return
         emitters = self.emitters
         if not emitters:
             return
@@ -273,8 +310,11 @@ class ColumnarPlane(DeviceRoutedPlane):
         rr = self.qdisc == "round_robin"
         uids_l = None
         for h in emitters:
-            hr = h.egress_rows
-            h.egress_rows = []
+            # copy + clear in place: the egress list's object identity is
+            # load-bearing when the C engine is attached (it caches the
+            # list; see native/colcore/colcore.c)
+            hr = h.egress_rows[:]
+            h.egress_rows.clear()
             k = len(hr)
             base = (h.id << 40) | h._uid_counter
             if rr and k > 1:
@@ -520,6 +560,24 @@ class ColumnarPlane(DeviceRoutedPlane):
                 keep_rows, src_l, arrival_l, keys_l, uid_lo, uid_hi, npk,
                 thresh, forced, round_end, deadline, None))
             return
+        self._device_chunks(keep_rows, src_l, arrival, arrival_l, keys_l,
+                            uid_lo, uid_hi, npk, thresh, forced, round_end)
+
+    def _dispatch_device_batch(self, r, round_end: SimTime) -> None:
+        """A C barrier handed back a big live batch for the device draw
+        plane: route it through the same chunk loop as the vector path."""
+        keep_rows, src_l, arrival, keys_l, uid_lo, uid_hi, npk, thresh = r
+        self._device_chunks(keep_rows, src_l, arrival, arrival.tolist(),
+                            keys_l, uid_lo, uid_hi, npk, thresh, None,
+                            round_end)
+
+    def _device_chunks(self, keep_rows, src_l, arrival, arrival_l, keys_l,
+                       uid_lo, uid_hi, npk, thresh, forced,
+                       round_end: SimTime) -> None:
+        """THE device dispatch loop (single implementation — the Python
+        vector barrier and the C barrier hand-off both route here, so the
+        deadline formula and _Outstanding shape cannot drift apart)."""
+        n = len(keep_rows)
         mb = self.max_batch
         for i in range(0, n, mb):
             j = min(n, i + mb)
@@ -593,12 +651,18 @@ class ColumnarPlane(DeviceRoutedPlane):
 
     def flush_all(self) -> None:
         self.flush_due(T_NEVER + 1)
+        if self._c is not None:
+            self._c.fold_counters()
 
     def _store_resolved(self, rows, src_l, arrival, keys, flags,
                         round_end: SimTime) -> None:
         """Flags known (None = all survive): build one sorted StoreBatch —
         arrival rows for survivors, loss-notify rows (KIND_LOSS, delivered
         to the sender) for dropped units that asked for notification."""
+        if self._c is not None:
+            self._c.store_resolved(rows, src_l, arrival, keys, flags,
+                                   round_end)
+            return
         out: list = []
         nbytes_total = 0
         sent = 0
