@@ -199,7 +199,21 @@ def draw_plane_throughput(n: int = 1_000_000) -> dict:
     return out
 
 
+def ensure_native() -> None:
+    """Build the native pieces (shim + colcore) the benchmarks rely on;
+    the C engine degrades to the Python twin if absent, which would turn
+    the headline into a measurement of the wrong implementation."""
+    import subprocess
+
+    try:
+        subprocess.run(["make", "-C", str(ROOT / "native")], check=True,
+                       capture_output=True)
+    except Exception as exc:  # keep benching; colplane falls back
+        log(f"WARNING: native build failed ({exc}); C engine may be absent")
+
+
 def main() -> None:
+    ensure_native()
     ap = argparse.ArgumentParser()
     ap.add_argument("--all", action="store_true",
                     help="full matrix + BENCH_DETAIL.json")

@@ -15,12 +15,17 @@ import hashlib
 import os
 
 import numpy as np
-import pytest
 
 from shadow_tpu.config.schema import load_config
 from shadow_tpu.core.controller import Controller
 
-pytest.importorskip("shadow_tpu.native._colcore")
+import pathlib
+import subprocess
+
+subprocess.run(
+    ["make", "-C", str(pathlib.Path(__file__).resolve().parent.parent
+                       / "native")],
+    check=True, capture_output=True)
 from shadow_tpu.native import _colcore  # noqa: E402
 
 VOLATILE = ("wall_seconds", "sim_sec_per_wall_sec", "phase_wall")
