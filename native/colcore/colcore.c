@@ -302,6 +302,7 @@ typedef struct {
   int64_t H, G;
   uint64_t seed;
   int64_t bootstrap_end;
+  int mesh_mode; /* hand live batches to Python for the mesh collective */
   CHost *hs;
   /* scratch buffers reused across barriers */
   struct BRow *brow;
@@ -1081,20 +1082,28 @@ static PyObject *Core_barrier(CoreObject *c, PyObject *args) {
    * that actually bypassed the device — matching the vector twin, which
    * never ticks on empty rounds */
 
-  /* device hand-off for big live batches: the Python dispatch machinery
-   * (DeviceDrawPlane + _Outstanding) takes over with arrays we build */
+  /* hand-off paths: the Python machinery takes over with arrays we
+   * build — mesh mode hands EVERY post-bootstrap batch to the lazy
+   * collective (plus src/dst arrays); device mode hands big live
+   * batches to the draw plane */
+  /* dead batches (no loss anywhere) store inline even in mesh mode —
+   * the collective would only confirm all-false flags */
+  int mesh_off = c->mesh_mode && round_start >= c->bootstrap_end && any_live;
   if (any_live) {
     PyObject *device = PyObject_GetAttr(c->plane, S_device);
     if (!device) goto done;
     int have_dev = device != Py_None;
     Py_DECREF(device);
-    if (have_dev) {
-      PyObject *fl = PyObject_GetAttr(c->plane, S_device_floor);
-      if (!fl) goto done;
-      double floor_d = PyFloat_AsDouble(fl);
-      Py_DECREF(fl);
-      if (floor_d == -1.0 && PyErr_Occurred()) goto done;
-      if ((double)keep >= floor_d) {
+    if (have_dev || mesh_off) {
+      double floor_d = 0.0;
+      if (!mesh_off) {
+        PyObject *fl = PyObject_GetAttr(c->plane, S_device_floor);
+        if (!fl) goto done;
+        floor_d = PyFloat_AsDouble(fl);
+        Py_DECREF(fl);
+        if (floor_d == -1.0 && PyErr_Occurred()) goto done;
+      }
+      if (mesh_off || (double)keep >= floor_d) {
         npy_intp dims[1] = {keep};
         PyObject *rows_l = PyList_New(keep);
         PyObject *src_l = PyList_New(keep);
@@ -1104,11 +1113,17 @@ static PyObject *Core_barrier(CoreObject *c, PyObject *args) {
         PyObject *arr_hi = PyArray_SimpleNew(1, dims, NPY_UINT32);
         PyObject *arr_npk = PyArray_SimpleNew(1, dims, NPY_UINT32);
         PyObject *arr_th = PyArray_SimpleNew(1, dims, NPY_UINT32);
+        PyObject *arr_src = NULL, *arr_dst = NULL;
+        if (mesh_off) {
+          arr_src = PyArray_SimpleNew(1, dims, NPY_INT32);
+          arr_dst = PyArray_SimpleNew(1, dims, NPY_INT32);
+        }
         if (!rows_l || !src_l || !keys_l || !arr_t || !arr_lo || !arr_hi ||
-            !arr_npk || !arr_th) {
+            !arr_npk || !arr_th || (mesh_off && (!arr_src || !arr_dst))) {
           Py_XDECREF(rows_l); Py_XDECREF(src_l); Py_XDECREF(keys_l);
           Py_XDECREF(arr_t); Py_XDECREF(arr_lo); Py_XDECREF(arr_hi);
           Py_XDECREF(arr_npk); Py_XDECREF(arr_th);
+          Py_XDECREF(arr_src); Py_XDECREF(arr_dst);
           goto done;
         }
         int64_t *pt = PyArray_DATA((PyArrayObject *)arr_t);
@@ -1116,6 +1131,10 @@ static PyObject *Core_barrier(CoreObject *c, PyObject *args) {
         uint32_t *phi = PyArray_DATA((PyArrayObject *)arr_hi);
         uint32_t *pnp = PyArray_DATA((PyArrayObject *)arr_npk);
         uint32_t *pth = PyArray_DATA((PyArrayObject *)arr_th);
+        int32_t *psrc = mesh_off
+            ? PyArray_DATA((PyArrayObject *)arr_src) : NULL;
+        int32_t *pdst = mesh_off
+            ? PyArray_DATA((PyArrayObject *)arr_dst) : NULL;
         int fail = 0;
         for (int i = 0; i < keep && !fail; i++) {
           BRow *b = &c->brow[i];
@@ -1131,17 +1150,27 @@ static PyObject *Core_barrier(CoreObject *c, PyObject *args) {
           phi[i] = (uint32_t)(b->uid >> 32);
           pnp[i] = (uint32_t)b->npk;
           pth[i] = b->th;
+          if (mesh_off) {
+            psrc[i] = b->src;
+            pdst[i] = b->dst;
+          }
         }
         if (fail) {
           Py_DECREF(rows_l); Py_DECREF(src_l); Py_DECREF(keys_l);
           Py_DECREF(arr_t); Py_DECREF(arr_lo); Py_DECREF(arr_hi);
           Py_DECREF(arr_npk); Py_DECREF(arr_th);
+          Py_XDECREF(arr_src); Py_XDECREF(arr_dst);
           goto done;
         }
-        result = Py_BuildValue("(NNNNNNNN)", rows_l, src_l, arr_t, keys_l,
-                               arr_lo, arr_hi, arr_npk, arr_th);
+        if (mesh_off)
+          result = Py_BuildValue("(NNNNNNNNNN)", rows_l, src_l, arr_t,
+                                 keys_l, arr_lo, arr_hi, arr_npk, arr_th,
+                                 arr_src, arr_dst);
+        else
+          result = Py_BuildValue("(NNNNNNNN)", rows_l, src_l, arr_t,
+                                 keys_l, arr_lo, arr_hi, arr_npk, arr_th);
         if (!result) goto done;
-        goto done; /* rows now referenced by rows_l; eglists can drop */
+        goto done; /* row refs now held by rows_l */
       }
     }
   }
@@ -1525,6 +1554,10 @@ static int Core_init(CoreObject *c, PyObject *args, PyObject *kwds) {
   if (attr_i64(plane, PyUnicode_InternFromString("bootstrap_end"),
                &c->bootstrap_end) < 0)
     return -1;
+  PyObject *mp = PyObject_GetAttrString(plane, "mesh_plane");
+  if (!mp) return -1;
+  c->mesh_mode = mp != Py_None;
+  Py_DECREF(mp);
   PyObject *mod = PyImport_ImportModule("shadow_tpu.network.colplane");
   if (!mod) return -1;
   c->storebatch_cls = PyObject_GetAttrString(mod, "StoreBatch");

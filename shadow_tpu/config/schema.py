@@ -91,6 +91,9 @@ class ExperimentalOptions:
     tpu_max_batch: int = 65536  # max units per device draw dispatch
     tpu_device_floor: int = 0  # min batch to engage the device; 0 = calibrate
     tpu_mesh_shards: int = 0  # 0 = all local devices
+    #: tpu_mesh: min due-window units for the collective program; smaller
+    #: windows take the bit-identical numpy twin
+    tpu_mesh_floor: int = 2048
     #: C engine for the columnar plane (native/colcore). Bit-identical to
     #: the Python paths; off forces the pure-Python twin (test oracle).
     native_colcore: bool = True
@@ -230,6 +233,7 @@ def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
     e.tpu_max_batch = int(exp.get("tpu_max_batch", 65536))
     e.tpu_device_floor = int(exp.get("tpu_device_floor", 0))
     e.tpu_mesh_shards = int(exp.get("tpu_mesh_shards", 0))
+    e.tpu_mesh_floor = int(exp.get("tpu_mesh_floor", 2048))
     e.native_colcore = bool(exp.get("native_colcore", True))
 
     hosts_doc = doc.get("hosts", {}) or {}

@@ -30,11 +30,15 @@ represents traffic as batch-level data end-to-end:
   inbox with its timer heap by (time, band, key) — the same canonical
   order the per-unit plane produces (core/events.py BAND_NET) — charging
   the ingress token bucket per row at dispatch time, in event order.
-- **The mesh plane (tpu_mesh) rides the same machinery**: the whole round
-  (departures, draws, all_to_all arrival exchange, pmin barrier) runs as
-  one sharded XLA program per chunk; exchange tables stream back
-  asynchronously and materialize at the g_min barrier, and blackholed
-  units charge their buckets device-side without producing arrivals.
+- **The mesh plane (tpu_mesh) rides the same machinery**: departures are
+  host-side closed form (bit-equal to the device math), and everything
+  deferrable — per-packet loss draws plus the all_to_all arrival
+  exchange and pmin barrier — accumulates per causal window and resolves
+  as ONE sharded XLA program at the window's earliest-arrival deadline
+  (parallel/mesh.py::_exchange_rounds). Windows below
+  ``experimental.tpu_mesh_floor`` units take the numpy twin instead
+  (identical flags; the collective's fixed cost loses on tiny windows),
+  the same adaptive discipline as the device draw floor.
 
 Equivalence argument (why the two planes cannot diverge): unit identity
 (uids), event keys, egress-bucket charge order, ingress charge order, and
@@ -160,6 +164,10 @@ class ColumnarPlane(DeviceRoutedPlane):
         self.min_used_latency: SimTime = T_NEVER
         self.qdisc = str(getattr(tpu_options, "interface_qdisc", "fifo")
                          or "fifo")
+        #: minimum due-window unit count for the mesh collective; smaller
+        #: windows resolve on the numpy twin (identical flags)
+        _mf = getattr(tpu_options, "tpu_mesh_floor", None)
+        self.mesh_floor = 2048 if _mf is None else int(_mf)
         #: per-phase wall-clock breakdown (VERDICT r2 item #7); merged into
         #: the run summary by the controller
         self.phase_wall = {"barrier": 0.0, "draw_flush": 0.0,
@@ -172,7 +180,7 @@ class ColumnarPlane(DeviceRoutedPlane):
         #: tests/test_colcore.py + the cross-plane suite); absent or
         #: disabled, everything below runs pure Python.
         self._c = None
-        if (backend == "tpu" and self.qdisc == "fifo"
+        if (backend in ("tpu", "mesh") and self.qdisc == "fifo"
                 and getattr(tpu_options, "native_colcore", True)):
             try:
                 from shadow_tpu.native import _colcore
@@ -294,7 +302,10 @@ class ColumnarPlane(DeviceRoutedPlane):
             # empty rounds)
             r = self._c.barrier(round_start, round_end)
             if isinstance(r, tuple):
-                self._dispatch_device_batch(r, round_end)
+                if len(r) == 10:  # mesh hand-off (src/dst arrays appended)
+                    self._queue_mesh_batch(r, round_end)
+                else:
+                    self._dispatch_device_batch(r, round_end)
             elif r and self.device is not None:
                 self._floor_cooldown_tick()
             self.phase_wall["barrier"] += _walltime.perf_counter() - t0
@@ -344,27 +355,47 @@ class ColumnarPlane(DeviceRoutedPlane):
             self._barrier_vector(rows, segs, round_start, round_end, uids_l)
         self.phase_wall["barrier"] += _walltime.perf_counter() - t0
 
-    def _mesh_dispatch(self, mesh_full, round_start: SimTime):
-        """Chunk the FULL (pre-blackhole-filter) batch through the mesh
-        round program; returns (device tables, earliest-arrival deadline).
-        Sequential chunks at one t_now advance the device bucket state
-        exactly like a single batched call (per-source FIFO preserved by
-        chunking in emission order)."""
-        ups = self.mesh_plane.units_per_shard
-        fs, fd, fsz, fte, fu, frk = mesh_full
-        parts = []
-        deadline = T_NEVER
-        for i in range(0, len(fs), ups):
-            j = min(len(fs), i + ups)
-            recv_dev, gmin = self.mesh_plane.round_step_async(
-                self.mesh_plane.shard_units(
-                    fs[i:j], fd[i:j], fsz[i:j], fte[i:j], fu[i:j],
-                    frk[i:j]),
-                t_now=int(round_start))
-            parts.append(recv_dev)
-            if gmin < deadline:
-                deadline = gmin
-        return parts, deadline
+    def _mesh_materialize(self) -> None:
+        """Resolve EVERY lazily-accumulated mesh barrier in one fused
+        collective dispatch (VERDICT r3 item #2): the accumulated window's
+        units run through draws + the all_to_all arrival exchange as one
+        sharded program (parallel/mesh.py::_exchange_rounds); each
+        barrier's handle then reads its own units out of the shared
+        exchange tables. Draws are pure functions of unit identity, so
+        batch order is immaterial — the lazy-numpy coalescing discipline,
+        one program instead of one per barrier."""
+        pend = [b for b in self.outstanding
+                if isinstance(b.handle, _MeshLazy)]
+        if not pend:
+            return
+        total = sum(len(b.handle.uid) for b in pend)
+        if total < self.mesh_floor:
+            # small window: the collective's fixed program cost loses to
+            # the numpy twin — convert to lazily-coalesced numpy batches
+            # (flags identical either way: pure functions of identity)
+            for b in pend:
+                h = b.handle
+                u = h.uid.astype(np.uint64)
+                b.uid_lo = (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+                b.uid_hi = (u >> np.uint64(32)).astype(np.uint32)
+                b.npk = h.npk.astype(np.uint32)
+                b.thresh = h.th.astype(np.uint32)
+                b.handle = None
+            return
+
+        def cat(field):
+            return np.concatenate([getattr(b.handle, field) for b in pend])
+
+        parts = self.mesh_plane.exchange_rounds(
+            cat("src"), cat("dst"), cat("arrival"), cat("uid"),
+            cat("npk"), cat("th"))
+        from shadow_tpu.parallel.mesh import F_FLAGS, F_UID
+
+        tab = np.concatenate(parts) if len(parts) > 1 else parts[0]
+        tab = tab[tab[:, F_FLAGS] >= 2]  # valid rows only
+        tab = tab[np.argsort(tab[:, F_UID])]  # sorted ONCE, shared
+        for b in pend:
+            b.handle = _MeshHandle(tab, b.handle.uid)
 
     # -- scalar barrier (exact twin of the vector math, for tiny rounds) ---
     def _barrier_scalar(self, rows, segs, round_start: SimTime,
@@ -468,9 +499,11 @@ class ColumnarPlane(DeviceRoutedPlane):
                     and round_start >= self.bootstrap_end)
         if round_start < self.bootstrap_end:
             depart = t_emit.copy()  # bootstrap: unlimited bandwidth
-        elif use_mesh:
-            depart = None  # the sharded program computes departures
         else:
+            # host-side closed-form departures for EVERY backend — the
+            # math is bit-equal on host and device (test_multichip), and
+            # computing it where the emissions originate is what lets the
+            # mesh plane defer its collective to the causal deadline
             depart = self.buckets.depart_times(src, size, t_emit,
                                                round_start)
 
@@ -482,12 +515,6 @@ class ColumnarPlane(DeviceRoutedPlane):
         reach = lat < INF_I64
         n_bh = n - int(reach.sum())
         keep_rows = rows
-        if use_mesh:
-            # the DEVICE buckets must be charged for blackholed units too
-            # (host planes charge before the reach filter): feed the FULL
-            # batch with routable flags, consume results for survivors
-            mesh_full = (src, dst, size, t_emit, uid.astype(np.int64),
-                         reach.astype(np.int64))
         if n_bh:
             self.units_blackholed += n_bh
             keep = np.flatnonzero(reach)
@@ -495,15 +522,11 @@ class ColumnarPlane(DeviceRoutedPlane):
             keep_rows = [rows[i] for i in kl]
             src, dst, sn, dn = src[keep], dst[keep], sn[keep], dn[keep]
             lat = lat[keep]
-            if depart is not None:
-                depart = depart[keep]
+            depart = depart[keep]
             size, t_emit, uid = size[keep], t_emit[keep], uid[keep]
             n = len(kl)
             if n == 0:
-                if use_mesh:
-                    # charge-only dispatch: every unit was unroutable
-                    self._mesh_dispatch(mesh_full, round_start)
-                return
+                return  # buckets already charged for the full batch
 
         ml = int(lat.min())
         if ml < self.min_used_latency:
@@ -524,21 +547,33 @@ class ColumnarPlane(DeviceRoutedPlane):
             if not any(forced):
                 forced = None
 
-        if use_mesh:
-            # dispatch the whole-round sharded program per chunk; bucket
-            # state advances on device, the exchange tables stream back in
-            # the background and materialize at the g_min barrier (the
-            # causal deadline) like the single-chip plane's draw batches
-            parts, deadline = self._mesh_dispatch(mesh_full, round_start)
-            handle = _MeshHandle(parts, uid.astype(np.int64))
-            self.outstanding.append(_Outstanding(
-                keep_rows, src_l, None, keys_l, None, None, None, None,
-                forced, round_end, max(round_end, deadline), handle))
-            return
         arrival = depart + lat
         arrival_l = arrival.tolist()
 
         live = bool((thresh > 0).any())
+        if use_mesh:
+            if not live and forced is None:
+                # nothing can drop: straight to the store (the collective
+                # would only confirm all-false flags)
+                self._store_resolved(keep_rows, src_l, arrival_l, keys_l,
+                                     None, round_end)
+                return
+            # LAZY collective batch: arrivals are known host-side, draws
+            # are pure functions of unit identity, so the whole causal
+            # window (every barrier until the earliest arrival comes due)
+            # resolves in ONE sharded draws+all_to_all+pmin program at
+            # flush (_mesh_materialize) — fused across rounds, not
+            # dispatch-bound per barrier (VERDICT r3 item #2).
+            npk = np.minimum(np.maximum(1, -(-size // MTU)),
+                             HARD_MAX_PKTS).astype(np.int64)
+            deadline = max(round_end, int(arrival.min()))
+            self.outstanding.append(_Outstanding(
+                keep_rows, src_l, arrival_l, keys_l, None, None, None,
+                None, forced, round_end, deadline,
+                _MeshLazy(src.astype(np.int64), dst.astype(np.int64),
+                          arrival, uid.astype(np.int64), npk,
+                          thresh.astype(np.int64))))
+            return
         use_device = (self.device is not None and live
                       and n >= self.device_floor)
         if not use_device:
@@ -562,6 +597,21 @@ class ColumnarPlane(DeviceRoutedPlane):
             return
         self._device_chunks(keep_rows, src_l, arrival, arrival_l, keys_l,
                             uid_lo, uid_hi, npk, thresh, forced, round_end)
+
+    def _queue_mesh_batch(self, r, round_end: SimTime) -> None:
+        """C-barrier mesh hand-off: append the lazy collective batch
+        exactly as the Python vector path does."""
+        (keep_rows, src_l, arrival, keys_l, uid_lo, uid_hi, npk, thresh,
+         src_a, dst_a) = r
+        uid64 = (uid_lo.astype(np.int64)
+                 | (uid_hi.astype(np.int64) << np.int64(32)))
+        deadline = max(round_end, int(arrival.min()))
+        self.outstanding.append(_Outstanding(
+            keep_rows, src_l, arrival.tolist(), keys_l, None, None, None,
+            None, None, round_end, deadline,
+            _MeshLazy(src_a.astype(np.int64), dst_a.astype(np.int64),
+                      arrival, uid64, npk.astype(np.int64),
+                      thresh.astype(np.int64))))
 
     def _dispatch_device_batch(self, r, round_end: SimTime) -> None:
         """A C barrier handed back a big live batch for the device draw
@@ -604,6 +654,8 @@ class ColumnarPlane(DeviceRoutedPlane):
         if not any(b.deadline < limit for b in self.outstanding):
             return
         t0 = _walltime.perf_counter()
+        if self.mesh_plane is not None:
+            self._mesh_materialize()
         take = [b for b in self.outstanding
                 if b.handle is None or b.deadline < limit]
         self.outstanding = deque(
@@ -715,27 +767,42 @@ class ColumnarPlane(DeviceRoutedPlane):
             self.pending.append(StoreBatch(out))
 
 
+class _MeshLazy:
+    """A barrier's units awaiting the fused collective (draws + arrival
+    exchange): post-blackhole arrays, arrivals already resolved host-side.
+    Converted to a _MeshHandle over the window's shared exchange tables by
+    _mesh_materialize."""
+
+    __slots__ = ("src", "dst", "arrival", "uid", "npk", "th")
+
+    def __init__(self, src, dst, arrival, uid, npk, th):
+        self.src = src
+        self.dst = dst
+        self.arrival = arrival
+        self.uid = uid
+        self.npk = npk
+        self.th = th
+
+
 class _MeshHandle:
-    """In-flight mesh-round exchange tables: read() materializes them and
-    yields per-unit (arrival, dropped) for the surviving uids."""
+    """A barrier's view over the window's uid-sorted exchange table (built
+    once in _mesh_materialize, shared across the window's barriers)."""
 
-    __slots__ = ("parts", "uids")
+    __slots__ = ("tab", "uids")
 
-    def __init__(self, parts, uids):
-        self.parts = parts  # device arrays, host copies streaming
+    def __init__(self, tab, uids):
+        self.tab = tab  # (rows, 4) int64, valid rows only, uid-ascending
         self.uids = uids  # (n,) int64, batch order (post blackhole filter)
 
     def read(self):
         from shadow_tpu.parallel.mesh import F_FLAGS, F_TARR, F_UID
 
-        tabs = []
-        for r in self.parts:
-            t = np.asarray(r).reshape(-1, r.shape[-1])
-            tabs.append(t[t[:, F_FLAGS] >= 2])  # valid rows only
-        tab = np.concatenate(tabs) if len(tabs) > 1 else tabs[0]
-        order = np.argsort(tab[:, F_UID])
-        tab = tab[order]
+        tab = self.tab
         idx = np.searchsorted(tab[:, F_UID], self.uids)
+        if (idx >= len(tab)).any() or (tab[idx, F_UID] != self.uids).any():
+            raise RuntimeError(
+                "mesh exchange table is missing units — collective "
+                "routing bug (capacity truncation?)")
         return tab[idx, F_TARR], (tab[idx, F_FLAGS] & 1).astype(bool)
 
 

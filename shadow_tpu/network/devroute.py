@@ -57,7 +57,12 @@ class DeviceRoutedPlane:
 
             n_shards = int(getattr(tpu_options, "tpu_mesh_shards", 0) or 0)
             n = n_shards or len(jax.devices())
-            ups = max(1024, self.max_batch // n)
+            # per-shard slot width: every scan step pads to (N, C), so C
+            # tracks realistic per-barrier chunk sizes, not max_batch —
+            # bulk barriers just span more fused steps. Chunk boundaries
+            # cannot change results (sequential chunks at one t_now equal
+            # one batched call).
+            ups = max(256, min(2048, 4096 // n))
             self.mesh_plane = MeshDataPlane(
                 params, n_shards=n, units_per_shard=ups,
                 max_pkts=self.max_pkts)
