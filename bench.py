@@ -121,6 +121,153 @@ def managed_bench(n_servers: int = 10, n_clients: int = 40,
     return out
 
 
+def managed_dense_bench(n_procs: int = 4, iters: int = 15000,
+                        chunk: int = 512) -> dict:
+    """Syscall-DENSE managed benchmark (VERDICT r3 item #5 / weak #4):
+    each process does ``iters`` write+read round trips through an
+    emulated pipe (>= 30k trapped syscalls/process), so the number is the
+    steady-state shim<->worker service rate, not spawn cost. The round-3
+    managed_50 figure (1,316 syscalls/s over ~19 syscalls/process) was
+    spawn-dominated; this measures the path the shmem fast paths serve."""
+    import subprocess
+    import time as _t
+
+    from shadow_tpu.config import parse_config
+    from shadow_tpu.core.controller import Controller
+
+    build = ROOT / "native" / "build"
+    subprocess.run(["make", "-C", str(ROOT / "native")], check=True,
+                   capture_output=True)
+    doc = {
+        "general": {"stop_time": "60s", "seed": 3,
+                    "data_directory": "/tmp/shadow-bench-pump"},
+        "network": {"graph": {"type": "gml", "inline": """graph [
+  directed 0
+  node [ id 0 host_bandwidth_up "1 Gbit" host_bandwidth_down "1 Gbit" ]
+  edge [ source 0 target 0 latency "2 ms" ]
+]"""}},
+        "hosts": {
+            f"box{i}": {"network_node_id": 0, "processes": [
+                {"path": str(build / "pump"),
+                 "args": [str(iters), str(chunk)],
+                 "expected_final_state": {"exited": 0}}]}
+            for i in range(n_procs)
+        },
+    }
+    cfg = parse_config(doc, {})
+    t0 = _t.perf_counter()
+    res = Controller(cfg, mirror_log=False).run()
+    wall = _t.perf_counter() - t0
+    sysc = res["counters"].get("syscalls", 0)
+    out = {
+        "processes": n_procs,
+        "round_trips_per_process": 2 * iters,
+        "syscalls": sysc,
+        "syscalls_per_wall_sec": round(sysc / wall, 1),
+        "wall_s": round(wall, 3),
+        "errors": len(res["process_errors"]),
+    }
+    log(f"managed_dense: {sysc} syscalls / {wall:.2f}s = "
+        f"{out['syscalls_per_wall_sec']:.0f}/s steady-state")
+    return out
+
+
+def _tor_doc(n_relays: int, n_clients: int, stop_s: int,
+             fetch: str = "20 kB") -> dict:
+    """Config #5 generator (BASELINE.md): onion-routing at tornettools
+    shape — TorRelay/TorExit relays, TGen web servers, TorClients
+    building 3-hop circuits and fetching through them, on a 64-node
+    random graph. Deterministic from the fixed seed."""
+    import sys as _sys
+
+    import numpy as np
+
+    _sys.path.insert(0, str(ROOT / "tools"))
+    from gen_benchmarks import random_gml
+
+    rng = np.random.default_rng(42)
+    g = 64
+    gml = random_gml(rng, g, min_lat_ms=10, max_lat_ms=120, max_loss=0.002,
+                     bw_choices=("50 Mbit", "100 Mbit", "1 Gbit"))
+    hosts = {}
+    for i in range(n_relays):
+        cls = "TorExit" if i % 8 == 0 else "TorRelay"
+        hosts[f"relay{i}"] = {
+            "network_node_id": int(rng.integers(0, g)),
+            "processes": [{"path": f"pyapp:shadow_tpu.models.tor:{cls}",
+                           "args": ["9001"]}]}
+    for i in range(20):
+        hosts[f"web{i}"] = {
+            "network_node_id": int(rng.integers(0, g)),
+            "processes": [{"path": "pyapp:shadow_tpu.models.tgen:TGenServer",
+                           "args": ["80"]}]}
+    per = n_clients // g
+    for i in range(g):
+        q = per + (n_clients - per * g if i == g - 1 else 0)
+        hosts[f"u{i}_"] = {
+            "network_node_id": i, "quantity": q,
+            "processes": [{"path": "pyapp:shadow_tpu.models.tor:TorClient",
+                           "args": [str(n_relays), "9001", f"web{i % 20}",
+                                    "80", fetch, "1"],
+                           "start_time": f"{2000 + i * 150} ms"}]}
+    return {"general": {"stop_time": f"{stop_s}s", "seed": 6},
+            "network": {"graph": {"type": "gml", "inline": gml}},
+            "hosts": hosts}
+
+
+def tor_100k(stop_s: int = 15) -> dict:
+    """BASELINE config #5 as a real bench row (VERDICT r3 item #6):
+    7,000 relays + 100,000 clients through the columnar plane + C
+    engine. Publishes sim-s/wall-s, RSS, events, completed fetches.
+    Determinism gate: a 1/10-scale twin (700 relays + 10k clients) runs
+    TWICE and must match on every result field (the full config once is
+    ~5-8 min on one core; twice would double the bench for no extra
+    information — the machinery is scale-invariant)."""
+    import resource
+    import time as _t
+
+    from shadow_tpu.config import parse_config
+    from shadow_tpu.core.controller import Controller
+
+    def run(doc, tag):
+        cfg = parse_config(doc, {
+            "general.data_directory": f"/tmp/shadow-bench-{tag}",
+            "experimental.scheduler_policy": "tpu_batch"})
+        ctl = Controller(cfg, mirror_log=False)
+        t0 = _t.perf_counter()
+        r = ctl.run()
+        wall = _t.perf_counter() - t0
+        fetches = sum(p.app.completed for h in ctl.hosts
+                      for p in h.processes
+                      if type(p.app).__name__ == "TorClient")
+        return r, wall, fetches
+
+    small = _tor_doc(700, 10_000, 8)
+    a, _, fa = run(small, "tor10k-a")
+    b, _, fb = run(small, "tor10k-b")
+    for k in ("events", "units_sent", "units_dropped", "bytes_sent",
+              "rounds", "counters"):
+        assert a[k] == b[k], f"tor determinism: {k} diverged"
+    assert fa == fb
+    log(f"tor_10k determinism OK ({a['events']} events, {fa} fetches)")
+
+    doc = _tor_doc(7000, 100_000, stop_s)
+    r, wall, fetches = run(doc, "tor100k")
+    rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+    out = {
+        "relays": 7000, "clients": 100_000, "sim_seconds": stop_s,
+        "wall_s": round(wall, 1),
+        "sim_sec_per_wall_sec": round(r["sim_sec_per_wall_sec"], 4),
+        "events": r["events"], "units_sent": r["units_sent"],
+        "fetches_completed": fetches,
+        "rss_gb": round(rss, 2),
+        "errors": len(r["process_errors"]),
+    }
+    log(f"tor_100k: {out['sim_sec_per_wall_sec']} sim-s/wall-s, "
+        f"{out['events']} events, {fetches} fetches, {out['rss_gb']} GB RSS")
+    return out
+
+
 def mesh_scaling(config: str = "examples/tgen_100host.yaml") -> dict:
     """tpu_mesh scaling table (VERDICT r2 item #2): the whole-round
     sharded program over 1/2/4/8 shards of an 8-virtual-device CPU mesh
@@ -284,6 +431,8 @@ def main() -> None:
                 assert (detail[tag]["thread_per_core"][k]
                         == detail[tag]["tpu_batch"][k]), (tag, k)
         detail["managed_50"] = managed_bench()
+        detail["managed_dense"] = managed_dense_bench()
+        detail["tor_100k"] = tor_100k()
         detail["tpu_mesh_scaling"] = mesh_scaling()
         detail["draw_plane"] = draw_plane_throughput()
         for tag in ("tgen_1k", "tgen_100", "tor_400", "gossip_10k"):

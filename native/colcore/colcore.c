@@ -68,6 +68,13 @@ static inline int64_t nsnow(void) {
 #define KIND_DGRAM 6
 #define KIND_LOSS_C 16
 #define TX_SIZE 400
+/* stream unit kinds (network/unit.py order) */
+#define TK_SYN 0
+#define TK_SYNACK 1
+#define TK_DATA 2
+#define TK_ACK 3
+#define TK_FIN 4
+#define TK_FINACK 5
 
 /* ---- threefry2x32-20 (ops/prng.py twin; Salmon et al. SC'11) ---------- */
 static inline uint32_t rotl32(uint32_t x, int r) {
@@ -251,7 +258,8 @@ typedef struct {
   PyObject *row; /* owned ref while in the inbox */
   /* dispatch fields pre-read at extraction (the tuple is cache-warm
    * there; re-reading it at dispatch costs a cold pointer chase) */
-  int32_t size, peer, bport;
+  int64_t nbytes, seq; /* stream dispatch: cum-ack / byte offset ride here */
+  int32_t size, peer, bport, aport;
   int16_t kind;
   int16_t single_frag;
 } IRow;
@@ -262,7 +270,8 @@ struct GossipState_s;
  * IRow's pre-read dispatch fields */
 typedef struct {
   int64_t t, key;
-  int32_t tgt, size, peer, bport;
+  int64_t nbytes, seq;
+  int32_t tgt, size, peer, bport, aport;
   int16_t kind;
   int16_t single_frag;
 } SRec;
@@ -275,6 +284,8 @@ typedef struct {
   PyObject *cancelled; /* owned: equeue._cancelled set */
   int py_mode;         /* pcap etc.: dispatch through Python run_events */
   PyObject *egress;    /* owned: host.egress_rows (identity-stable) */
+  PyObject *conns;     /* owned: host._conns dict (identity-stable) */
+  PyObject *listeners; /* owned: host._listeners dict (identity-stable) */
   /* C-registered datagram ports (gossip); tiny linear table */
   int nports;
   int port[4];
@@ -284,6 +295,8 @@ typedef struct {
   int inbox_n, inbox_cap, inbox_last_slice, inbox_multi;
   /* per-round counter deltas, flushed to host attrs after run_host */
   int64_t d_emitted, d_delivered, d_dgrams, d_dgrams_recv, d_events;
+  /* stream-transport + routing counter deltas (host.counters keys) */
+  int64_t d_sbytes_q, d_sbytes_recv, d_resets, d_unroutable;
 } CHost;
 
 typedef struct {
@@ -302,6 +315,8 @@ typedef struct {
   int64_t H, G;
   uint64_t seed;
   int64_t bootstrap_end;
+  int64_t unit_chunk; /* fluid quantum payload bytes (Host.unit_chunk) */
+  int64_t sock_sbuf, sock_rbuf; /* experimental.socket_*_buffer */
   int mesh_mode; /* hand live batches to Python for the mesh collective */
   CHost *hs;
   /* scratch buffers reused across barriers */
@@ -462,6 +477,47 @@ static int core_emit_dgram_inner(CoreObject *c, CHost *h, int64_t now,
   return 0;
 }
 
+/* generalized emission: the C twin of Host.emit_msg's columnar branch */
+static int core_emit_fields(CoreObject *c, CHost *h, int64_t now,
+                            int kind, int dst, int64_t size, int64_t nbytes,
+                            PyObject *payload, int64_t seq, int sport,
+                            int dport, int frag, int nfrags, int want_loss) {
+  PyObject *eg = h->egress;
+  if (PyList_GET_SIZE(eg) == 0) {
+    PyObject *em = PyObject_GetAttr(c->plane, S_emitters);
+    if (!em) return -1;
+    int r = PyList_Append(em, h->host);
+    Py_DECREF(em);
+    if (r < 0) return -1;
+  }
+  PyObject *t = PyTuple_New(12);
+  if (!t) return -1;
+  PyTuple_SET_ITEM(t, 0, PyLong_FromLong(kind));
+  PyTuple_SET_ITEM(t, 1, PyLong_FromLong(dst));
+  PyTuple_SET_ITEM(t, 2, PyLong_FromLongLong(size));
+  PyTuple_SET_ITEM(t, 3, PyLong_FromLongLong(now));
+  PyTuple_SET_ITEM(t, 4, PyLong_FromLong(sport));
+  PyTuple_SET_ITEM(t, 5, PyLong_FromLong(dport));
+  PyTuple_SET_ITEM(t, 6, PyLong_FromLongLong(nbytes));
+  PyTuple_SET_ITEM(t, 7, PyLong_FromLongLong(seq));
+  PyTuple_SET_ITEM(t, 8, PyLong_FromLong(frag));
+  PyTuple_SET_ITEM(t, 9, PyLong_FromLong(nfrags));
+  PyObject *wl = want_loss ? Py_True : Py_False;
+  Py_INCREF(wl);
+  PyTuple_SET_ITEM(t, 10, wl);
+  if (!payload) payload = Py_None;
+  Py_INCREF(payload);
+  PyTuple_SET_ITEM(t, 11, payload);
+  for (Py_ssize_t i = 0; i < 10; i++) {
+    if (!PyTuple_GET_ITEM(t, i)) { Py_DECREF(t); return -1; }
+  }
+  int r = PyList_Append(eg, t);
+  Py_DECREF(t);
+  if (r < 0) return -1;
+  h->d_emitted++;
+  return 0;
+}
+
 /* ---- the gossip model's hot half (models/gossip.py twin) --------------- */
 static PyObject *msg_bytes(char kind, const char *txid, Py_ssize_t n) {
   PyObject *b = PyBytes_FromStringAndSize(NULL, n + 1);
@@ -530,9 +586,14 @@ static int gossip_on_msg_c(CoreObject *c, CHost *h, GossipState *g,
 /* ---- row dispatch (Host.dispatch_row twin) ----------------------------
  * Returns 0 ok, -1 error. `*now` is the host's running clock; kept in C
  * and synced to host._now around any Python call-out. */
+static int dispatch_stream(CoreObject *c, CHost *h, int hid, IRow *ir,
+                           int64_t *now, int *now_dirty);
+
 static int dispatch_c(CoreObject *c, CHost *h, int hid, IRow *ir,
                       int64_t *now, int *now_dirty) {
   int64_t t = ir->t;
+  if (ir->kind <= TK_FINACK || ir->kind == KIND_LOSS_C)
+    return dispatch_stream(c, h, hid, ir, now, now_dirty);
   GossipState *g = NULL;
   if (ir->kind == KIND_DGRAM && ir->single_frag) {
     for (int i = 0; i < h->nports; i++)
@@ -806,6 +867,9 @@ static int store_build(CoreObject *c, BRow *rows, int n, int have_flags,
       rc2->size = (int32_t)b->size;
       rc2->peer = out[i].loss ? b->dst : b->src;
       rc2->bport = (int32_t)tup_i64(er, 5); /* dport */
+      rc2->aport = (int32_t)tup_i64(er, 4);  /* sport */
+      rc2->nbytes = tup_i64(er, 6);
+      rc2->seq = tup_i64(er, 7);
       rc2->kind = out[i].loss ? KIND_LOSS_C : (int16_t)tup_i64(er, 0);
       rc2->single_frag = tup_i64(er, 9) == 1; /* nfrags */
       PyObject *t = PyTuple_New(13);
@@ -1232,6 +1296,9 @@ static int inbox_push_rec(CHost *h, const SRec *s, PyObject *row,
   r->kind = s->kind;
   r->peer = s->peer;
   r->bport = s->bport;
+  r->aport = s->aport;
+  r->nbytes = s->nbytes;
+  r->seq = s->seq;
   r->single_frag = s->single_frag;
   r->size = s->size;
   return 0;
@@ -1249,7 +1316,10 @@ static int inbox_push(CHost *h, int64_t t, int64_t key, PyObject *row,
   r->row = row;
   r->kind = (int16_t)tup_i64(row, 3);
   r->peer = (int32_t)tup_i64(row, 4);
+  r->aport = (int32_t)tup_i64(row, 5);
   r->bport = (int32_t)tup_i64(row, 6);
+  r->nbytes = tup_i64(row, 7);
+  r->seq = tup_i64(row, 8);
   r->single_frag = tup_i64(row, 10) == 1;
   r->size = (int32_t)tup_i64(row, 11);
   return 0;
@@ -1476,6 +1546,8 @@ static void Core_dealloc(CoreObject *c) {
       Py_XDECREF(h->live);
       Py_XDECREF(h->cancelled);
       Py_XDECREF(h->egress);
+      Py_XDECREF(h->conns);
+      Py_XDECREF(h->listeners);
       for (int j = 0; j < h->inbox_n; j++) Py_XDECREF(h->inbox[j].row);
       free(h->inbox);
       for (int j = 0; j < h->nports; j++) Py_XDECREF(h->gs[j]);
@@ -1558,6 +1630,7 @@ static int Core_init(CoreObject *c, PyObject *args, PyObject *kwds) {
   if (!mp) return -1;
   c->mesh_mode = mp != Py_None;
   Py_DECREF(mp);
+  c->unit_chunk = 0; /* filled from hosts[0] below (config-uniform) */
   PyObject *mod = PyImport_ImportModule("shadow_tpu.network.colplane");
   if (!mod) return -1;
   c->storebatch_cls = PyObject_GetAttrString(mod, "StoreBatch");
@@ -1591,6 +1664,28 @@ static int Core_init(CoreObject *c, PyObject *args, PyObject *kwds) {
     if (!PyList_Check(h->egress)) {
       PyErr_SetString(PyExc_TypeError, "host.egress_rows must be a list");
       return -1;
+    }
+    h->conns = PyObject_GetAttrString(host, "_conns");
+    h->listeners = PyObject_GetAttrString(host, "_listeners");
+    if (!h->conns || !h->listeners) return -1;
+    if (i == 0) {
+      int64_t uc;
+      if (attr_i64(host, PyUnicode_InternFromString("unit_chunk"), &uc) < 0)
+        return -1;
+      c->unit_chunk = uc;
+      PyObject *exp = NULL, *ctl2 = PyObject_GetAttrString(host,
+                                                           "controller");
+      PyObject *cfg2 = ctl2 ? PyObject_GetAttrString(ctl2, "cfg") : NULL;
+      exp = cfg2 ? PyObject_GetAttrString(cfg2, "experimental") : NULL;
+      int ok2 = exp &&
+          attr_i64(exp, PyUnicode_InternFromString("socket_send_buffer"),
+                   &c->sock_sbuf) == 0 &&
+          attr_i64(exp, PyUnicode_InternFromString("socket_recv_buffer"),
+                   &c->sock_rbuf) == 0;
+      Py_XDECREF(exp);
+      Py_XDECREF(cfg2);
+      Py_XDECREF(ctl2);
+      if (!ok2) return -1;
     }
   }
   return 0;
@@ -1662,9 +1757,32 @@ static PyObject *Core_fold_counters(CoreObject *c, PyObject *noarg) {
       return NULL;
     h->d_emitted = h->d_delivered = h->d_dgrams = h->d_dgrams_recv = 0;
     h->d_events = 0;
+    /* stream/routing counters go through host.counters.add (key space
+     * shared with the Python transport) */
+    static const char *names2[4] = {"stream_bytes_queued",
+                                    "stream_bytes_received",
+                                    "stream_resets", "units_unroutable"};
+    int64_t *vals[4] = {&h->d_sbytes_q, &h->d_sbytes_recv, &h->d_resets,
+                        &h->d_unroutable};
+    PyObject *ctrs = NULL;
+    for (int j = 0; j < 4; j++) {
+      if (!*vals[j]) continue;
+      if (!ctrs) {
+        ctrs = PyObject_GetAttrString(h->host, "counters");
+        if (!ctrs) return NULL;
+      }
+      PyObject *r = PyObject_CallMethod(ctrs, "add", "(sL)", names2[j],
+                                        (long long)*vals[j]);
+      if (!r) { Py_DECREF(ctrs); return NULL; }
+      Py_DECREF(r);
+      *vals[j] = 0;
+    }
+    Py_XDECREF(ctrs);
   }
   Py_RETURN_NONE;
 }
+
+static PyObject *Core_make_endpoint(CoreObject *c, PyObject *args);
 
 static PyMethodDef Core_methods[] = {
     {"barrier", (PyCFunction)Core_barrier, METH_VARARGS,
@@ -1681,6 +1799,8 @@ static PyMethodDef Core_methods[] = {
      "(hid, port, peers) -> GossipState; registers the C dgram handler"},
     {"fold_counters", (PyCFunction)Core_fold_counters, METH_NOARGS,
      "flush outstanding per-host counter deltas into host attributes"},
+    {"make_endpoint", (PyCFunction)Core_make_endpoint, METH_VARARGS,
+     "(hid, lport, rhost, rport, initiator, sbuf, rbuf) -> Endpoint"},
     {NULL, NULL, 0, NULL}};
 
 static PyTypeObject Core_Type = {
@@ -1693,6 +1813,1088 @@ static PyTypeObject Core_Type = {
     .tp_new = PyType_GenericNew,
     .tp_doc = "C engine for one ColumnarPlane (plane._c)",
 };
+
+
+/* ======================================================================
+ * C stream transport — the exact twin of network/transport.py's
+ * StreamEndpoint/StreamSender/StreamReceiver, one object per connection
+ * half. App callbacks (on_data, on_connected, ...) stay Python; all
+ * protocol bookkeeping (windows, cumulative acks, OOO buffering,
+ * retransmission, close handshakes) runs here. Timers go through the
+ * host's Python event queue (bound-method tasks), so event identity and
+ * ordering match the Python twin exactly.
+ * ====================================================================== */
+
+#define MSS_C 1460
+#define INIT_CWND_C (10 * MSS_C)
+#define MIN_CWND_C (2 * MSS_C)
+#define RTO_MIN_NS_C 200000000LL
+#define SYN_RETRIES_C 5
+#define FIN_RETRIES_C 5
+#define DATA_RETRIES_C 8
+/* endpoint states (transport.py order) */
+#define ST_CLOSED 0
+#define ST_SYN_SENT 1
+#define ST_ESTABLISHED 2
+#define ST_CLOSING 3
+#define ST_FIN_SENT 4
+#define ST_TIME_WAIT 5
+
+typedef struct { int64_t nbytes; PyObject *payload; } SQEnt;
+typedef struct { int64_t seq, n; PyObject *payload; } RtxEnt;
+
+/* grow-able ring (head + count) */
+typedef struct { void *buf; int head, count, cap, esz; } Ring;
+
+static int ring_grow(Ring *r) {
+  int ncap = r->cap ? r->cap * 2 : 16;
+  void *nb = malloc((size_t)ncap * (size_t)r->esz);
+  if (!nb) { PyErr_NoMemory(); return -1; }
+  for (int i = 0; i < r->count; i++)
+    memcpy((char *)nb + (size_t)i * r->esz,
+           (char *)r->buf + (size_t)((r->head + i) % r->cap) * r->esz,
+           (size_t)r->esz);
+  free(r->buf);
+  r->buf = nb;
+  r->head = 0;
+  r->cap = ncap;
+  return 0;
+}
+
+static inline void *ring_at(Ring *r, int i) {
+  return (char *)r->buf + (size_t)((r->head + i) % r->cap) * r->esz;
+}
+
+static inline void *ring_push(Ring *r) {
+  if (r->count == r->cap && ring_grow(r) < 0) return NULL;
+  return ring_at(r, r->count++);
+}
+
+static inline void ring_popleft(Ring *r) {
+  r->head = (r->head + 1) % r->cap;
+  r->count--;
+}
+
+typedef struct CEp {
+  PyObject_HEAD
+  CoreObject *core; /* owned */
+  int hid;
+  int local_port, remote_host, remote_port;
+  int initiator, state, syn_tries, fin_tries, peer_fin;
+  int64_t rto_ns;
+  PyObject *ctl_timer; /* owned PyLong handle, or NULL */
+  /* sender */
+  int64_t chunk, cwnd, ssthresh, send_buffer, snd_nxt, snd_una, adv_wnd;
+  int64_t buffered, bytes_acked;
+  int64_t rto_backoff;
+  int retries, loss_events;
+  PyObject *rto_timer; /* owned PyLong handle, or NULL */
+  Ring sendbuf; /* SQEnt */
+  Ring rtx;     /* RtxEnt */
+  /* receiver */
+  int64_t recv_buffer, rcv_nxt, ooo_bytes, bytes_received, last_wnd;
+  Ring ooo; /* RtxEnt, kept seq-sorted (insertion) */
+  PyObject *app_unread; /* callable or NULL */
+  /* app callbacks (None when unset) */
+  PyObject *on_connected, *on_data, *on_drain, *on_close, *on_error;
+} CEp;
+
+static PyTypeObject CEp_Type; /* fwd */
+
+static CHost *cep_h(CEp *e) { return &e->core->hs[e->hid]; }
+
+/* current sim clock of the owning host: used by timer-driven entry
+ * points; row-driven entry points pass `now` explicitly */
+static int64_t cep_now(CEp *e, int *err) {
+  int64_t v;
+  if (attr_i64(cep_h(e)->host, S_now, &v) < 0) { *err = 1; return 0; }
+  *err = 0;
+  return v;
+}
+
+static PyObject *S_schedule_in, *S_cancel_m, *S_rto_fire, *S_syn_fire,
+    *S_fin_fire, *S_drop_fire;
+
+static int64_t cep_window(CEp *e, int *err) {
+  *err = 0;
+  int64_t unread = 0;
+  if (e->app_unread && e->app_unread != Py_None) {
+    PyObject *r = PyObject_CallNoArgs(e->app_unread);
+    if (!r) { *err = 1; return 0; }
+    unread = PyLong_AsLongLong(r);
+    Py_DECREF(r);
+    if (unread == -1 && PyErr_Occurred()) { *err = 1; return 0; }
+  }
+  int64_t w = e->recv_buffer - e->ooo_bytes - unread;
+  return w > 0 ? w : 0;
+}
+
+static int cep_emit(CEp *e, int64_t now, int kind, int64_t nbytes,
+                    PyObject *payload, int64_t seq, int64_t acked,
+                    int64_t wnd, int want_loss) {
+  return core_emit_fields(
+      e->core, cep_h(e), now, kind, e->remote_host, nbytes + HEADER,
+      kind == TK_DATA ? nbytes : acked, payload,
+      kind == TK_DATA ? seq : wnd, e->local_port, e->remote_port, 0, 1,
+      want_loss);
+}
+
+/* receiver._ack: round-barrier coalesced ack (Host.mark_ack twin) */
+static int cep_mark_ack(CEp *e) {
+  CHost *h = cep_h(e);
+  PyObject *aeps = PyObject_GetAttrString(h->host, "_ack_eps");
+  if (!aeps) return -1;
+  int rc = -1;
+  if (PyDict_GET_SIZE(aeps) == 0) {
+    PyObject *al = PyObject_GetAttrString(e->core->plane, "ack_hosts");
+    if (!al) goto out;
+    int r = PyList_Append(al, h->host);
+    Py_DECREF(al);
+    if (r < 0) goto out;
+  }
+  if (PyDict_SetItem(aeps, (PyObject *)e, Py_None) < 0) goto out;
+  rc = 0;
+out:
+  Py_DECREF(aeps);
+  return rc;
+}
+
+/* timers ride the host's Python event queue so seq/order match the twin */
+static int cep_schedule(CEp *e, int64_t delay, PyObject *meth_name,
+                        PyObject **slot) {
+  PyObject *task = PyObject_GetAttr((PyObject *)e, meth_name);
+  if (!task) return -1;
+  PyObject *d = PyLong_FromLongLong(delay);
+  if (!d) { Py_DECREF(task); return -1; }
+  PyObject *h = PyObject_CallMethodObjArgs(cep_h(e)->host, S_schedule_in,
+                                           d, task, NULL);
+  Py_DECREF(d);
+  Py_DECREF(task);
+  if (!h) return -1;
+  Py_XSETREF(*slot, h);
+  return 0;
+}
+
+static int cep_cancel_timer(CEp *e, PyObject **slot) {
+  if (!*slot) return 0;
+  PyObject *r = PyObject_CallMethodObjArgs(cep_h(e)->host, S_cancel_m,
+                                           *slot, NULL);
+  Py_CLEAR(*slot);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+static int cs_pump(CEp *e, int64_t now);
+static int ce_sender_drained(CEp *e, int64_t now);
+static int ce_drop(CEp *e);
+static int ce_reset(CEp *e, const char *reason);
+static int ce_enter_time_wait(CEp *e, int64_t now);
+
+static int cs_arm_rto(CEp *e, int reset) {
+  if (reset && e->rto_timer) {
+    if (cep_cancel_timer(e, &e->rto_timer) < 0) return -1;
+  }
+  if (!e->rto_timer)
+    return cep_schedule(e, e->rto_ns * e->rto_backoff, S_rto_fire,
+                        &e->rto_timer);
+  return 0;
+}
+
+static int cs_emit_data(CEp *e, int64_t now, int64_t seq, int64_t nbytes,
+                        PyObject *payload) {
+  return cep_emit(e, now, TK_DATA, nbytes, payload, seq, 0, 0, 1);
+}
+
+static int cs_pump(CEp *e, int64_t now) {
+  if (e->state != ST_ESTABLISHED && e->state != ST_CLOSING) return 0;
+  int64_t window = e->adv_wnd > MSS_C ? e->adv_wnd : MSS_C;
+  if (e->cwnd < window) window = e->cwnd;
+  while (e->buffered > 0 && (e->snd_nxt - e->snd_una) < window) {
+    int64_t inflight = e->snd_nxt - e->snd_una;
+    int64_t usable = window - inflight;
+    /* silly-window avoidance (transport.py pump) */
+    if (usable < e->chunk && usable < e->buffered && inflight > 0) break;
+    int64_t budget = usable < e->chunk ? usable : e->chunk;
+    SQEnt *head = ring_at(&e->sendbuf, 0);
+    int64_t nbytes = head->nbytes;
+    PyObject *chunk_p = NULL;
+    if (nbytes <= budget) {
+      chunk_p = head->payload; /* transfer ownership */
+      ring_popleft(&e->sendbuf);
+    } else {
+      if (head->payload && head->payload != Py_None) {
+        chunk_p = PySequence_GetSlice(head->payload, 0, budget);
+        PyObject *rest = PySequence_GetSlice(head->payload, budget,
+                                             PyBytes_GET_SIZE(head->payload));
+        if (!chunk_p || !rest) {
+          Py_XDECREF(chunk_p); Py_XDECREF(rest);
+          return -1;
+        }
+        Py_SETREF(head->payload, rest);
+      }
+      head->nbytes = nbytes - budget;
+      nbytes = budget;
+    }
+    e->buffered -= nbytes;
+    int64_t seq = e->snd_nxt;
+    e->snd_nxt += nbytes;
+    RtxEnt *re = ring_push(&e->rtx);
+    if (!re) { Py_XDECREF(chunk_p); return -1; }
+    re->seq = seq;
+    re->n = nbytes;
+    re->payload = chunk_p; /* owned (may be NULL) */
+    if (cs_emit_data(e, now, seq, nbytes, chunk_p) < 0) return -1;
+  }
+  if (e->snd_nxt - e->snd_una > 0) {
+    if (cs_arm_rto(e, 0) < 0) return -1;
+  } else if (e->buffered == 0) {
+    return ce_sender_drained(e, now);
+  }
+  return 0;
+}
+
+static int cs_oracle_loss(CEp *e, int64_t now, int64_t seq, int64_t nbytes,
+                          PyObject *payload) {
+  if (seq + nbytes <= e->snd_una || e->state == ST_CLOSED ||
+      e->state == ST_TIME_WAIT)
+    return 0;
+  e->loss_events++;
+  int64_t inflight = e->snd_nxt - e->snd_una;
+  e->ssthresh = inflight / 2 > MIN_CWND_C ? inflight / 2 : MIN_CWND_C;
+  e->cwnd = e->cwnd / 2 > MIN_CWND_C ? e->cwnd / 2 : MIN_CWND_C;
+  if (cs_emit_data(e, now, seq, nbytes, payload) < 0) return -1;
+  return cs_arm_rto(e, 1);
+}
+
+static int cs_on_rto(CEp *e, int64_t now) {
+  Py_CLEAR(e->rto_timer);
+  if (e->snd_nxt - e->snd_una == 0 || e->state == ST_CLOSED ||
+      e->state == ST_TIME_WAIT)
+    return 0;
+  if (e->adv_wnd > 0) e->retries++;
+  if (e->retries > DATA_RETRIES_C)
+    return ce_reset(e, "data retransmission retries exhausted");
+  int64_t inflight = e->snd_nxt - e->snd_una;
+  e->ssthresh = inflight / 2 > MIN_CWND_C ? inflight / 2 : MIN_CWND_C;
+  e->cwnd = MIN_CWND_C;
+  e->rto_backoff = e->rto_backoff * 2 > 64 ? 64 : e->rto_backoff * 2;
+  RtxEnt *re = ring_at(&e->rtx, 0);
+  if (cs_emit_data(e, now, re->seq, re->n, re->payload) < 0) return -1;
+  return cs_arm_rto(e, 0);
+}
+
+static int cs_on_ack(CEp *e, int64_t now, int64_t cum_ack, int64_t wnd) {
+  e->adv_wnd = wnd;
+  if (cum_ack > e->snd_una) {
+    int64_t newly = cum_ack - e->snd_una;
+    e->snd_una = cum_ack;
+    e->bytes_acked += newly;
+    while (e->rtx.count) {
+      RtxEnt *re = ring_at(&e->rtx, 0);
+      if (re->seq + re->n > cum_ack) break;
+      Py_XDECREF(re->payload);
+      ring_popleft(&e->rtx);
+    }
+    e->rto_backoff = 1;
+    e->retries = 0;
+    if (cep_cancel_timer(e, &e->rto_timer) < 0) return -1;
+    if (e->snd_nxt - e->snd_una > 0) {
+      if (cs_arm_rto(e, 0) < 0) return -1;
+    }
+    if (e->cwnd < e->ssthresh) {
+      e->cwnd += newly < e->cwnd ? newly : e->cwnd; /* slow start */
+    } else {
+      int64_t add = MSS_C * newly / e->cwnd;
+      e->cwnd += add > 1 ? add : 1; /* AIMD */
+    }
+    if (e->on_drain && e->on_drain != Py_None &&
+        e->buffered < e->send_buffer) {
+      PyObject *room = PyLong_FromLongLong(e->send_buffer - e->buffered);
+      if (!room) return -1;
+      PyObject *r = PyObject_CallOneArg(e->on_drain, room);
+      Py_DECREF(room);
+      if (!r) return -1;
+      Py_DECREF(r);
+    }
+  }
+  return cs_pump(e, now);
+}
+
+/* ---- receiver (StreamReceiver twin) ------------------------------------ */
+static int cr_deliver(CEp *e, int64_t now, int64_t nbytes,
+                      PyObject *payload) {
+  e->rcv_nxt += nbytes;
+  e->bytes_received += nbytes;
+  if (e->on_data && e->on_data != Py_None) {
+    PyObject *nb = PyLong_FromLongLong(nbytes);
+    PyObject *tn = PyLong_FromLongLong(now);
+    if (!nb || !tn) { Py_XDECREF(nb); Py_XDECREF(tn); return -1; }
+    PyObject *r = PyObject_CallFunctionObjArgs(
+        e->on_data, nb, payload ? payload : Py_None, tn, NULL);
+    Py_DECREF(nb);
+    Py_DECREF(tn);
+    if (!r) return -1;
+    Py_DECREF(r);
+  }
+  return 0;
+}
+
+static int cr_ooo_find(CEp *e, int64_t seq) {
+  for (int i = 0; i < e->ooo.count; i++)
+    if (((RtxEnt *)ring_at(&e->ooo, i))->seq == seq) return i;
+  return -1;
+}
+
+static int cr_on_data(CEp *e, int64_t now, int64_t seq, int64_t n,
+                      PyObject *payload) {
+  int err;
+  if (seq + n <= e->rcv_nxt) return cep_mark_ack(e); /* duplicate */
+  if (seq > e->rcv_nxt) {
+    if (cr_ooo_find(e, seq) < 0) {
+      int64_t w = cep_window(e, &err);
+      if (err) return -1;
+      if (n <= w) {
+        RtxEnt *oe = ring_push(&e->ooo);
+        if (!oe) return -1;
+        oe->seq = seq;
+        oe->n = n;
+        Py_XINCREF(payload);
+        oe->payload = payload;
+        e->ooo_bytes += n;
+      }
+    }
+    return cep_mark_ack(e); /* "duplicate ack" */
+  }
+  int64_t w = cep_window(e, &err);
+  if (err) return -1;
+  if (n > w) return cep_mark_ack(e); /* beyond-window probe: refuse */
+  if (cr_deliver(e, now, n, payload) < 0) return -1;
+  for (;;) {
+    int i = cr_ooo_find(e, e->rcv_nxt);
+    if (i < 0) break;
+    RtxEnt cp = *(RtxEnt *)ring_at(&e->ooo, i);
+    /* remove entry i (order within the ring is irrelevant) */
+    *(RtxEnt *)ring_at(&e->ooo, i) =
+        *(RtxEnt *)ring_at(&e->ooo, e->ooo.count - 1);
+    e->ooo.count--;
+    e->ooo_bytes -= cp.n;
+    int r = cr_deliver(e, now, cp.n, cp.payload);
+    Py_XDECREF(cp.payload);
+    if (r < 0) return -1;
+  }
+  return cep_mark_ack(e);
+}
+
+/* ---- endpoint (StreamEndpoint twin) ------------------------------------ */
+static int ce_cancel_ctl(CEp *e) { return cep_cancel_timer(e, &e->ctl_timer); }
+
+static int ce_drop(CEp *e) {
+  if (ce_cancel_ctl(e) < 0) return -1;
+  if (cep_cancel_timer(e, &e->rto_timer) < 0) return -1;
+  e->state = ST_CLOSED;
+  /* host.drop_endpoint twin: pop our four-tuple from the cached
+   * identity-stable host._conns dict */
+  PyObject *conns = cep_h(e)->conns;
+  PyObject *key = Py_BuildValue("(iii)", e->local_port, e->remote_host,
+                                e->remote_port);
+  if (!key) return -1;
+  if (PyDict_Contains(conns, key) == 1) {
+    if (PyDict_DelItem(conns, key) < 0) { Py_DECREF(key); return -1; }
+  }
+  Py_DECREF(key);
+  return 0;
+}
+
+static int ce_reset(CEp *e, const char *reason) {
+  cep_h(e)->d_resets++;
+  PyObject *err_cb = e->on_error;
+  Py_XINCREF(err_cb);
+  if (ce_drop(e) < 0) { Py_XDECREF(err_cb); return -1; }
+  if (err_cb && err_cb != Py_None) {
+    PyObject *msg = PyUnicode_FromString(reason);
+    if (!msg) { Py_DECREF(err_cb); return -1; }
+    PyObject *r = PyObject_CallOneArg(err_cb, msg);
+    Py_DECREF(msg);
+    Py_DECREF(err_cb);
+    if (!r) return -1;
+    Py_DECREF(r);
+  } else {
+    Py_XDECREF(err_cb);
+  }
+  return 0;
+}
+
+static int ce_enter_time_wait(CEp *e, int64_t now) {
+  if (e->state == ST_TIME_WAIT) return 0;
+  int was_open = e->state == ST_ESTABLISHED || e->state == ST_CLOSING ||
+                 e->state == ST_FIN_SENT;
+  e->state = ST_TIME_WAIT;
+  if (ce_cancel_ctl(e) < 0) return -1;
+  if (cep_cancel_timer(e, &e->rto_timer) < 0) return -1;
+  /* schedule the final drop WITHOUT tracking a handle (Python twin
+   * schedules self._drop unconditionally) */
+  PyObject *tmp = NULL;
+  if (cep_schedule(e, 2 * e->rto_ns, S_drop_fire, &tmp) < 0) return -1;
+  Py_XDECREF(tmp);
+  if (was_open && e->on_close && e->on_close != Py_None) {
+    PyObject *tn = PyLong_FromLongLong(now);
+    if (!tn) return -1;
+    PyObject *r = PyObject_CallOneArg(e->on_close, tn);
+    Py_DECREF(tn);
+    if (!r) return -1;
+    Py_DECREF(r);
+  }
+  return 0;
+}
+
+static int ce_send_fin(CEp *e, int64_t now) {
+  e->fin_tries++;
+  if (e->fin_tries > FIN_RETRIES_C) return ce_drop(e); /* orphan timeout */
+  if (cep_emit(e, now, TK_FIN, 0, NULL, 0, 0, 0, 0) < 0) return -1;
+  int64_t mult = 1LL << (e->fin_tries - 1);
+  if (mult > 64) mult = 64;
+  return cep_schedule(e, e->rto_ns * mult, S_fin_fire, &e->ctl_timer);
+}
+
+static int ce_sender_drained(CEp *e, int64_t now) {
+  if (e->peer_fin &&
+      (e->state == ST_ESTABLISHED || e->state == ST_CLOSING)) {
+    if (cep_emit(e, now, TK_FINACK, 0, NULL, 0, 0, 0, 0) < 0) return -1;
+    return ce_enter_time_wait(e, now);
+  }
+  if (e->state == ST_CLOSING) {
+    e->state = ST_FIN_SENT;
+    return ce_send_fin(e, now);
+  }
+  return 0;
+}
+
+static int ce_send_syn(CEp *e, int64_t now) {
+  e->syn_tries++;
+  if (e->syn_tries > SYN_RETRIES_C)
+    return ce_reset(e, "connection timed out (SYN retries exhausted)");
+  int err;
+  int64_t w = cep_window(e, &err);
+  if (err) return -1;
+  if (cep_emit(e, now, TK_SYN, 0, NULL, 0, 0, w, 0) < 0) return -1;
+  int64_t mult = 1LL << (e->syn_tries - 1);
+  if (mult > 64) mult = 64;
+  return cep_schedule(e, e->rto_ns * mult, S_syn_fire, &e->ctl_timer);
+}
+
+/* the unit-arrival dispatch (StreamEndpoint.handle_fields twin) */
+static int ce_handle_fields(CEp *e, int64_t now, int k, int64_t nbytes,
+                            PyObject *payload, int64_t seq) {
+  int err;
+  if (k == TK_SYN) {
+    if (e->state == ST_ESTABLISHED) { /* dup SYN: SYNACK was lost */
+      e->adv_wnd = seq;
+      int64_t w = cep_window(e, &err);
+      if (err) return -1;
+      return cep_emit(e, now, TK_SYNACK, 0, NULL, 0, 0, w, 0);
+    }
+    return 0;
+  }
+  if (k == TK_SYNACK) {
+    if (e->state == ST_SYN_SENT) {
+      e->state = ST_ESTABLISHED;
+      e->adv_wnd = seq;
+      if (ce_cancel_ctl(e) < 0) return -1;
+      if (e->on_connected && e->on_connected != Py_None) {
+        PyObject *tn = PyLong_FromLongLong(now);
+        if (!tn) return -1;
+        PyObject *r = PyObject_CallOneArg(e->on_connected, tn);
+        Py_DECREF(tn);
+        if (!r) return -1;
+        Py_DECREF(r);
+      }
+      return cs_pump(e, now);
+    }
+    return 0;
+  }
+  if (k == TK_DATA) {
+    if (e->state == ST_CLOSED || e->state == ST_TIME_WAIT) return 0;
+    cep_h(e)->d_sbytes_recv += nbytes;
+    return cr_on_data(e, now, seq, nbytes, payload);
+  }
+  if (k == TK_ACK) {
+    if (e->state == ST_CLOSED || e->state == ST_TIME_WAIT) return 0;
+    return cs_on_ack(e, now, nbytes, seq);
+  }
+  if (k == TK_FIN) {
+    if (e->state == ST_SYN_SENT) {
+      if (cep_emit(e, now, TK_FINACK, 0, NULL, 0, 0, 0, 0) < 0) return -1;
+      return ce_reset(e, "connection closed by peer");
+    }
+    if ((e->state == ST_ESTABLISHED || e->state == ST_CLOSING) &&
+        (e->buffered > 0 || e->snd_nxt - e->snd_una > 0)) {
+      e->peer_fin = 1; /* half-close: FINACK when drained */
+      return 0;
+    }
+    if (cep_emit(e, now, TK_FINACK, 0, NULL, 0, 0, 0, 0) < 0) return -1;
+    if (e->state != ST_CLOSED) return ce_enter_time_wait(e, now);
+    return 0;
+  }
+  if (k == TK_FINACK) {
+    if (e->state == ST_FIN_SENT) {
+      if (ce_cancel_ctl(e) < 0) return -1;
+      PyObject *close_cb = e->on_close;
+      Py_XINCREF(close_cb);
+      if (ce_drop(e) < 0) { Py_XDECREF(close_cb); return -1; }
+      if (close_cb && close_cb != Py_None) {
+        PyObject *tn = PyLong_FromLongLong(now);
+        if (!tn) { Py_DECREF(close_cb); return -1; }
+        PyObject *r = PyObject_CallOneArg(close_cb, tn);
+        Py_DECREF(tn);
+        Py_DECREF(close_cb);
+        if (!r) return -1;
+        Py_DECREF(r);
+      } else {
+        Py_XDECREF(close_cb);
+      }
+    }
+    return 0;
+  }
+  return 0;
+}
+
+/* ---- CEp Python surface ------------------------------------------------ */
+static int CEp_traverse(CEp *e, visitproc visit, void *arg) {
+  Py_VISIT(e->core);
+  Py_VISIT(e->on_connected);
+  Py_VISIT(e->on_data);
+  Py_VISIT(e->on_drain);
+  Py_VISIT(e->on_close);
+  Py_VISIT(e->on_error);
+  Py_VISIT(e->app_unread);
+  return 0;
+}
+
+static int CEp_clear_gc(CEp *e) {
+  Py_CLEAR(e->on_connected);
+  Py_CLEAR(e->on_data);
+  Py_CLEAR(e->on_drain);
+  Py_CLEAR(e->on_close);
+  Py_CLEAR(e->on_error);
+  Py_CLEAR(e->app_unread);
+  return 0;
+}
+
+static void CEp_dealloc(CEp *e) {
+  PyObject_GC_UnTrack(e);
+  Py_XDECREF(e->core);
+  Py_XDECREF(e->ctl_timer);
+  Py_XDECREF(e->rto_timer);
+  for (int i = 0; i < e->sendbuf.count; i++)
+    Py_XDECREF(((SQEnt *)ring_at(&e->sendbuf, i))->payload);
+  for (int i = 0; i < e->rtx.count; i++)
+    Py_XDECREF(((RtxEnt *)ring_at(&e->rtx, i))->payload);
+  for (int i = 0; i < e->ooo.count; i++)
+    Py_XDECREF(((RtxEnt *)ring_at(&e->ooo, i))->payload);
+  free(e->sendbuf.buf);
+  free(e->rtx.buf);
+  free(e->ooo.buf);
+  Py_XDECREF(e->app_unread);
+  Py_XDECREF(e->on_connected);
+  Py_XDECREF(e->on_data);
+  Py_XDECREF(e->on_drain);
+  Py_XDECREF(e->on_close);
+  Py_XDECREF(e->on_error);
+  Py_TYPE(e)->tp_free((PyObject *)e);
+}
+
+static PyObject *CEp_send(CEp *e, PyObject *args, PyObject *kw) {
+  static char *kws[] = {"nbytes", "payload", NULL};
+  long long nbytes = 0;
+  PyObject *payload = Py_None;
+  if (!PyArg_ParseTupleAndKeywords(args, kw, "|LO", kws, &nbytes, &payload))
+    return NULL;
+  if (payload != Py_None) nbytes = PyBytes_GET_SIZE(payload);
+  if (nbytes <= 0 || e->state == ST_CLOSING || e->state == ST_FIN_SENT ||
+      e->state == ST_TIME_WAIT)
+    return PyLong_FromLong(0);
+  /* StreamSender.queue */
+  int64_t room = e->send_buffer - e->buffered;
+  int64_t accept = nbytes < room ? nbytes : (room > 0 ? room : 0);
+  if (accept <= 0) return PyLong_FromLong(0);
+  SQEnt *q = ring_push(&e->sendbuf);
+  if (!q) return NULL;
+  q->nbytes = accept;
+  if (payload != Py_None) {
+    q->payload = PySequence_GetSlice(payload, 0, accept);
+    if (!q->payload) { e->sendbuf.count--; return NULL; }
+  } else {
+    q->payload = NULL;
+  }
+  e->buffered += accept;
+  int err;
+  int64_t now = cep_now(e, &err);
+  if (err) return NULL;
+  if (cs_pump(e, now) < 0) return NULL;
+  cep_h(e)->d_sbytes_q += accept;
+  return PyLong_FromLongLong(accept);
+}
+
+static PyObject *CEp_close(CEp *e, PyObject *noarg) {
+  (void)noarg;
+  if (e->state == ST_CLOSED || e->state == ST_CLOSING ||
+      e->state == ST_FIN_SENT || e->state == ST_TIME_WAIT)
+    Py_RETURN_NONE;
+  e->state = ST_CLOSING;
+  int err;
+  int64_t now = cep_now(e, &err);
+  if (err) return NULL;
+  if (cs_pump(e, now) < 0) return NULL;
+  Py_RETURN_NONE;
+}
+
+static PyObject *CEp_connect(CEp *e, PyObject *noarg) {
+  (void)noarg;
+  e->state = ST_SYN_SENT;
+  int err;
+  int64_t now = cep_now(e, &err);
+  if (err) return NULL;
+  if (ce_send_syn(e, now) < 0) return NULL;
+  Py_RETURN_NONE;
+}
+
+static PyObject *CEp_window(CEp *e, PyObject *noarg) {
+  (void)noarg;
+  int err;
+  int64_t w = cep_window(e, &err);
+  if (err) return NULL;
+  return PyLong_FromLongLong(w);
+}
+
+static PyObject *CEp_flush_ack(CEp *e, PyObject *noarg) {
+  (void)noarg;
+  int err;
+  e->last_wnd = cep_window(e, &err);
+  if (err) return NULL;
+  int64_t now = cep_now(e, &err);
+  if (err) return NULL;
+  if (cep_emit(e, now, TK_ACK, 0, NULL, 0, e->rcv_nxt, e->last_wnd, 0) < 0)
+    return NULL;
+  Py_RETURN_NONE;
+}
+
+static PyObject *CEp_on_app_read(CEp *e, PyObject *noarg) {
+  (void)noarg;
+  int err;
+  if (e->last_wnd < (e->recv_buffer >> 2) && e->state != ST_CLOSED &&
+      e->state != ST_TIME_WAIT) {
+    int64_t w = cep_window(e, &err);
+    if (err) return NULL;
+    if (w > e->last_wnd && cep_mark_ack(e) < 0) return NULL;
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject *CEp_handle_fields(CEp *e, PyObject *args) {
+  long long k, nbytes, seq, now;
+  PyObject *payload;
+  if (!PyArg_ParseTuple(args, "LLOLL", &k, &nbytes, &payload, &seq, &now))
+    return NULL;
+  if (ce_handle_fields(e, now, (int)k, nbytes,
+                       payload == Py_None ? NULL : payload, seq) < 0)
+    return NULL;
+  Py_RETURN_NONE;
+}
+
+static PyObject *CEp_on_loss_notify(CEp *e, PyObject *args) {
+  long long seq, nbytes;
+  PyObject *payload;
+  if (!PyArg_ParseTuple(args, "LLO", &seq, &nbytes, &payload)) return NULL;
+  int err;
+  int64_t now = cep_now(e, &err);
+  if (err) return NULL;
+  if (cs_oracle_loss(e, now, seq, nbytes,
+                     payload == Py_None ? NULL : payload) < 0)
+    return NULL;
+  Py_RETURN_NONE;
+}
+
+static PyObject *CEp_emit(CEp *e, PyObject *args, PyObject *kw) {
+  static char *kws[] = {"kind", "nbytes", "payload", "seq", "acked", "wnd",
+                        "want_loss", NULL};
+  long long kind, nbytes = 0, seq = 0, acked = 0, wnd = 0;
+  int want_loss = 0;
+  PyObject *payload = Py_None;
+  if (!PyArg_ParseTupleAndKeywords(args, kw, "L|LOLLLp", kws, &kind,
+                                   &nbytes, &payload, &seq, &acked, &wnd,
+                                   &want_loss))
+    return NULL;
+  int err;
+  int64_t now = cep_now(e, &err);
+  if (err) return NULL;
+  if (cep_emit(e, now, (int)kind, nbytes,
+               payload == Py_None ? NULL : payload, seq, acked, wnd,
+               want_loss) < 0)
+    return NULL;
+  Py_RETURN_NONE;
+}
+
+/* timer entry points (scheduled on the host's Python event queue) */
+static PyObject *CEp_rto_fire(CEp *e, PyObject *noarg) {
+  (void)noarg;
+  int err;
+  int64_t now = cep_now(e, &err);
+  if (err) return NULL;
+  if (cs_on_rto(e, now) < 0) return NULL;
+  Py_RETURN_NONE;
+}
+
+static PyObject *CEp_syn_fire(CEp *e, PyObject *noarg) {
+  (void)noarg;
+  if (e->state == ST_SYN_SENT) {
+    int err;
+    int64_t now = cep_now(e, &err);
+    if (err) return NULL;
+    if (ce_send_syn(e, now) < 0) return NULL;
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject *CEp_fin_fire(CEp *e, PyObject *noarg) {
+  (void)noarg;
+  if (e->state == ST_FIN_SENT) {
+    int err;
+    int64_t now = cep_now(e, &err);
+    if (err) return NULL;
+    if (ce_send_fin(e, now) < 0) return NULL;
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject *CEp_drop_fire(CEp *e, PyObject *noarg) {
+  (void)noarg;
+  if (ce_drop(e) < 0) return NULL;
+  Py_RETURN_NONE;
+}
+
+static PyObject *CEp_get_self(CEp *e, void *u) {
+  (void)u;
+  Py_INCREF(e);
+  return (PyObject *)e;
+}
+
+#define CB_GETSET(name)                                       \
+  static PyObject *CEp_get_##name(CEp *e, void *u) {          \
+    (void)u;                                                  \
+    PyObject *v = e->name ? e->name : Py_None;                \
+    Py_INCREF(v);                                             \
+    return v;                                                 \
+  }                                                           \
+  static int CEp_set_##name(CEp *e, PyObject *v, void *u) {   \
+    (void)u;                                                  \
+    Py_XINCREF(v);                                            \
+    Py_XSETREF(e->name, v);                                   \
+    return 0;                                                 \
+  }
+CB_GETSET(on_connected)
+CB_GETSET(on_data)
+CB_GETSET(on_drain)
+CB_GETSET(on_close)
+CB_GETSET(on_error)
+CB_GETSET(app_unread)
+
+#define I64_GETSET(name)                                      \
+  static PyObject *CEp_get_##name(CEp *e, void *u) {          \
+    (void)u;                                                  \
+    return PyLong_FromLongLong(e->name);                      \
+  }                                                           \
+  static int CEp_set_##name(CEp *e, PyObject *v, void *u) {   \
+    (void)u;                                                  \
+    int64_t x = PyLong_AsLongLong(v);                         \
+    if (x == -1 && PyErr_Occurred()) return -1;               \
+    e->name = x;                                              \
+    return 0;                                                 \
+  }
+I64_GETSET(adv_wnd)
+I64_GETSET(buffered)
+I64_GETSET(send_buffer)
+I64_GETSET(recv_buffer)
+I64_GETSET(bytes_acked)
+I64_GETSET(bytes_received)
+I64_GETSET(rcv_nxt)
+I64_GETSET(snd_una)
+I64_GETSET(snd_nxt)
+I64_GETSET(cwnd)
+I64_GETSET(rto_ns)
+
+static PyObject *CEp_get_state(CEp *e, void *u) {
+  (void)u;
+  return PyLong_FromLong(e->state);
+}
+static int CEp_set_state(CEp *e, PyObject *v, void *u) {
+  (void)u;
+  long x = PyLong_AsLong(v);
+  if (x == -1 && PyErr_Occurred()) return -1;
+  e->state = (int)x;
+  return 0;
+}
+static PyObject *CEp_get_local_port(CEp *e, void *u) {
+  (void)u;
+  return PyLong_FromLong(e->local_port);
+}
+static PyObject *CEp_get_remote_host(CEp *e, void *u) {
+  (void)u;
+  return PyLong_FromLong(e->remote_host);
+}
+static PyObject *CEp_get_remote_port(CEp *e, void *u) {
+  (void)u;
+  return PyLong_FromLong(e->remote_port);
+}
+static PyObject *CEp_get_loss_events(CEp *e, void *u) {
+  (void)u;
+  return PyLong_FromLong(e->loss_events);
+}
+
+static PyGetSetDef CEp_getset[] = {
+    {"sender", (getter)CEp_get_self, NULL, "sender half (self)", NULL},
+    {"receiver", (getter)CEp_get_self, NULL, "receiver half (self)", NULL},
+    {"state", (getter)CEp_get_state, (setter)CEp_set_state, NULL, NULL},
+    {"on_connected", (getter)CEp_get_on_connected,
+     (setter)CEp_set_on_connected, NULL, NULL},
+    {"on_data", (getter)CEp_get_on_data, (setter)CEp_set_on_data, NULL,
+     NULL},
+    {"on_drain", (getter)CEp_get_on_drain, (setter)CEp_set_on_drain, NULL,
+     NULL},
+    {"on_close", (getter)CEp_get_on_close, (setter)CEp_set_on_close, NULL,
+     NULL},
+    {"on_error", (getter)CEp_get_on_error, (setter)CEp_set_on_error, NULL,
+     NULL},
+    {"app_unread", (getter)CEp_get_app_unread, (setter)CEp_set_app_unread,
+     NULL, NULL},
+    {"adv_wnd", (getter)CEp_get_adv_wnd, (setter)CEp_set_adv_wnd, NULL,
+     NULL},
+    {"buffered", (getter)CEp_get_buffered, (setter)CEp_set_buffered, NULL,
+     NULL},
+    {"send_buffer", (getter)CEp_get_send_buffer,
+     (setter)CEp_set_send_buffer, NULL, NULL},
+    {"recv_buffer", (getter)CEp_get_recv_buffer,
+     (setter)CEp_set_recv_buffer, NULL, NULL},
+    {"bytes_acked", (getter)CEp_get_bytes_acked,
+     (setter)CEp_set_bytes_acked, NULL, NULL},
+    {"bytes_received", (getter)CEp_get_bytes_received,
+     (setter)CEp_set_bytes_received, NULL, NULL},
+    {"rcv_nxt", (getter)CEp_get_rcv_nxt, (setter)CEp_set_rcv_nxt, NULL,
+     NULL},
+    {"snd_una", (getter)CEp_get_snd_una, (setter)CEp_set_snd_una, NULL,
+     NULL},
+    {"snd_nxt", (getter)CEp_get_snd_nxt, (setter)CEp_set_snd_nxt, NULL,
+     NULL},
+    {"cwnd", (getter)CEp_get_cwnd, (setter)CEp_set_cwnd, NULL, NULL},
+    {"rto_ns", (getter)CEp_get_rto_ns, (setter)CEp_set_rto_ns, NULL, NULL},
+    {"local_port", (getter)CEp_get_local_port, NULL, NULL, NULL},
+    {"remote_host", (getter)CEp_get_remote_host, NULL, NULL, NULL},
+    {"remote_port", (getter)CEp_get_remote_port, NULL, NULL, NULL},
+    {"loss_events", (getter)CEp_get_loss_events, NULL, NULL, NULL},
+    {NULL, NULL, NULL, NULL, NULL}};
+
+static PyMethodDef CEp_methods[] = {
+    {"send", (PyCFunction)CEp_send, METH_VARARGS | METH_KEYWORDS, NULL},
+    {"close", (PyCFunction)CEp_close, METH_NOARGS, NULL},
+    {"connect", (PyCFunction)CEp_connect, METH_NOARGS, NULL},
+    {"window", (PyCFunction)CEp_window, METH_NOARGS, NULL},
+    {"flush_ack", (PyCFunction)CEp_flush_ack, METH_NOARGS, NULL},
+    {"on_app_read", (PyCFunction)CEp_on_app_read, METH_NOARGS, NULL},
+    {"handle_fields", (PyCFunction)CEp_handle_fields, METH_VARARGS, NULL},
+    {"on_loss_notify", (PyCFunction)CEp_on_loss_notify, METH_VARARGS, NULL},
+    {"emit", (PyCFunction)CEp_emit, METH_VARARGS | METH_KEYWORDS, NULL},
+    {"_rto_fire", (PyCFunction)CEp_rto_fire, METH_NOARGS, NULL},
+    {"_syn_fire", (PyCFunction)CEp_syn_fire, METH_NOARGS, NULL},
+    {"_fin_fire", (PyCFunction)CEp_fin_fire, METH_NOARGS, NULL},
+    {"_drop_fire", (PyCFunction)CEp_drop_fire, METH_NOARGS, NULL},
+    {NULL, NULL, 0, NULL}};
+
+static PyTypeObject CEp_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0).tp_name = "_colcore.Endpoint",
+    .tp_basicsize = sizeof(CEp),
+    .tp_dealloc = (destructor)CEp_dealloc,
+    /* GC-tracked: the app callbacks ALWAYS form cycles through the
+     * endpoint (app holds ep, ep.on_data closes over app) — without
+     * traverse/clear every churned connection would leak (review r4) */
+    .tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC,
+    .tp_traverse = (traverseproc)CEp_traverse,
+    .tp_clear = (inquiry)CEp_clear_gc,
+    .tp_methods = CEp_methods,
+    .tp_getset = CEp_getset,
+    .tp_free = PyObject_GC_Del,
+    .tp_doc = "C stream endpoint (network/transport.py twin)",
+};
+
+/* factory shared by Python (Host._make_endpoint) and the C SYN accept */
+static CEp *cep_new(CoreObject *c, int hid, int lport, int rhost, int rport,
+                    int initiator, int64_t sbuf, int64_t rbuf) {
+  CEp *e = PyObject_GC_New(CEp, &CEp_Type);
+  if (!e) return NULL;
+  memset(((char *)e) + sizeof(PyObject), 0, sizeof(CEp) - sizeof(PyObject));
+  Py_INCREF(c);
+  e->core = c;
+  e->hid = hid;
+  e->local_port = lport;
+  e->remote_host = rhost;
+  e->remote_port = rport;
+  e->initiator = initiator;
+  e->state = ST_CLOSED;
+  e->cwnd = INIT_CWND_C;
+  e->ssthresh = 1LL << 62;
+  e->adv_wnd = INIT_CWND_C;
+  e->rto_backoff = 1;
+  e->send_buffer = sbuf;
+  e->recv_buffer = rbuf;
+  e->last_wnd = rbuf;
+  e->chunk = c->unit_chunk;
+  e->sendbuf.esz = sizeof(SQEnt);
+  e->rtx.esz = sizeof(RtxEnt);
+  e->ooo.esz = sizeof(RtxEnt);
+  int32_t sn = c->hostnode[hid], dn = c->hostnode[rhost];
+  int64_t rtt = c->lat[(int64_t)sn * c->G + dn] +
+                c->lat[(int64_t)dn * c->G + sn];
+  e->rto_ns = 2 * rtt > RTO_MIN_NS_C ? 2 * rtt : RTO_MIN_NS_C;
+  PyObject_GC_Track((PyObject *)e);
+  return e;
+}
+
+static PyObject *Core_make_endpoint(CoreObject *c, PyObject *args) {
+  long long hid, lport, rhost, rport, sbuf, rbuf;
+  int initiator;
+  if (!PyArg_ParseTuple(args, "LLLLpLL", &hid, &lport, &rhost, &rport,
+                        &initiator, &sbuf, &rbuf))
+    return NULL;
+  if (hid < 0 || hid >= c->H || rhost < 0 || rhost >= c->H) {
+    PyErr_SetString(PyExc_ValueError, "host id out of range");
+    return NULL;
+  }
+  return (PyObject *)cep_new(c, (int)hid, (int)lport, (int)rhost,
+                             (int)rport, initiator, sbuf, rbuf);
+}
+
+/* ---- stream row dispatch (Host.dispatch_row / _deliver_row twin) ------- */
+static int dispatch_stream(CoreObject *c, CHost *h, int hid, IRow *ir,
+                           int64_t *now, int *now_dirty) {
+  int k = ir->kind;
+  PyObject *pl = PyTuple_GET_ITEM(ir->row, 12);
+  if (pl == Py_None) pl = NULL;
+  if (k == KIND_LOSS_C) {
+    /* loss-notify (no ingress charge): route back by four-tuple.
+     * The clock attr syncs BEFORE the endpoint logic runs: transport
+     * code schedules timers through host.schedule_in (now + delay). */
+    if (ir->t > *now) { *now = ir->t; *now_dirty = 1; }
+    if (*now_dirty) {
+      if (attr_set_i64(h->host, S_now, *now) < 0) return -1;
+      *now_dirty = 0;
+    }
+    PyObject *key = Py_BuildValue("(iii)", ir->aport, ir->peer, ir->bport);
+    if (!key) return -1;
+    PyObject *ep = PyDict_GetItem(h->conns, key);
+    Py_DECREF(key);
+    if (!ep) return 0; /* connection gone: no-op */
+    if (Py_TYPE(ep) == &CEp_Type)
+      return cs_oracle_loss((CEp *)ep, *now, ir->seq, ir->nbytes, pl);
+    if (*now_dirty) {
+      if (attr_set_i64(h->host, S_now, *now) < 0) return -1;
+      *now_dirty = 0;
+    }
+    PyObject *r = PyObject_CallMethod(ep, "on_loss_notify", "(LLO)",
+                                      (long long)ir->seq,
+                                      (long long)ir->nbytes,
+                                      pl ? pl : Py_None);
+    if (!r) return -1;
+    Py_DECREF(r);
+    return 0;
+  }
+  /* data-plane row: clock + ingress charge, then deliver. The clock
+   * attr syncs up front — endpoint handlers arm timers via
+   * host.schedule_in, which reads host._now. */
+  if (ir->t > *now) { *now = ir->t; *now_dirty = 1; }
+  if (*now_dirty) {
+    if (attr_set_i64(h->host, S_now, *now) < 0) return -1;
+    *now_dirty = 0;
+  }
+  if (ir->t >= c->bootstrap_end) {
+    if (c->tokens_down[hid] >= ir->size) {
+      c->tokens_down[hid] -= ir->size;
+    } else {
+      PyObject *dl = PyObject_GetAttr(h->host, S_ingress_deferred_rows);
+      if (!dl) return -1;
+      int r = PyList_Append(dl, ir->row);
+      Py_DECREF(dl);
+      if (r < 0) return -1;
+      if (PySet_Add(c->deferred, h->host) < 0) return -1;
+      return 0;
+    }
+  }
+  h->d_delivered++;
+  PyObject *key = Py_BuildValue("(iii)", ir->bport, ir->peer, ir->aport);
+  if (!key) return -1;
+  PyObject *ep = PyDict_GetItem(h->conns, key);
+  if (!ep) {
+    if (k != TK_SYN) {
+      Py_DECREF(key);
+      h->d_unroutable++;
+      return 0;
+    }
+    PyObject *pk = PyLong_FromLong(ir->bport);
+    if (!pk) { Py_DECREF(key); return -1; }
+    PyObject *on_accept = PyDict_GetItem(h->listeners, pk);
+    Py_DECREF(pk);
+    if (!on_accept) {
+      Py_DECREF(key);
+      h->d_unroutable++;
+      return 0;
+    }
+    CEp *ne = cep_new(c, hid, ir->bport, ir->peer, ir->aport, 0,
+                      c->sock_sbuf, c->sock_rbuf);
+    if (!ne) { Py_DECREF(key); return -1; }
+    ne->state = ST_ESTABLISHED;
+    ne->adv_wnd = ir->seq; /* client window rides the SYN */
+    int rset = PyDict_SetItem(h->conns, key, (PyObject *)ne);
+    Py_DECREF(key);
+    if (rset < 0) { Py_DECREF(ne); return -1; }
+    int err;
+    int64_t w = cep_window(ne, &err);
+    if (err) { Py_DECREF(ne); return -1; }
+    if (cep_emit(ne, *now, TK_SYNACK, 0, NULL, 0, 0, w, 0) < 0) {
+      Py_DECREF(ne);
+      return -1;
+    }
+    /* on_accept(ep, t) — Python app callback */
+    if (*now_dirty) {
+      if (attr_set_i64(h->host, S_now, *now) < 0) { Py_DECREF(ne); return -1; }
+      *now_dirty = 0;
+    }
+    PyObject *tn = PyLong_FromLongLong(*now);
+    if (!tn) { Py_DECREF(ne); return -1; }
+    PyObject *r = PyObject_CallFunctionObjArgs(on_accept, (PyObject *)ne,
+                                               tn, NULL);
+    Py_DECREF(tn);
+    Py_DECREF(ne);
+    if (!r) return -1;
+    Py_DECREF(r);
+    if (attr_i64(h->host, S_now, now) < 0) return -1;
+    return 0;
+  }
+  Py_DECREF(key);
+  if (Py_TYPE(ep) == &CEp_Type)
+    return ce_handle_fields((CEp *)ep, *now, k, ir->nbytes, pl, ir->seq);
+  /* Python endpoint on a C-dispatched host (shouldn't happen in
+   * practice, but stay correct): sync the clock and delegate */
+  if (*now_dirty) {
+    if (attr_set_i64(h->host, S_now, *now) < 0) return -1;
+    *now_dirty = 0;
+  }
+  PyObject *r = PyObject_CallMethod(ep, "handle_fields", "(LLOLL)",
+                                    (long long)k, (long long)ir->nbytes,
+                                    pl ? pl : Py_None, (long long)ir->seq,
+                                    (long long)*now);
+  if (!r) return -1;
+  Py_DECREF(r);
+  if (attr_i64(h->host, S_now, now) < 0) return -1;
+  return 0;
+}
 
 /* ---- module ------------------------------------------------------------ */
 static PyObject *mod_unit_dropped(PyObject *self, PyObject *args) {
@@ -1764,6 +2966,12 @@ PyMODINIT_FUNC PyInit__colcore(void) {
   INTERN(S_n_dgrams_recv, "_n_dgrams_recv");
   INTERN(S_n_events, "_n_events");
   INTERN(S_dispatch, "dispatch");
+  INTERN(S_schedule_in, "schedule_in");
+  INTERN(S_cancel_m, "cancel");
+  INTERN(S_rto_fire, "_rto_fire");
+  INTERN(S_syn_fire, "_syn_fire");
+  INTERN(S_fin_fire, "_fin_fire");
+  INTERN(S_drop_fire, "_drop_fire");
 #undef INTERN
   O_zero = PyLong_FromLong(0);
   O_one = PyLong_FromLong(1);
@@ -1772,7 +2980,8 @@ PyMODINIT_FUNC PyInit__colcore(void) {
   O_kind_dgram = PyLong_FromLong(KIND_DGRAM);
   O_kind_loss = PyLong_FromLong(KIND_LOSS_C);
   if (!O_zero || !O_one || !O_kind_dgram || !O_kind_loss) return NULL;
-  if (PyType_Ready(&Core_Type) < 0 || PyType_Ready(&GossipState_Type) < 0)
+  if (PyType_Ready(&Core_Type) < 0 || PyType_Ready(&GossipState_Type) < 0
+      || PyType_Ready(&CEp_Type) < 0)
     return NULL;
   PyObject *m = PyModule_Create(&colcore_module);
   if (!m) return NULL;
@@ -1780,5 +2989,7 @@ PyMODINIT_FUNC PyInit__colcore(void) {
   PyModule_AddObject(m, "Core", (PyObject *)&Core_Type);
   Py_INCREF(&GossipState_Type);
   PyModule_AddObject(m, "GossipState", (PyObject *)&GossipState_Type);
+  Py_INCREF(&CEp_Type);
+  PyModule_AddObject(m, "Endpoint", (PyObject *)&CEp_Type);
   return m;
 }

@@ -71,7 +71,10 @@
 
 struct shim_req { uint64_t nr; uint64_t args[6]; };
 
-static volatile int64_t *shim_time_page; /* emulated ns since UNIX epoch */
+static volatile int64_t *shim_time_page; /* [0] emulated ns since UNIX
+  epoch; [1] this process's virtual pid (identity fast path — INVALID in
+  forked children, which share the parent's page; see shim_is_fork) */
+static int shim_is_fork; /* set in the child after the fork replay */
 static int shim_active;
 static long shim_real_pid, shim_real_tid; /* cached pre-seccomp: the trapped
                                              getpid/gettid return vpids */
@@ -295,6 +298,7 @@ static long shim_do_fork(uint64_t nr, greg_t *g) {
      * and sever inherited per-thread channels by dup2'ing /dev/null over
      * them (close() on the IPC window is trapped — the worker must not
      * see channel traffic from this thread before its HELLO) */
+    shim_is_fork = 1; /* the shared clock page's vpid is the parent's */
     raw3(SYS_dup2, newfd, SHIM_IPC_FD, 0);
     if (newfd != SHIM_IPC_FD) raw3(SYS_close, newfd, 0, 0);
     int nullfd = (int)raw3(SYS_open, (long)"/dev/null", 2 /*O_RDWR*/, 0);
@@ -343,6 +347,10 @@ static int shim_nr_emulated(long nr, const greg_t *g) {
     return a0 <= 2 || vfd;
   case SYS_close:
     return vfd || (a0 >= SHIM_IPC_LOW && a0 <= SHIM_IPC_FD);
+  case 9: { /* mmap: fd rides arg4; MAP_ANONYMOUS fd=-1 stays native */
+    uint64_t a4 = (uint64_t)g[REG_R8];
+    return a4 >= SHIM_VFD_BASE && a4 < 0xFFFFF000u;
+  }
   /* BEGIN GENERATED VFD CASES (tools/gen_bpf.py) */
   case 16: case 72: case 32: case 5: case 8: case 217: case 77: case 74: case 75: case 81: case 17: case 18:  /* ioctl fcntl dup fstat lseek getdents64 ftruncate fsync fdatasync fchdir pread64 pwrite64 */
   /* END GENERATED VFD CASES */
@@ -439,6 +447,45 @@ static void sigsys_handler(int signo, siginfo_t *info, void *vctx) {
                                      (long)g[REG_RSI], (long)g[REG_RDX],
                                      (long)g[REG_R10], (long)g[REG_R8],
                                      (long)g[REG_R9]);
+    return;
+  }
+  /* identity fast path (shared clock page, no worker round trip):
+   * getpid/gettid return the page's vpid (the worker's emulation returns
+   * vpid for both), getppid is the constant 1 ("init of the simulated
+   * world"). Forked children share the parent's page, so they forward. */
+  if (info->si_syscall == SYS_getpid || info->si_syscall == SYS_gettid) {
+    if (!shim_is_fork && shim_time_page && shim_time_page[1] > 0) {
+      g[REG_RAX] = (greg_t)shim_time_page[1];
+      return;
+    }
+  } else if (info->si_syscall == SYS_getppid) {
+    g[REG_RAX] = 1;
+    return;
+  }
+  if (info->si_syscall == 9) {
+    /* mmap of a virtualized file: the worker replies with the real
+     * backing fd (host-tree fd or a memfd snapshot of synthesized
+     * content) as SCM_RIGHTS; re-issue the map with it through the
+     * gadget, then drop the temporary fd — the mapping holds the file */
+    struct shim_req rq = {9, {(uint64_t)g[REG_RDI], (uint64_t)g[REG_RSI],
+                              (uint64_t)g[REG_RDX], (uint64_t)g[REG_R10],
+                              (uint64_t)g[REG_R8], (uint64_t)g[REG_R9]}};
+    int64_t val = -EBADF;
+    if (write_all(&rq, sizeof rq) != 0) {
+      g[REG_RAX] = (greg_t)(int64_t)-EPIPE;
+      return;
+    }
+    int mfd = shim_recv_fd(&val);
+    if (mfd >= 0) {
+      shim_gadget_fn reissue = shim_gadget ? shim_gadget : raw6_asm;
+      long r = reissue(9, (long)g[REG_RDI], (long)g[REG_RSI],
+                       (long)g[REG_RDX], (long)g[REG_R10], mfd,
+                       (long)g[REG_R9]);
+      raw3(SYS_close, mfd, 0, 0);
+      g[REG_RAX] = (greg_t)r;
+    } else {
+      g[REG_RAX] = (greg_t)val; /* worker errno (no fd attached) */
+    }
     return;
   }
   int64_t ret = forward((uint64_t)info->si_syscall, (uint64_t)g[REG_RDI],
@@ -847,6 +894,7 @@ void pthread_exit(void *retval) {
 
 #define BPF_NR (offsetof(struct seccomp_data, nr))
 #define BPF_ARG0 (offsetof(struct seccomp_data, args[0]))
+#define BPF_ARG4 (offsetof(struct seccomp_data, args[4]))
 #define BPF_ARG2LO (offsetof(struct seccomp_data, args[2]))
 #define BPF_ARG2HI (offsetof(struct seccomp_data, args[2]) + 4)
 #define BPF_ARCHF (offsetof(struct seccomp_data, arch))
@@ -861,237 +909,243 @@ void pthread_exit(void *retval) {
 
 static int install_seccomp(void) {
   /* BEGIN GENERATED BPF (tools/gen_bpf.py) */
-  struct sock_filter prog[] = {  /* 116 instructions */
+  struct sock_filter prog[] = {  /* 119 instructions */
       LD(BPF_ARCHF),
-      JEQ(AUDIT_ARCH_X86_64, 0, 113),
+      JEQ(AUDIT_ARCH_X86_64, 0, 116),
       LD(BPF_IPHI),
       JEQ((uint32_t)((uintptr_t)SHIM_GADGET_ADDR >> 32), 0, 3),
       LD(BPF_IPLO),
       JGE((uint32_t)(uintptr_t)SHIM_GADGET_ADDR, 0, 1),
-      JGE(((uint32_t)(uintptr_t)SHIM_GADGET_ADDR + 4096), 0, 108),
+      JGE(((uint32_t)(uintptr_t)SHIM_GADGET_ADDR + 4096), 0, 111),
       LD(BPF_NR),
-      JEQ(0, 84, 0),  /* read */
-      JEQ(1, 88, 0),  /* write */
-      JEQ(3, 97, 0),  /* close */
-      JEQ(19, 81, 0),  /* readv */
-      JEQ(20, 85, 0),  /* writev */
-      JEQ(16, 97, 0),  /* ioctl */
-      JEQ(72, 96, 0),  /* fcntl */
-      JEQ(32, 95, 0),  /* dup */
-      JEQ(5, 94, 0),  /* fstat */
-      JEQ(8, 93, 0),  /* lseek */
-      JEQ(217, 92, 0),  /* getdents64 */
-      JEQ(77, 91, 0),  /* ftruncate */
-      JEQ(74, 90, 0),  /* fsync */
-      JEQ(75, 89, 0),  /* fdatasync */
-      JEQ(81, 88, 0),  /* fchdir */
-      JEQ(17, 87, 0),  /* pread64 */
-      JEQ(18, 86, 0),  /* pwrite64 */
-      JEQ(35, 88, 0),  /* nanosleep */
-      JEQ(230, 87, 0),  /* clock_nanosleep */
-      JEQ(228, 86, 0),  /* clock_gettime */
-      JEQ(96, 85, 0),  /* gettimeofday */
-      JEQ(201, 84, 0),  /* time */
-      JEQ(318, 83, 0),  /* getrandom */
-      JEQ(7, 82, 0),  /* poll */
-      JEQ(271, 81, 0),  /* ppoll */
-      JEQ(213, 80, 0),  /* epoll_create */
-      JEQ(291, 79, 0),  /* epoll_create1 */
-      JEQ(233, 78, 0),  /* epoll_ctl */
-      JEQ(232, 77, 0),  /* epoll_wait */
-      JEQ(281, 76, 0),  /* epoll_pwait */
-      JEQ(288, 75, 0),  /* accept4 */
-      JEQ(435, 74, 0),  /* clone3 */
-      JEQ(39, 73, 0),  /* getpid */
-      JEQ(110, 72, 0),  /* getppid */
-      JEQ(186, 71, 0),  /* gettid */
-      JEQ(283, 70, 0),  /* timerfd_create */
-      JEQ(286, 69, 0),  /* timerfd_settime */
-      JEQ(287, 68, 0),  /* timerfd_gettime */
-      JEQ(284, 67, 0),  /* eventfd */
-      JEQ(290, 66, 0),  /* eventfd2 */
-      JEQ(202, 65, 0),  /* futex */
-      JEQ(14, 64, 0),  /* rt_sigprocmask */
-      JEQ(22, 63, 0),  /* pipe */
-      JEQ(293, 62, 0),  /* pipe2 */
-      JEQ(61, 61, 0),  /* wait4 */
-      JEQ(231, 60, 0),  /* exit_group */
-      JEQ(436, 59, 0),  /* close_range */
-      JEQ(23, 58, 0),  /* select */
-      JEQ(270, 57, 0),  /* pselect6 */
-      JEQ(62, 56, 0),  /* kill */
-      JEQ(63, 55, 0),  /* uname */
-      JEQ(100, 54, 0),  /* times */
-      JEQ(229, 53, 0),  /* clock_getres */
-      JEQ(204, 52, 0),  /* sched_getaffinity */
-      JEQ(99, 51, 0),  /* sysinfo */
-      JEQ(98, 50, 0),  /* getrusage */
-      JEQ(2, 49, 0),  /* open */
-      JEQ(257, 48, 0),  /* openat */
-      JEQ(85, 47, 0),  /* creat */
-      JEQ(4, 46, 0),  /* stat */
-      JEQ(6, 45, 0),  /* lstat */
-      JEQ(332, 44, 0),  /* statx */
-      JEQ(21, 43, 0),  /* access */
-      JEQ(269, 42, 0),  /* faccessat */
-      JEQ(439, 41, 0),  /* faccessat2 */
-      JEQ(262, 40, 0),  /* newfstatat */
-      JEQ(87, 39, 0),  /* unlink */
-      JEQ(263, 38, 0),  /* unlinkat */
-      JEQ(83, 37, 0),  /* mkdir */
-      JEQ(258, 36, 0),  /* mkdirat */
-      JEQ(84, 35, 0),  /* rmdir */
-      JEQ(82, 34, 0),  /* rename */
-      JEQ(264, 33, 0),  /* renameat */
-      JEQ(316, 32, 0),  /* renameat2 */
-      JEQ(89, 31, 0),  /* readlink */
-      JEQ(267, 30, 0),  /* readlinkat */
-      JEQ(80, 29, 0),  /* chdir */
-      JEQ(79, 28, 0),  /* getcwd */
-      JEQ(76, 27, 0),  /* truncate */
-      JEQ(33, 26, 0),  /* dup2 */
-      JEQ(292, 25, 0),  /* dup3 */
+      JEQ(0, 85, 0),  /* read */
+      JEQ(1, 89, 0),  /* write */
+      JEQ(3, 98, 0),  /* close */
+      JEQ(19, 82, 0),  /* readv */
+      JEQ(20, 86, 0),  /* writev */
+      JEQ(16, 100, 0),  /* ioctl */
+      JEQ(72, 99, 0),  /* fcntl */
+      JEQ(32, 98, 0),  /* dup */
+      JEQ(5, 97, 0),  /* fstat */
+      JEQ(8, 96, 0),  /* lseek */
+      JEQ(217, 95, 0),  /* getdents64 */
+      JEQ(77, 94, 0),  /* ftruncate */
+      JEQ(74, 93, 0),  /* fsync */
+      JEQ(75, 92, 0),  /* fdatasync */
+      JEQ(81, 91, 0),  /* fchdir */
+      JEQ(17, 90, 0),  /* pread64 */
+      JEQ(18, 89, 0),  /* pwrite64 */
+      JEQ(9, 86, 0),  /* mmap */
+      JEQ(35, 90, 0),  /* nanosleep */
+      JEQ(230, 89, 0),  /* clock_nanosleep */
+      JEQ(228, 88, 0),  /* clock_gettime */
+      JEQ(96, 87, 0),  /* gettimeofday */
+      JEQ(201, 86, 0),  /* time */
+      JEQ(318, 85, 0),  /* getrandom */
+      JEQ(7, 84, 0),  /* poll */
+      JEQ(271, 83, 0),  /* ppoll */
+      JEQ(213, 82, 0),  /* epoll_create */
+      JEQ(291, 81, 0),  /* epoll_create1 */
+      JEQ(233, 80, 0),  /* epoll_ctl */
+      JEQ(232, 79, 0),  /* epoll_wait */
+      JEQ(281, 78, 0),  /* epoll_pwait */
+      JEQ(288, 77, 0),  /* accept4 */
+      JEQ(435, 76, 0),  /* clone3 */
+      JEQ(39, 75, 0),  /* getpid */
+      JEQ(110, 74, 0),  /* getppid */
+      JEQ(186, 73, 0),  /* gettid */
+      JEQ(283, 72, 0),  /* timerfd_create */
+      JEQ(286, 71, 0),  /* timerfd_settime */
+      JEQ(287, 70, 0),  /* timerfd_gettime */
+      JEQ(284, 69, 0),  /* eventfd */
+      JEQ(290, 68, 0),  /* eventfd2 */
+      JEQ(202, 67, 0),  /* futex */
+      JEQ(14, 66, 0),  /* rt_sigprocmask */
+      JEQ(22, 65, 0),  /* pipe */
+      JEQ(293, 64, 0),  /* pipe2 */
+      JEQ(61, 63, 0),  /* wait4 */
+      JEQ(231, 62, 0),  /* exit_group */
+      JEQ(436, 61, 0),  /* close_range */
+      JEQ(23, 60, 0),  /* select */
+      JEQ(270, 59, 0),  /* pselect6 */
+      JEQ(62, 58, 0),  /* kill */
+      JEQ(63, 57, 0),  /* uname */
+      JEQ(100, 56, 0),  /* times */
+      JEQ(229, 55, 0),  /* clock_getres */
+      JEQ(204, 54, 0),  /* sched_getaffinity */
+      JEQ(99, 53, 0),  /* sysinfo */
+      JEQ(98, 52, 0),  /* getrusage */
+      JEQ(2, 51, 0),  /* open */
+      JEQ(257, 50, 0),  /* openat */
+      JEQ(85, 49, 0),  /* creat */
+      JEQ(4, 48, 0),  /* stat */
+      JEQ(6, 47, 0),  /* lstat */
+      JEQ(332, 46, 0),  /* statx */
+      JEQ(21, 45, 0),  /* access */
+      JEQ(269, 44, 0),  /* faccessat */
+      JEQ(439, 43, 0),  /* faccessat2 */
+      JEQ(262, 42, 0),  /* newfstatat */
+      JEQ(87, 41, 0),  /* unlink */
+      JEQ(263, 40, 0),  /* unlinkat */
+      JEQ(83, 39, 0),  /* mkdir */
+      JEQ(258, 38, 0),  /* mkdirat */
+      JEQ(84, 37, 0),  /* rmdir */
+      JEQ(82, 36, 0),  /* rename */
+      JEQ(264, 35, 0),  /* renameat */
+      JEQ(316, 34, 0),  /* renameat2 */
+      JEQ(89, 33, 0),  /* readlink */
+      JEQ(267, 32, 0),  /* readlinkat */
+      JEQ(80, 31, 0),  /* chdir */
+      JEQ(79, 30, 0),  /* getcwd */
+      JEQ(76, 29, 0),  /* truncate */
+      JEQ(33, 28, 0),  /* dup2 */
+      JEQ(292, 27, 0),  /* dup3 */
       JEQ(47, 13, 0),  /* recvmsg */
       JEQ(56, 15, 0),  /* clone */
-      JGE(41, 0, 23),  /* socket */
-      JGE(60, 22, 21),  /* clone_end */
+      JGE(41, 0, 25),  /* socket */
+      JGE(60, 24, 23),  /* clone_end */
       LD(BPF_ARG0),
       JGE(SHIM_IPC_LOW, 0, 1),
-      JGE((SHIM_IPC_FD + 1), 0, 19),
-      JEQ(0, 17, 0),  /* read */
-      JGE(SHIM_VFD_BASE, 16, 17),
+      JGE((SHIM_IPC_FD + 1), 0, 21),
+      JEQ(0, 19, 0),  /* read */
+      JGE(SHIM_VFD_BASE, 18, 19),
       LD(BPF_ARG0),
       JGE(SHIM_IPC_LOW, 0, 1),
-      JGE((SHIM_IPC_FD + 1), 0, 14),
-      JGE(3, 0, 12),  /* close */
-      JGE(SHIM_VFD_BASE, 11, 12),
+      JGE((SHIM_IPC_FD + 1), 0, 16),
+      JGE(3, 0, 14),  /* close */
+      JGE(SHIM_VFD_BASE, 13, 14),
       LD(BPF_ARG0),
-      JGE(SHIM_IPC_LOW, 0, 9),
-      JGE((SHIM_IPC_FD + 1), 8, 9),
+      JGE(SHIM_IPC_LOW, 0, 11),
+      JGE((SHIM_IPC_FD + 1), 10, 11),
       LD(BPF_ARG0),
-      JSET(65536, 7, 6),  /* CLONE_THREAD */
+      JSET(65536, 9, 8),  /* CLONE_THREAD */
       LD(BPF_ARG0),
-      JGE(SHIM_IPC_LOW, 0, 2),
-      JGE((SHIM_IPC_FD + 1), 1, 3),
+      JGE(SHIM_IPC_LOW, 0, 4),
+      JGE((SHIM_IPC_FD + 1), 3, 5),
+      LD(BPF_ARG4),
+      JGE(0, 1, 1),  /* read */
       LD(BPF_ARG0),
       JGE(SHIM_VFD_BASE, 0, 2),
       JGE(4294963200, 1, 0),
       RET(SECCOMP_RET_TRAP),
       RET(SECCOMP_RET_ALLOW),
   };
-  struct sock_filter prog_audit[] = {  /* 117 instructions */
+  struct sock_filter prog_audit[] = {  /* 120 instructions */
       LD(BPF_ARCHF),
-      JEQ(AUDIT_ARCH_X86_64, 0, 114),
+      JEQ(AUDIT_ARCH_X86_64, 0, 117),
       LD(BPF_IPHI),
       JEQ((uint32_t)((uintptr_t)SHIM_GADGET_ADDR >> 32), 0, 3),
       LD(BPF_IPLO),
       JGE((uint32_t)(uintptr_t)SHIM_GADGET_ADDR, 0, 1),
-      JGE(((uint32_t)(uintptr_t)SHIM_GADGET_ADDR + 4096), 0, 109),
+      JGE(((uint32_t)(uintptr_t)SHIM_GADGET_ADDR + 4096), 0, 112),
       LD(BPF_NR),
-      JEQ(15, 107, 0),
-      JEQ(0, 84, 0),  /* read */
-      JEQ(1, 88, 0),  /* write */
-      JEQ(3, 97, 0),  /* close */
-      JEQ(19, 81, 0),  /* readv */
-      JEQ(20, 85, 0),  /* writev */
-      JEQ(16, 97, 0),  /* ioctl */
-      JEQ(72, 96, 0),  /* fcntl */
-      JEQ(32, 95, 0),  /* dup */
-      JEQ(5, 94, 0),  /* fstat */
-      JEQ(8, 93, 0),  /* lseek */
-      JEQ(217, 92, 0),  /* getdents64 */
-      JEQ(77, 91, 0),  /* ftruncate */
-      JEQ(74, 90, 0),  /* fsync */
-      JEQ(75, 89, 0),  /* fdatasync */
-      JEQ(81, 88, 0),  /* fchdir */
-      JEQ(17, 87, 0),  /* pread64 */
-      JEQ(18, 86, 0),  /* pwrite64 */
-      JEQ(35, 88, 0),  /* nanosleep */
-      JEQ(230, 87, 0),  /* clock_nanosleep */
-      JEQ(228, 86, 0),  /* clock_gettime */
-      JEQ(96, 85, 0),  /* gettimeofday */
-      JEQ(201, 84, 0),  /* time */
-      JEQ(318, 83, 0),  /* getrandom */
-      JEQ(7, 82, 0),  /* poll */
-      JEQ(271, 81, 0),  /* ppoll */
-      JEQ(213, 80, 0),  /* epoll_create */
-      JEQ(291, 79, 0),  /* epoll_create1 */
-      JEQ(233, 78, 0),  /* epoll_ctl */
-      JEQ(232, 77, 0),  /* epoll_wait */
-      JEQ(281, 76, 0),  /* epoll_pwait */
-      JEQ(288, 75, 0),  /* accept4 */
-      JEQ(435, 74, 0),  /* clone3 */
-      JEQ(39, 73, 0),  /* getpid */
-      JEQ(110, 72, 0),  /* getppid */
-      JEQ(186, 71, 0),  /* gettid */
-      JEQ(283, 70, 0),  /* timerfd_create */
-      JEQ(286, 69, 0),  /* timerfd_settime */
-      JEQ(287, 68, 0),  /* timerfd_gettime */
-      JEQ(284, 67, 0),  /* eventfd */
-      JEQ(290, 66, 0),  /* eventfd2 */
-      JEQ(202, 65, 0),  /* futex */
-      JEQ(14, 64, 0),  /* rt_sigprocmask */
-      JEQ(22, 63, 0),  /* pipe */
-      JEQ(293, 62, 0),  /* pipe2 */
-      JEQ(61, 61, 0),  /* wait4 */
-      JEQ(231, 60, 0),  /* exit_group */
-      JEQ(436, 59, 0),  /* close_range */
-      JEQ(23, 58, 0),  /* select */
-      JEQ(270, 57, 0),  /* pselect6 */
-      JEQ(62, 56, 0),  /* kill */
-      JEQ(63, 55, 0),  /* uname */
-      JEQ(100, 54, 0),  /* times */
-      JEQ(229, 53, 0),  /* clock_getres */
-      JEQ(204, 52, 0),  /* sched_getaffinity */
-      JEQ(99, 51, 0),  /* sysinfo */
-      JEQ(98, 50, 0),  /* getrusage */
-      JEQ(2, 49, 0),  /* open */
-      JEQ(257, 48, 0),  /* openat */
-      JEQ(85, 47, 0),  /* creat */
-      JEQ(4, 46, 0),  /* stat */
-      JEQ(6, 45, 0),  /* lstat */
-      JEQ(332, 44, 0),  /* statx */
-      JEQ(21, 43, 0),  /* access */
-      JEQ(269, 42, 0),  /* faccessat */
-      JEQ(439, 41, 0),  /* faccessat2 */
-      JEQ(262, 40, 0),  /* newfstatat */
-      JEQ(87, 39, 0),  /* unlink */
-      JEQ(263, 38, 0),  /* unlinkat */
-      JEQ(83, 37, 0),  /* mkdir */
-      JEQ(258, 36, 0),  /* mkdirat */
-      JEQ(84, 35, 0),  /* rmdir */
-      JEQ(82, 34, 0),  /* rename */
-      JEQ(264, 33, 0),  /* renameat */
-      JEQ(316, 32, 0),  /* renameat2 */
-      JEQ(89, 31, 0),  /* readlink */
-      JEQ(267, 30, 0),  /* readlinkat */
-      JEQ(80, 29, 0),  /* chdir */
-      JEQ(79, 28, 0),  /* getcwd */
-      JEQ(76, 27, 0),  /* truncate */
-      JEQ(33, 26, 0),  /* dup2 */
-      JEQ(292, 25, 0),  /* dup3 */
+      JEQ(15, 110, 0),
+      JEQ(0, 85, 0),  /* read */
+      JEQ(1, 89, 0),  /* write */
+      JEQ(3, 98, 0),  /* close */
+      JEQ(19, 82, 0),  /* readv */
+      JEQ(20, 86, 0),  /* writev */
+      JEQ(16, 100, 0),  /* ioctl */
+      JEQ(72, 99, 0),  /* fcntl */
+      JEQ(32, 98, 0),  /* dup */
+      JEQ(5, 97, 0),  /* fstat */
+      JEQ(8, 96, 0),  /* lseek */
+      JEQ(217, 95, 0),  /* getdents64 */
+      JEQ(77, 94, 0),  /* ftruncate */
+      JEQ(74, 93, 0),  /* fsync */
+      JEQ(75, 92, 0),  /* fdatasync */
+      JEQ(81, 91, 0),  /* fchdir */
+      JEQ(17, 90, 0),  /* pread64 */
+      JEQ(18, 89, 0),  /* pwrite64 */
+      JEQ(9, 86, 0),  /* mmap */
+      JEQ(35, 90, 0),  /* nanosleep */
+      JEQ(230, 89, 0),  /* clock_nanosleep */
+      JEQ(228, 88, 0),  /* clock_gettime */
+      JEQ(96, 87, 0),  /* gettimeofday */
+      JEQ(201, 86, 0),  /* time */
+      JEQ(318, 85, 0),  /* getrandom */
+      JEQ(7, 84, 0),  /* poll */
+      JEQ(271, 83, 0),  /* ppoll */
+      JEQ(213, 82, 0),  /* epoll_create */
+      JEQ(291, 81, 0),  /* epoll_create1 */
+      JEQ(233, 80, 0),  /* epoll_ctl */
+      JEQ(232, 79, 0),  /* epoll_wait */
+      JEQ(281, 78, 0),  /* epoll_pwait */
+      JEQ(288, 77, 0),  /* accept4 */
+      JEQ(435, 76, 0),  /* clone3 */
+      JEQ(39, 75, 0),  /* getpid */
+      JEQ(110, 74, 0),  /* getppid */
+      JEQ(186, 73, 0),  /* gettid */
+      JEQ(283, 72, 0),  /* timerfd_create */
+      JEQ(286, 71, 0),  /* timerfd_settime */
+      JEQ(287, 70, 0),  /* timerfd_gettime */
+      JEQ(284, 69, 0),  /* eventfd */
+      JEQ(290, 68, 0),  /* eventfd2 */
+      JEQ(202, 67, 0),  /* futex */
+      JEQ(14, 66, 0),  /* rt_sigprocmask */
+      JEQ(22, 65, 0),  /* pipe */
+      JEQ(293, 64, 0),  /* pipe2 */
+      JEQ(61, 63, 0),  /* wait4 */
+      JEQ(231, 62, 0),  /* exit_group */
+      JEQ(436, 61, 0),  /* close_range */
+      JEQ(23, 60, 0),  /* select */
+      JEQ(270, 59, 0),  /* pselect6 */
+      JEQ(62, 58, 0),  /* kill */
+      JEQ(63, 57, 0),  /* uname */
+      JEQ(100, 56, 0),  /* times */
+      JEQ(229, 55, 0),  /* clock_getres */
+      JEQ(204, 54, 0),  /* sched_getaffinity */
+      JEQ(99, 53, 0),  /* sysinfo */
+      JEQ(98, 52, 0),  /* getrusage */
+      JEQ(2, 51, 0),  /* open */
+      JEQ(257, 50, 0),  /* openat */
+      JEQ(85, 49, 0),  /* creat */
+      JEQ(4, 48, 0),  /* stat */
+      JEQ(6, 47, 0),  /* lstat */
+      JEQ(332, 46, 0),  /* statx */
+      JEQ(21, 45, 0),  /* access */
+      JEQ(269, 44, 0),  /* faccessat */
+      JEQ(439, 43, 0),  /* faccessat2 */
+      JEQ(262, 42, 0),  /* newfstatat */
+      JEQ(87, 41, 0),  /* unlink */
+      JEQ(263, 40, 0),  /* unlinkat */
+      JEQ(83, 39, 0),  /* mkdir */
+      JEQ(258, 38, 0),  /* mkdirat */
+      JEQ(84, 37, 0),  /* rmdir */
+      JEQ(82, 36, 0),  /* rename */
+      JEQ(264, 35, 0),  /* renameat */
+      JEQ(316, 34, 0),  /* renameat2 */
+      JEQ(89, 33, 0),  /* readlink */
+      JEQ(267, 32, 0),  /* readlinkat */
+      JEQ(80, 31, 0),  /* chdir */
+      JEQ(79, 30, 0),  /* getcwd */
+      JEQ(76, 29, 0),  /* truncate */
+      JEQ(33, 28, 0),  /* dup2 */
+      JEQ(292, 27, 0),  /* dup3 */
       JEQ(47, 13, 0),  /* recvmsg */
       JEQ(56, 15, 0),  /* clone */
-      JGE(41, 0, 22),  /* socket */
-      JGE(60, 21, 21),  /* clone_end */
+      JGE(41, 0, 24),  /* socket */
+      JGE(60, 23, 23),  /* clone_end */
       LD(BPF_ARG0),
       JGE(SHIM_IPC_LOW, 0, 1),
-      JGE((SHIM_IPC_FD + 1), 0, 19),
-      JEQ(0, 17, 0),  /* read */
-      JGE(SHIM_VFD_BASE, 16, 16),
+      JGE((SHIM_IPC_FD + 1), 0, 21),
+      JEQ(0, 19, 0),  /* read */
+      JGE(SHIM_VFD_BASE, 18, 18),
       LD(BPF_ARG0),
       JGE(SHIM_IPC_LOW, 0, 1),
-      JGE((SHIM_IPC_FD + 1), 0, 14),
-      JGE(3, 0, 12),  /* close */
-      JGE(SHIM_VFD_BASE, 11, 11),
+      JGE((SHIM_IPC_FD + 1), 0, 16),
+      JGE(3, 0, 14),  /* close */
+      JGE(SHIM_VFD_BASE, 13, 13),
       LD(BPF_ARG0),
-      JGE(SHIM_IPC_LOW, 0, 9),
-      JGE((SHIM_IPC_FD + 1), 8, 9),
+      JGE(SHIM_IPC_LOW, 0, 11),
+      JGE((SHIM_IPC_FD + 1), 10, 11),
       LD(BPF_ARG0),
-      JSET(65536, 7, 6),  /* CLONE_THREAD */
+      JSET(65536, 9, 8),  /* CLONE_THREAD */
       LD(BPF_ARG0),
-      JGE(SHIM_IPC_LOW, 0, 2),
-      JGE((SHIM_IPC_FD + 1), 1, 3),
+      JGE(SHIM_IPC_LOW, 0, 4),
+      JGE((SHIM_IPC_FD + 1), 3, 5),
+      LD(BPF_ARG4),
+      JGE(0, 1, 1),  /* read */
       LD(BPF_ARG0),
       JGE(SHIM_VFD_BASE, 0, 1),
       JGE(4294963200, 0, 0),

@@ -1,0 +1,39 @@
+"""Dual-run guest: mmap over files served by the virtual file surface.
+
+Runs unmodified both against the real kernel and as a managed process;
+stdout must be byte-identical (tests/test_vfs.py). Under the simulator the
+open() returns a vfd and the trapped mmap round-trips through the worker
+(SCM_RIGHTS real-fd reply; managed.py::_mmap_vfd)."""
+
+import hashlib
+import mmap
+
+data = bytes(range(256)) * 512  # 128 KiB
+with open("blob.bin", "wb") as f:
+    f.write(data)
+with open("blob.bin", "rb") as f:
+    m = mmap.mmap(f.fileno(), 0, prot=mmap.PROT_READ)
+    print("len", len(m))
+    print("sha", hashlib.sha256(m[:]).hexdigest())
+    print("head", m[:8].hex(), "tail", m[-8:].hex())
+    m.close()
+
+# shared writable mapping: stores must land in the backing file
+with open("rw.bin", "wb") as f:
+    f.write(b"\0" * 4096)
+with open("rw.bin", "r+b") as f:
+    m = mmap.mmap(f.fileno(), 4096)
+    m[0:5] = b"HELLO"
+    m[4091:4096] = b"WORLD"
+    m.flush()
+    m.close()
+back = open("rw.bin", "rb").read()
+print("rw", back[:5].decode(), back[-5:].decode(), len(back))
+
+# a synthesized file maps too (memfd snapshot); content matches read()
+hosts_read = open("/etc/hosts", "rb").read()
+with open("/etc/hosts", "rb") as f:
+    m = mmap.mmap(f.fileno(), 0, prot=mmap.PROT_READ)
+    print("synth_match", bytes(m[:]) == hosts_read)
+    m.close()
+print("done")

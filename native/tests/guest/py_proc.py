@@ -1,0 +1,20 @@
+"""Managed-only guest: the synthesized /proc must present the virtual
+machine identity (1 CPU, 2 GB, simulated uptime, vpid) regardless of the
+real host (tests/test_vfs.py asserts the printed invariants)."""
+
+import os
+
+cpu = open("/proc/cpuinfo").read()
+print("ncpu", cpu.count("processor\t:"))
+print([ln for ln in cpu.splitlines() if ln.startswith("model name")][0])
+print(open("/proc/meminfo").read().splitlines()[0])
+st = open("/proc/self/status").read().splitlines()
+print([ln for ln in st if ln.split(":")[0] in ("Name", "PPid", "Threads")])
+stat = open("/proc/self/stat").read().split()
+print("stat_pid_is_getpid", int(stat[0]) == os.getpid())
+print("comm", stat[1])
+up = float(open("/proc/uptime").read().split()[0])
+print("uptime_is_sim", 0.0 <= up < 100.0)
+maps = open("/proc/self/maps").read()
+print("maps_has_stack_heap", "[stack]" in maps and "[heap]" in maps)
+print("cpu_count", os.cpu_count())

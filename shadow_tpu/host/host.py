@@ -348,6 +348,15 @@ class Host:
     def _make_endpoint(self, local_port: int, remote_host: int,
                        remote_port: int, initiator: bool) -> StreamEndpoint:
         exp = self.controller.cfg.experimental
+        core = getattr(self.colplane, "_c", None)
+        if core is not None and self.pcap is None:
+            # C stream endpoint (native/colcore): the exact protocol twin
+            # of StreamEndpoint, bit-identical under the cross-plane and
+            # colcore A/B suites; Python remains the oracle (and serves
+            # pcap hosts, whose dispatch stays on the Python path)
+            return core.make_endpoint(
+                self.id, local_port, remote_host, remote_port,
+                initiator, exp.socket_send_buffer, exp.socket_recv_buffer)
         return StreamEndpoint(
             self, local_port, remote_host, remote_port, initiator=initiator,
             send_buffer=exp.socket_send_buffer,

@@ -82,8 +82,10 @@ SYS_socketpair = 53
 SYS_uname = 63
 SYS_times, SYS_clock_getres = 100, 229
 SYS_sched_getaffinity, SYS_sysinfo = 204, 99
+SYS_mmap = 9
 SYS_getrusage = 98
-SIM_CPUS = 2  # virtual cores guests see (machine-independent behavior)
+from shadow_tpu.native.identity import SIM_CPUS  # noqa: E402 (why 1
+# CPU: see identity.py — the spin-free machine identity)
 # default-terminate signals the worker emulates for guest-to-guest kill
 # every Linux default-terminate signal (+ realtime 34..64, all default-
 # terminate); STOP/CONT/TSTP (19,18,20..22) and default-ignores excluded
@@ -344,6 +346,9 @@ class ManagedProcess(ProcessLifecycle):
         #: experimental.native_audit: syscall numbers this process ran
         #: against the host kernel (reported once each by the shim)
         self.audit_native: set[int] = set()
+        #: default-on reality boundary: syscall numbers the worker sent
+        #: back for native re-issue (RETRY_NATIVE) in THIS process
+        self.native_vfs: set[int] = set()
         #: the per-host virtual file surface (native/vfs.py): synthesized
         #: /etc files, host-data-dir tree, native passthrough elsewhere
         self.vfs = HostVFS(self)
@@ -408,6 +413,10 @@ class ManagedProcess(ProcessLifecycle):
         tf = open(self._time_path, "r+b")
         self._time_map = mmap.mmap(tf.fileno(), 4096)
         tf.close()
+        # page layout: [0:8] emulated ns, [8:16] vpid (the shim's identity
+        # fast path serves getpid/gettid from here — no worker round trip;
+        # forked children share this page and keep forwarding instead)
+        self._time_map[8:16] = struct.pack("<q", self.vpid)
 
         env = dict(os.environ)
         env.update(self.opts.environment)
@@ -726,6 +735,14 @@ class ManagedProcess(ProcessLifecycle):
                 self._exited()
                 return
             self._trace(nr, args, ret)
+            if ret == RETRY_NATIVE and nr not in self.native_vfs:
+                # reality boundary, default-on (VERDICT r3 item #7): the
+                # worker declined this path/syscall and the shim re-issues
+                # it against the host kernel — record the number even
+                # without audit mode (audit mode additionally observes
+                # the never-trapped numbers via the gadget-IP filter)
+                self.native_vfs.add(nr)
+                self.host.counters.add("native_passthrough_syscalls", 1)
             if self._syscall_latency == 0:
                 # livelock detector: a guest spinning on nonblocking
                 # syscalls at a frozen sim instant (e.g. sloppy epoll
@@ -865,6 +882,35 @@ class ManagedProcess(ProcessLifecycle):
         child.close()
         # grant the embryo its first turn once the spawner yields
         self._ready.append((nt, _EMBRYO))
+        return _REPLIED
+
+    def _mmap_vfd(self, args):
+        """mmap over a virtualized file (the arg4-conditional trap): reply
+        with a real kernel fd as SCM_RIGHTS — the host-tree backing fd, or
+        a memfd snapshot for synthesized content — and the shim re-issues
+        the map with it through the gadget, then closes the temporary fd.
+        Deterministic: only this simulation writes the backing files, and
+        synthesized snapshots are pure functions of the config."""
+        fd = _sfd(args[4])
+        vs = self.fds.get(fd)
+        if vs is None:
+            return -EBADF
+        if vs.kind != "file" or vs.vfile is None:
+            return -19  # ENODEV: directories/sockets are not mappable
+        vf = vs.vfile
+        if vf.fd is not None:
+            send = vf.fd
+            tmp = None
+        else:
+            tmp = os.memfd_create("shadow-synth")
+            os.write(tmp, vf.data)
+            send = tmp
+        self._time_map[:8] = struct.pack("<q", emulated(self.host.now))
+        try:
+            socket.send_fds(self._cur.sock, [struct.pack("<q", 0)], [send])
+        finally:
+            if tmp is not None:
+                os.close(tmp)
         return _REPLIED
 
     def _join_thread(self, slot: int):
@@ -1367,6 +1413,13 @@ class ManagedProcess(ProcessLifecycle):
             self.host.log(
                 f"{self.name}: {len(self.audit_native)} unemulated "
                 f"syscalls ran natively: {sorted(self.audit_native)}")
+        if self.native_vfs:
+            # default-on flavor (VERDICT r3 item #7): numbers the worker
+            # explicitly re-issued natively (virtual-FS policy and
+            # unemulated trapped calls), observed in EVERY run
+            self.host.log(
+                f"{self.name}: guest used {len(self.native_vfs)} "
+                f"native-passthrough syscalls: {sorted(self.native_vfs)}")
         if self._strace is not None:
             if self.audit_native:
                 self._strace.write(
@@ -1825,6 +1878,8 @@ class ManagedProcess(ProcessLifecycle):
                 self.fd_cloexec.discard(fd)
                 self._close_vs(self.fds.pop(fd))
             return 0
+        if nr == SYS_mmap:
+            return self._mmap_vfd(args)
         if nr == SYS_fstat:
             return self._fstat(args[0], args[1])
         if nr == SYS_newfstatat:

@@ -184,3 +184,82 @@ def test_python_file_io_dual_run(tmp_path):
     name = Path(sys.executable).name
     managed = Path(f"/tmp/vfs-py/hosts/beta/{name}.0.stdout").read_text()
     assert managed == native.stdout, (managed, native.stdout)
+
+
+PY_MMAP_GUEST = ROOT / "native" / "tests" / "guest" / "py_mmap.py"
+PY_PROC_GUEST = ROOT / "native" / "tests" / "guest" / "py_proc.py"
+
+
+def test_python_mmap_dual_run(tmp_path):
+    """mmap over virtualized files (VERDICT r3 item #4): read-only maps,
+    shared writable maps landing in the backing file, and a synthesized
+    file mapped via a memfd snapshot — byte-identical stdout natively and
+    under the simulator."""
+    import sys
+
+    native = subprocess.run([sys.executable, str(PY_MMAP_GUEST)],
+                            cwd=tmp_path, capture_output=True, text=True,
+                            timeout=60)
+    assert native.returncode == 0, native.stderr
+    cfg_text = ETC_CFG.replace(
+        "path: /bin/cat\n        args: [\"/etc/hosts\"]",
+        f"path: {sys.executable}\n        args: [\"{PY_MMAP_GUEST}\"]")
+    cfg = parse_config(yaml.safe_load(cfg_text), {
+        "general.data_directory": "/tmp/vfs-mmap",
+    })
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    import sys as _s
+    name = Path(_s.executable).name
+    managed = Path(f"/tmp/vfs-mmap/hosts/beta/{name}.0.stdout").read_text()
+    assert managed == native.stdout, (managed, native.stdout)
+    # the shared-writable map's stores really landed in the host tree
+    back = Path("/tmp/vfs-mmap/hosts/beta/rw.bin").read_bytes()
+    assert back[:5] == b"HELLO" and back[-5:] == b"WORLD"
+
+
+def test_proc_virtual_identity():
+    """The synthesized /proc presents the 1-CPU / 2-GB / sim-uptime
+    virtual identity on ANY host (VERDICT r3 item #8)."""
+    import sys
+
+    cfg_text = ETC_CFG.replace(
+        "path: /bin/cat\n        args: [\"/etc/hosts\"]",
+        f"path: {sys.executable}\n        args: [\"{PY_PROC_GUEST}\"]")
+    cfg = parse_config(yaml.safe_load(cfg_text), {
+        "general.data_directory": "/tmp/vfs-proc",
+    })
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    name = Path(sys.executable).name
+    out = Path(f"/tmp/vfs-proc/hosts/beta/{name}.0.stdout").read_text()
+    assert "ncpu 1" in out, out
+    assert "Shadow Virtual CPU" in out, out
+    assert "MemTotal:       2097152 kB" in out, out
+    assert "stat_pid_is_getpid True" in out, out
+    assert "uptime_is_sim True" in out, out
+    assert "maps_has_stack_heap True" in out, out
+    assert "cpu_count 1" in out, out
+
+
+def test_native_passthrough_surfaced_by_default():
+    """VERDICT r3 item #7: every run (no audit flag) surfaces the
+    syscall numbers the worker re-issued natively, in the host log and
+    the counters — and the list is twice-run stable."""
+    def go(tag):
+        cfg = parse_config(yaml.safe_load(ETC_CFG), {
+            "general.data_directory": f"/tmp/vfs-npt-{tag}",
+        })
+        c = Controller(cfg, mirror_log=False)
+        r = c.run()
+        assert r["process_errors"] == [], r["process_errors"]
+        assert r["counters"].get("native_passthrough_syscalls", 0) > 0
+        log = Path(f"/tmp/vfs-npt-{tag}/hosts/beta/beta.log").read_text()
+        lines = [ln for ln in log.splitlines()
+                 if "native-passthrough syscalls" in ln]
+        assert lines, log
+        return lines
+
+    assert go("a") == go("b")

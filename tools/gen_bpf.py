@@ -12,6 +12,7 @@ from __future__ import annotations
 from pathlib import Path
 
 SYS = dict(read=0, write=1, open=2, close=3, stat=4, fstat=5, lstat=6,
+           mmap=9,
            poll=7, lseek=8, pread64=17, pwrite64=18,
            access=21, getcwd=79, chdir=80, fchdir=81, rename=82, mkdir=83,
            rmdir=84, creat=85, unlink=87, readlink=89, truncate=76,
@@ -67,6 +68,10 @@ VFD_CONDITIONAL = ["ioctl", "fcntl", "dup",
                    "fstat", "lseek", "getdents64", "ftruncate", "fsync",
                    "fdatasync", "fchdir", "pread64", "pwrite64"]
 
+#: syscalls trapped only when arg4 is a virtual fd (mmap's fd slot;
+#: MAP_ANONYMOUS passes fd=-1 which wraps past the negative-fd carve-out)
+FD4_CONDITIONAL = ["mmap"]
+
 
 def build(audit: bool = False):
     """audit=True emits the reality-boundary variant: syscalls are
@@ -105,6 +110,8 @@ def build(audit: bool = False):
     prog.append(("JEQ", SYS["writev"], "WRITE", None))
     for name in VFD_CONDITIONAL:
         prog.append(("JEQ", SYS[name], "VFDCHK", None))
+    for name in FD4_CONDITIONAL:
+        prog.append(("JEQ", SYS[name], "VFD4CHK", None))
     for name in UNCONDITIONAL:
         prog.append(("JEQ", SYS[name], "TRAP", None))
     # recvmsg on a worker IPC channel runs natively (SCM_RIGHTS receive of
@@ -137,6 +144,8 @@ def build(audit: bool = False):
     labels["CLOSECHK"] = len(prog)
     prog += [("LD_A0",), ("JGE", "IPCLOW", None, "VFDTAIL"),
              ("JGE", "IPCEND", "VFDTAIL", "TRAP")]
+    labels["VFD4CHK"] = len(prog)
+    prog += [("LD_A4",), ("JGE", 0, "VFDTAIL", "VFDTAIL")]
     labels["VFDCHK"] = len(prog)
     # negative fds (AT_FDCWD = -100 as a newfstatat dirfd) wrap to huge
     # unsigned values: let them through natively
@@ -163,7 +172,7 @@ def build(audit: bool = False):
     for i, ins in enumerate(prog):
         k = ins[0]
         simple = {"LD_ARCH": "LD(BPF_ARCHF),", "LD_NR": "LD(BPF_NR),",
-                  "LD_A0": "LD(BPF_ARG0),",
+                  "LD_A0": "LD(BPF_ARG0),", "LD_A4": "LD(BPF_ARG4),",
                   "LD_IPLO": "LD(BPF_IPLO),", "LD_IPHI": "LD(BPF_IPHI),",
                   "LD_A2LO": "LD(BPF_ARG2LO),", "LD_A2HI": "LD(BPF_ARG2HI),",
                   "RET_TRAP": "RET(SECCOMP_RET_TRAP),",
