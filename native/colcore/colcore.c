@@ -1459,7 +1459,18 @@ fail:
 }
 
 /* ---- GossipState type -------------------------------------------------- */
+static int Gossip_traverse(GossipState *g, visitproc visit, void *arg) {
+  Py_VISIT(g->core);
+  return 0;
+}
+
+static int Gossip_clear_gc(GossipState *g) {
+  Py_CLEAR(g->core);
+  return 0;
+}
+
 static void Gossip_dealloc(GossipState *g) {
+  PyObject_GC_UnTrack(g);
   Py_XDECREF(g->core);
   Py_XDECREF(g->port_obj);
   free(g->peers);
@@ -1511,8 +1522,11 @@ static PyTypeObject GossipState_Type = {
     PyVarObject_HEAD_INIT(NULL, 0).tp_name = "_colcore.GossipState",
     .tp_basicsize = sizeof(GossipState),
     .tp_dealloc = (destructor)Gossip_dealloc,
-    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC,
+    .tp_traverse = (traverseproc)Gossip_traverse,
+    .tp_clear = (inquiry)Gossip_clear_gc,
     .tp_methods = Gossip_methods,
+    .tp_free = PyObject_GC_Del,
     .tp_doc = "C half of the gossip model (models/gossip.py delegates)",
 };
 
@@ -1537,7 +1551,58 @@ static PyObject *grab_array(PyObject *o, const char *name, int typenum,
   return v;
 }
 
+static int Core_traverse(CoreObject *c, visitproc visit, void *arg) {
+  Py_VISIT(c->hosts);
+  Py_VISIT(c->pending);
+  Py_VISIT(c->deferred);
+  Py_VISIT(c->active);
+  Py_VISIT(c->storebatch_cls);
+  for (int i = 0; i < 9; i++) Py_VISIT(c->arrs[i]);
+  if (c->hs) {
+    for (int64_t i = 0; i < c->H; i++) {
+      CHost *h = &c->hs[i];
+      Py_VISIT(h->id_obj);
+      Py_VISIT(h->heap);
+      Py_VISIT(h->live);
+      Py_VISIT(h->cancelled);
+      Py_VISIT(h->egress);
+      Py_VISIT(h->conns);
+      Py_VISIT(h->listeners);
+      for (int j = 0; j < h->nports; j++) Py_VISIT(h->gs[j]);
+      for (int j = 0; j < h->inbox_n; j++) Py_VISIT(h->inbox[j].row);
+    }
+  }
+  return 0;
+}
+
+static int Core_clear_gc(CoreObject *c) {
+  Py_CLEAR(c->hosts);
+  Py_CLEAR(c->pending);
+  Py_CLEAR(c->deferred);
+  Py_CLEAR(c->active);
+  Py_CLEAR(c->storebatch_cls);
+  for (int i = 0; i < 9; i++) Py_CLEAR(c->arrs[i]);
+  if (c->hs) {
+    for (int64_t i = 0; i < c->H; i++) {
+      CHost *h = &c->hs[i];
+      Py_CLEAR(h->id_obj);
+      Py_CLEAR(h->heap);
+      Py_CLEAR(h->live);
+      Py_CLEAR(h->cancelled);
+      Py_CLEAR(h->egress);
+      Py_CLEAR(h->conns);
+      Py_CLEAR(h->listeners);
+      for (int j = 0; j < h->nports; j++) Py_CLEAR(h->gs[j]);
+      h->nports = 0;
+      for (int j = 0; j < h->inbox_n; j++) Py_CLEAR(h->inbox[j].row);
+      h->inbox_n = 0;
+    }
+  }
+  return 0;
+}
+
 static void Core_dealloc(CoreObject *c) {
+  PyObject_GC_UnTrack(c);
   if (c->hs) {
     for (int64_t i = 0; i < c->H; i++) {
       CHost *h = &c->hs[i];
@@ -1717,7 +1782,7 @@ static PyObject *Core_gossip_register(CoreObject *c, PyObject *args) {
   PyObject *pl = PySequence_List(peers);
   if (!pl) return NULL;
   Py_ssize_t np = PyList_GET_SIZE(pl);
-  GossipState *g = PyObject_New(GossipState, &GossipState_Type);
+  GossipState *g = PyObject_GC_New(GossipState, &GossipState_Type);
   if (!g) { Py_DECREF(pl); return NULL; }
   Py_INCREF(c);
   g->core = c;
@@ -1742,6 +1807,7 @@ static PyObject *Core_gossip_register(CoreObject *c, PyObject *args) {
   Py_INCREF(g);
   h->gs[h->nports] = g;
   h->nports++;
+  PyObject_GC_Track((PyObject *)g);
   return (PyObject *)g;
 }
 
@@ -1807,10 +1873,15 @@ static PyTypeObject Core_Type = {
     PyVarObject_HEAD_INIT(NULL, 0).tp_name = "_colcore.Core",
     .tp_basicsize = sizeof(CoreObject),
     .tp_dealloc = (destructor)Core_dealloc,
-    .tp_flags = Py_TPFLAGS_DEFAULT,
+    /* GC-tracked so the endpoint->core->conns->endpoint and
+     * gossip-state cycles collect at simulation teardown (review r4) */
+    .tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC,
+    .tp_traverse = (traverseproc)Core_traverse,
+    .tp_clear = (inquiry)Core_clear_gc,
     .tp_methods = Core_methods,
     .tp_init = (initproc)Core_init,
     .tp_new = PyType_GenericNew,
+    .tp_free = PyObject_GC_Del,
     .tp_doc = "C engine for one ColumnarPlane (plane._c)",
 };
 
@@ -2372,6 +2443,7 @@ static int CEp_traverse(CEp *e, visitproc visit, void *arg) {
 }
 
 static int CEp_clear_gc(CEp *e) {
+  Py_CLEAR(e->core);
   Py_CLEAR(e->on_connected);
   Py_CLEAR(e->on_data);
   Py_CLEAR(e->on_drain);
@@ -2794,10 +2866,6 @@ static int dispatch_stream(CoreObject *c, CHost *h, int hid, IRow *ir,
     if (!ep) return 0; /* connection gone: no-op */
     if (Py_TYPE(ep) == &CEp_Type)
       return cs_oracle_loss((CEp *)ep, *now, ir->seq, ir->nbytes, pl);
-    if (*now_dirty) {
-      if (attr_set_i64(h->host, S_now, *now) < 0) return -1;
-      *now_dirty = 0;
-    }
     PyObject *r = PyObject_CallMethod(ep, "on_loss_notify", "(LLO)",
                                       (long long)ir->seq,
                                       (long long)ir->nbytes,

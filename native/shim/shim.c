@@ -234,7 +234,12 @@ static int shim_recv_fd(int64_t *val_out) {
 }
 
 /* the child re-reads its real pid from /proc (getpid is trapped and would
- * return the VIRTUAL pid; the cached parent ids are wrong post-fork) */
+ * return the VIRTUAL pid; the cached parent ids are wrong post-fork).
+ * raw3 rides the gadget, so this open is IP-allowed native and reads the
+ * REAL kernel /proc — the worker's synthesized /proc/self/stat (vpid)
+ * only serves guest-issued opens. The inline-asm no-gadget fallback
+ * would trap here; that degraded mode predates the file surface and is
+ * not used when the gadget page maps (it always does in practice). */
 static void shim_refresh_real_ids(void) {
   int fd = (int)raw3(SYS_open, (long)"/proc/self/stat", 0, 0);
   if (fd < 0) return;

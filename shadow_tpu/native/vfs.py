@@ -133,7 +133,12 @@ class HostVFS:
         """A minimal /proc consistent with the virtual identity: guests
         reading cpu/memory/self topology see the same deterministic
         machine on every host (VERDICT r3 item #8). Anything not listed
-        stays native by policy (resolve() returns None)."""
+        stays native by policy (resolve() returns None).
+
+        The SHIM's own /proc/self/stat read (shim_refresh_real_ids, which
+        must learn REAL ids after fork/exec) rides the syscall gadget —
+        IP-allowed by the filter, so it never traps and never reaches
+        this synthesis; only guest-issued opens land here."""
         proc = self.proc
         if path == "/proc/cpuinfo":
             blocks = []
