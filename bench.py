@@ -272,8 +272,10 @@ def _tor_doc(n_relays: int, n_clients: int, stop_s: int,
     gml = random_gml(rng, g, min_lat_ms=10, max_lat_ms=120, max_loss=0.002,
                      bw_choices=("50 Mbit", "100 Mbit", "1 Gbit"))
     hosts = {}
+    n_exits = max(1, n_relays // 8)  # exits FIRST: clients draw their last hop
+    # from relay0..relay{n_exits-1} (TorClient's n_exits arg)
     for i in range(n_relays):
-        cls = "TorExit" if i % 8 == 0 else "TorRelay"
+        cls = "TorExit" if i < n_exits else "TorRelay"
         hosts[f"relay{i}"] = {
             "network_node_id": int(rng.integers(0, g)),
             "processes": [{"path": f"pyapp:shadow_tpu.models.tor:{cls}",
@@ -290,7 +292,7 @@ def _tor_doc(n_relays: int, n_clients: int, stop_s: int,
             "network_node_id": i, "quantity": q,
             "processes": [{"path": "pyapp:shadow_tpu.models.tor:TorClient",
                            "args": [str(n_relays), "9001", f"web{i % 20}",
-                                    "80", fetch, "1"],
+                                    "80", fetch, "1", str(n_exits)],
                            "start_time": f"{2000 + i * 150} ms"}]}
     return {"general": {"stop_time": f"{stop_s}s", "seed": 6},
             "network": {"graph": {"type": "gml", "inline": gml}},

@@ -308,8 +308,9 @@ typedef struct {
   PyObject *active;  /* owned set (controller._active), via bind_active */
   PyObject *storebatch_cls; /* owned: colplane.StoreBatch */
   /* numpy arrays: owned refs + raw pointers */
-  PyObject *arrs[9];
+  PyObject *arrs[11];
   int64_t *tokens_down, *tbase, *tokens, *debt, *rate_up, *cap_up, *lat;
+  int64_t *rate_down, *cap_down;
   uint32_t *thresh;
   int32_t *hostnode;
   int64_t H, G;
@@ -1325,6 +1326,26 @@ static int inbox_push(CHost *h, int64_t t, int64_t key, PyObject *row,
   return 0;
 }
 
+static PyObject *Core_refill_ingress(CoreObject *c, PyObject *args) {
+  /* start_of_round ingress refill (fluid.clamped_refill twin): tokens
+   * gain min(bytes_over(rate, dt), cap) clamped at cap — pure int64,
+   * one pass, no per-round numpy temporaries */
+  long long dt_ll;
+  if (!PyArg_ParseTuple(args, "L", &dt_ll)) return NULL;
+  int64_t dt = dt_ll;
+  int64_t q = dt / NS_PER_SEC, r = dt % NS_PER_SEC;
+  for (int64_t i = 0; i < c->H; i++) {
+    int64_t rate = c->rate_down[i], cap = c->cap_down[i];
+    int64_t add = rate * q +
+                  (int64_t)((uint64_t)rate * (uint64_t)r /
+                            (uint64_t)NS_PER_SEC);
+    if (add > cap) add = cap;
+    int64_t room = cap - c->tokens_down[i];
+    c->tokens_down[i] += add < room ? add : room;
+  }
+  Py_RETURN_NONE;
+}
+
 static PyObject *Core_extract(CoreObject *c, PyObject *args) {
   long long re_ll;
   if (!PyArg_ParseTuple(args, "L", &re_ll)) return NULL;
@@ -1557,7 +1578,7 @@ static int Core_traverse(CoreObject *c, visitproc visit, void *arg) {
   Py_VISIT(c->deferred);
   Py_VISIT(c->active);
   Py_VISIT(c->storebatch_cls);
-  for (int i = 0; i < 9; i++) Py_VISIT(c->arrs[i]);
+  for (int i = 0; i < 11; i++) Py_VISIT(c->arrs[i]);
   if (c->hs) {
     for (int64_t i = 0; i < c->H; i++) {
       CHost *h = &c->hs[i];
@@ -1581,7 +1602,7 @@ static int Core_clear_gc(CoreObject *c) {
   Py_CLEAR(c->deferred);
   Py_CLEAR(c->active);
   Py_CLEAR(c->storebatch_cls);
-  for (int i = 0; i < 9; i++) Py_CLEAR(c->arrs[i]);
+  for (int i = 0; i < 11; i++) Py_CLEAR(c->arrs[i]);
   if (c->hs) {
     for (int64_t i = 0; i < c->H; i++) {
       CHost *h = &c->hs[i];
@@ -1625,7 +1646,7 @@ static void Core_dealloc(CoreObject *c) {
   Py_XDECREF(c->deferred);
   Py_XDECREF(c->active);
   Py_XDECREF(c->storebatch_cls);
-  for (int i = 0; i < 9; i++) Py_XDECREF(c->arrs[i]);
+  for (int i = 0; i < 11; i++) Py_XDECREF(c->arrs[i]);
   Py_TYPE(c)->tp_free((PyObject *)c);
 }
 
@@ -1677,6 +1698,10 @@ static int Core_init(CoreObject *c, PyObject *args, PyObject *kwds) {
               c->thresh = p; ok = c->arrs[7] != 0; }
     if (ok) { c->arrs[8] = grab_array(params, "host_node", NPY_INT32, &p);
               c->hostnode = p; ok = c->arrs[8] != 0; }
+    if (ok) { c->arrs[9] = grab_array(params, "rate_down", NPY_INT64, &p);
+              c->rate_down = p; ok = c->arrs[9] != 0; }
+    if (ok) { c->arrs[10] = grab_array(params, "cap_down", NPY_INT64, &p);
+              c->cap_down = p; ok = c->arrs[10] != 0; }
     if (ok) {
       c->G = PyArray_DIM((PyArrayObject *)c->arrs[6], 0);
       int64_t seed;
@@ -1849,12 +1874,15 @@ static PyObject *Core_fold_counters(CoreObject *c, PyObject *noarg) {
 }
 
 static PyObject *Core_make_endpoint(CoreObject *c, PyObject *args);
+static PyObject *Core_relay_new(CoreObject *c, PyObject *args);
 
 static PyMethodDef Core_methods[] = {
     {"barrier", (PyCFunction)Core_barrier, METH_VARARGS,
      "end_of_round twin: (round_start, round_end) -> None | device batch"},
     {"extract", (PyCFunction)Core_extract, METH_VARARGS,
      "_extract twin: (round_end)"},
+    {"refill_ingress", (PyCFunction)Core_refill_ingress, METH_VARARGS,
+     "clamped ingress token refill for an elapsed window: (dt_ns)"},
     {"run_round", (PyCFunction)Core_run_round, METH_VARARGS,
      "per-round host loop over the bound active set: (round_end) -> n"},
     {"store_resolved", (PyCFunction)Core_store_resolved, METH_VARARGS,
@@ -1867,6 +1895,8 @@ static PyMethodDef Core_methods[] = {
      "flush outstanding per-host counter deltas into host attributes"},
     {"make_endpoint", (PyCFunction)Core_make_endpoint, METH_VARARGS,
      "(hid, lport, rhost, rport, initiator, sbuf, rbuf) -> Endpoint"},
+    {"relay_new", (PyCFunction)Core_relay_new, METH_VARARGS,
+     "(hid, on_ctrl) -> Relay (C tor-relay data path)"},
     {NULL, NULL, 0, NULL}};
 
 static PyTypeObject Core_Type = {
@@ -1968,9 +1998,18 @@ typedef struct CEp {
   PyObject *app_unread; /* callable or NULL */
   /* app callbacks (None when unset) */
   PyObject *on_connected, *on_data, *on_drain, *on_close, *on_error;
+  /* C fast sink: when set, data delivery / drain / close route to the
+   * C relay machinery instead of the Python callbacks */
+  struct CRelayConn *sink;
 } CEp;
 
 static PyTypeObject CEp_Type; /* fwd */
+struct CRelayConn;
+static int relay_feed(struct CRelayConn *rc, int64_t now, int64_t nbytes,
+                      PyObject *payload);
+static int relay_pump_conn(struct CRelayConn *rc, int64_t now);
+static int relay_drain(struct CRelayConn *rc, int64_t now);
+static int relay_conn_closed(struct CRelayConn *rc);
 
 static CHost *cep_h(CEp *e) { return &e->core->hs[e->hid]; }
 
@@ -2179,7 +2218,9 @@ static int cs_on_ack(CEp *e, int64_t now, int64_t cum_ack, int64_t wnd) {
       int64_t add = MSS_C * newly / e->cwnd;
       e->cwnd += add > 1 ? add : 1; /* AIMD */
     }
-    if (e->on_drain && e->on_drain != Py_None &&
+    if (e->sink && e->buffered < e->send_buffer) {
+      if (relay_drain(e->sink, now) < 0) return -1;
+    } else if (e->on_drain && e->on_drain != Py_None &&
         e->buffered < e->send_buffer) {
       PyObject *room = PyLong_FromLongLong(e->send_buffer - e->buffered);
       if (!room) return -1;
@@ -2197,6 +2238,8 @@ static int cr_deliver(CEp *e, int64_t now, int64_t nbytes,
                       PyObject *payload) {
   e->rcv_nxt += nbytes;
   e->bytes_received += nbytes;
+  if (e->sink)
+    return relay_feed(e->sink, now, nbytes, payload);
   if (e->on_data && e->on_data != Py_None) {
     PyObject *nb = PyLong_FromLongLong(nbytes);
     PyObject *tn = PyLong_FromLongLong(now);
@@ -2279,6 +2322,9 @@ static int ce_drop(CEp *e) {
 
 static int ce_reset(CEp *e, const char *reason) {
   cep_h(e)->d_resets++;
+  /* sink conns mirror the Python twin exactly: _reset only fires
+   * on_error (unset for relay conns) and drops the endpoint — the
+   * relay's conn/table entries go stale, with NO teardown cascade */
   PyObject *err_cb = e->on_error;
   Py_XINCREF(err_cb);
   if (ce_drop(e) < 0) { Py_XDECREF(err_cb); return -1; }
@@ -2308,6 +2354,7 @@ static int ce_enter_time_wait(CEp *e, int64_t now) {
   PyObject *tmp = NULL;
   if (cep_schedule(e, 2 * e->rto_ns, S_drop_fire, &tmp) < 0) return -1;
   Py_XDECREF(tmp);
+  if (was_open && e->sink) return relay_conn_closed(e->sink);
   if (was_open && e->on_close && e->on_close != Py_None) {
     PyObject *tn = PyLong_FromLongLong(now);
     if (!tn) return -1;
@@ -2410,6 +2457,10 @@ static int ce_handle_fields(CEp *e, int64_t now, int k, int64_t nbytes,
   if (k == TK_FINACK) {
     if (e->state == ST_FIN_SENT) {
       if (ce_cancel_ctl(e) < 0) return -1;
+      if (e->sink) {
+        if (ce_drop(e) < 0) return -1;
+        return relay_conn_closed(e->sink);
+      }
       PyObject *close_cb = e->on_close;
       Py_XINCREF(close_cb);
       if (ce_drop(e) < 0) { Py_XDECREF(close_cb); return -1; }
@@ -2476,36 +2527,46 @@ static void CEp_dealloc(CEp *e) {
   Py_TYPE(e)->tp_free((PyObject *)e);
 }
 
+/* app-side send (StreamEndpoint.send + StreamSender.queue twin).
+   payload may be NULL (counted bytes); off slices a byte payload's tail.
+   Returns accepted count, or -1 on error. */
+static int64_t cs_send(CEp *e, int64_t now, int64_t nbytes,
+                       PyObject *payload, int64_t off) {
+  if (payload) nbytes = PyBytes_GET_SIZE(payload) - off;
+  if (nbytes <= 0 || e->state == ST_CLOSING || e->state == ST_FIN_SENT ||
+      e->state == ST_TIME_WAIT)
+    return 0;
+  int64_t room = e->send_buffer - e->buffered;
+  int64_t accept = nbytes < room ? nbytes : (room > 0 ? room : 0);
+  if (accept <= 0) return 0;
+  SQEnt *q = ring_push(&e->sendbuf);
+  if (!q) return -1;
+  q->nbytes = accept;
+  if (payload) {
+    q->payload = PySequence_GetSlice(payload, off, off + accept);
+    if (!q->payload) { e->sendbuf.count--; return -1; }
+  } else {
+    q->payload = NULL;
+  }
+  e->buffered += accept;
+  if (cs_pump(e, now) < 0) return -1;
+  cep_h(e)->d_sbytes_q += accept;
+  return accept;
+}
+
 static PyObject *CEp_send(CEp *e, PyObject *args, PyObject *kw) {
   static char *kws[] = {"nbytes", "payload", NULL};
   long long nbytes = 0;
   PyObject *payload = Py_None;
   if (!PyArg_ParseTupleAndKeywords(args, kw, "|LO", kws, &nbytes, &payload))
     return NULL;
-  if (payload != Py_None) nbytes = PyBytes_GET_SIZE(payload);
-  if (nbytes <= 0 || e->state == ST_CLOSING || e->state == ST_FIN_SENT ||
-      e->state == ST_TIME_WAIT)
-    return PyLong_FromLong(0);
-  /* StreamSender.queue */
-  int64_t room = e->send_buffer - e->buffered;
-  int64_t accept = nbytes < room ? nbytes : (room > 0 ? room : 0);
-  if (accept <= 0) return PyLong_FromLong(0);
-  SQEnt *q = ring_push(&e->sendbuf);
-  if (!q) return NULL;
-  q->nbytes = accept;
-  if (payload != Py_None) {
-    q->payload = PySequence_GetSlice(payload, 0, accept);
-    if (!q->payload) { e->sendbuf.count--; return NULL; }
-  } else {
-    q->payload = NULL;
-  }
-  e->buffered += accept;
   int err;
   int64_t now = cep_now(e, &err);
   if (err) return NULL;
-  if (cs_pump(e, now) < 0) return NULL;
-  cep_h(e)->d_sbytes_q += accept;
-  return PyLong_FromLongLong(accept);
+  int64_t accepted = cs_send(e, now, nbytes,
+                             payload == Py_None ? NULL : payload, 0);
+  if (accepted < 0) return NULL;
+  return PyLong_FromLongLong(accepted);
 }
 
 static PyObject *CEp_close(CEp *e, PyObject *noarg) {
@@ -2964,6 +3025,579 @@ static int dispatch_stream(CoreObject *c, CHost *h, int hid, IRow *ir,
   return 0;
 }
 
+/* ======================================================================
+ * C tor-relay data path (models/tor.py TorRelay twin for the hot flow).
+ *
+ * The plain relay's steady state is: framed cells arrive on one C
+ * endpoint, the circuit table maps (conn, circ) to the spliced peer,
+ * and the cell/body forwards out the peer connection — all here, zero
+ * Python. The control plane stays Python via one callback (on_ctrl):
+ * EXTEND at the circuit head (opens a new connection through the
+ * simulated network) — everything else (CREATE/CREATED handshakes,
+ * forwarding, teardown cascades, DATA headers, counted bodies) is C.
+ * Exits (TorExit) keep the full Python model. Bit-identity with the
+ * Python relay is asserted by the colcore A/B suite on the tor config.
+ * ====================================================================== */
+
+#define TCELL_HDR 12
+#define TC_CREATE 0
+#define TC_CREATED 1
+#define TC_EXTEND 2
+#define TC_EXTENDED 3
+#define TC_DATA 6
+
+typedef struct { PyObject *payload; int64_t a; } PendEnt;
+/* payload != NULL: byte frame, a = send offset; NULL: counted, a = left */
+
+typedef struct CRelayConn {
+  struct CRelayObj *relay; /* borrowed: relay owns conns[] */
+  CEp *ep;                 /* owned */
+  int cid;
+  int close_after_drain;
+  /* re-entrancy guard: a teardown cascade reached from inside this
+   * conn's own feed/pump (peer_fin unwinding) must not free it while
+   * its frames are on the C stack */
+  int busy, dead;
+  /* FrameReader state */
+  char *buf;
+  int64_t buf_len, buf_cap;
+  int64_t body_left;
+  int body_circ;
+  Ring pend; /* PendEnt */
+} CRelayConn;
+
+typedef struct CRelayObj {
+  PyObject_HEAD
+  CoreObject *core; /* owned */
+  int hid;
+  PyObject *on_ctrl; /* Python callable(cid, ctype, circ, payload) */
+  CRelayConn **conns;
+  int nconns, conns_cap;
+  /* circuit table: open addressing, key = (cid<<32)|circ (+1 so 0 =
+   * empty), val = (ncid<<32)|ncirc */
+  uint64_t *tk, *tv, *ts; /* keys, values, insertion seq (dict order) */
+  uint64_t tseq;
+  int tcap, tcount;
+  int next_circ;
+  int64_t cells_relayed, bytes_relayed;
+} CRelayObj;
+
+static PyTypeObject CRelay_Type;
+
+/* -- circuit table ------------------------------------------------------- */
+static int rtab_grow(CRelayObj *r) {
+  int ncap = r->tcap ? r->tcap * 2 : 64;
+  uint64_t *nk = calloc((size_t)ncap, sizeof(uint64_t));
+  uint64_t *nv = malloc((size_t)ncap * sizeof(uint64_t));
+  uint64_t *ns = malloc((size_t)ncap * sizeof(uint64_t));
+  if (!nk || !nv || !ns) {
+    free(nk); free(nv); free(ns);
+    PyErr_NoMemory();
+    return -1;
+  }
+  for (int i = 0; i < r->tcap; i++) {
+    if (!r->tk[i]) continue;
+    uint64_t h = r->tk[i] * 0x9E3779B97F4A7C15ULL;
+    int j = (int)(h & (uint64_t)(ncap - 1));
+    while (nk[j]) j = (j + 1) & (ncap - 1);
+    nk[j] = r->tk[i];
+    nv[j] = r->tv[i];
+    ns[j] = r->ts[i];
+  }
+  free(r->tk);
+  free(r->tv);
+  free(r->ts);
+  r->tk = nk;
+  r->tv = nv;
+  r->ts = ns;
+  r->tcap = ncap;
+  return 0;
+}
+
+static inline uint64_t rtab_key(int cid, int circ) {
+  return (((uint64_t)(uint32_t)cid << 32) | (uint32_t)circ) + 1;
+}
+
+static int rtab_get(CRelayObj *r, int cid, int circ, int *ncid, int *ncirc) {
+  if (!r->tcap) return 0;
+  uint64_t k = rtab_key(cid, circ);
+  uint64_t h = k * 0x9E3779B97F4A7C15ULL;
+  int i = (int)(h & (uint64_t)(r->tcap - 1));
+  while (r->tk[i]) {
+    if (r->tk[i] == k) {
+      *ncid = (int)(r->tv[i] >> 32);
+      *ncirc = (int)(uint32_t)r->tv[i];
+      return 1;
+    }
+    i = (i + 1) & (r->tcap - 1);
+  }
+  return 0;
+}
+
+static int rtab_put(CRelayObj *r, int cid, int circ, int ncid, int ncirc) {
+  if (r->tcount * 10 >= r->tcap * 7 && rtab_grow(r) < 0) return -1;
+  uint64_t k = rtab_key(cid, circ);
+  uint64_t h = k * 0x9E3779B97F4A7C15ULL;
+  int i = (int)(h & (uint64_t)(r->tcap - 1));
+  while (r->tk[i] && r->tk[i] != k) i = (i + 1) & (r->tcap - 1);
+  if (!r->tk[i]) {
+    r->tcount++;
+    r->ts[i] = r->tseq++; /* dict insertion order; overwrite keeps it */
+  }
+  r->tk[i] = k;
+  r->tv[i] = ((uint64_t)(uint32_t)ncid << 32) | (uint32_t)ncirc;
+  return 0;
+}
+
+/* -- frames -------------------------------------------------------------- */
+static PyObject *build_cell(int ctype, int circ, const char *payload,
+                            Py_ssize_t plen) {
+  PyObject *b = PyBytes_FromStringAndSize(NULL, TCELL_HDR + plen);
+  if (!b) return NULL;
+  char *p = PyBytes_AS_STRING(b);
+  memset(p, 0, TCELL_HDR);
+  p[0] = (char)ctype;
+  p[1] = (char)((circ >> 8) & 0xFF);
+  p[2] = (char)(circ & 0xFF);
+  p[3] = (char)(((uint64_t)plen >> 8) & 0xFF);
+  p[4] = (char)((uint64_t)plen & 0xFF);
+  if (plen) memcpy(p + TCELL_HDR, payload, (size_t)plen);
+  return b;
+}
+
+/* -- pending write queue (models/tor.py _Conn twin) ---------------------- */
+/* graceful-close idiom shared by CEp_close and the relay teardown
+ * paths: no-op unless the endpoint is in an open state */
+static int cep_begin_close(CEp *e, int64_t now) {
+  if (e->state == ST_CLOSED || e->state == ST_CLOSING ||
+      e->state == ST_FIN_SENT || e->state == ST_TIME_WAIT)
+    return 0;
+  e->state = ST_CLOSING;
+  return cs_pump(e, now);
+}
+
+static void relay_free_conn(CRelayConn *rc) {
+  free(rc->buf);
+  for (int i = 0; i < rc->pend.count; i++)
+    Py_XDECREF(((PendEnt *)ring_at(&rc->pend, i))->payload);
+  free(rc->pend.buf);
+  Py_DECREF(rc->ep);
+  free(rc);
+}
+
+/* detach a conn from its relay; honors the busy guard (an on-stack
+ * feed/pump frame frees it at exit instead) */
+static void relay_detach_conn(CRelayObj *r, int cid) {
+  CRelayConn *rc = r->conns[cid];
+  if (!rc) return;
+  r->conns[cid] = NULL;
+  rc->ep->sink = NULL;
+  if (rc->busy)
+    rc->dead = 1;
+  else
+    relay_free_conn(rc);
+}
+
+static int relay_pump_conn(CRelayConn *rc, int64_t now) {
+  int rcod = 0;
+  rc->busy++;
+  while (!rc->dead && rc->pend.count) {
+    PendEnt *head = ring_at(&rc->pend, 0);
+    int64_t sent;
+    int done;
+    if (head->payload) {
+      sent = cs_send(rc->ep, now, 0, head->payload, head->a);
+      if (sent < 0) { rcod = -1; goto out; }
+      if (rc->dead) goto out; /* send unwound into our own teardown */
+      head->a += sent;
+      done = head->a >= PyBytes_GET_SIZE(head->payload);
+    } else {
+      sent = cs_send(rc->ep, now, head->a, NULL, 0);
+      if (sent < 0) { rcod = -1; goto out; }
+      if (rc->dead) goto out;
+      head->a -= sent;
+      done = head->a <= 0;
+    }
+    if (done) {
+      Py_XDECREF(head->payload);
+      ring_popleft(&rc->pend);
+    }
+    if (sent == 0 && !done) goto out; /* buffer full; drain resumes */
+  }
+out:
+  if (--rc->busy == 0 && rc->dead) { relay_free_conn(rc); return rcod; }
+  return rcod;
+}
+
+/* the DRAIN entry point (ack freed buffer space): pump, then act on a
+ * deferred close — the Python twin's close_when_drained only closes
+ * from a subsequent on_drain, never from the write path's own pump */
+static int relay_drain(CRelayConn *rc, int64_t now) {
+  rc->busy++;
+  int rcod = relay_pump_conn(rc, now);
+  if (rcod == 0 && !rc->dead && rc->close_after_drain &&
+      rc->pend.count == 0) {
+    rc->close_after_drain = 0;
+    rcod = cep_begin_close(rc->ep, now);
+  }
+  if (--rc->busy == 0 && rc->dead) relay_free_conn(rc);
+  return rcod;
+}
+
+static int relay_write(CRelayConn *rc, int64_t now, PyObject *frame) {
+  PendEnt *p = ring_push(&rc->pend);
+  if (!p) { Py_DECREF(frame); return -1; }
+  p->payload = frame; /* steals */
+  p->a = 0;
+  return relay_pump_conn(rc, now);
+}
+
+static int relay_write_counted(CRelayConn *rc, int64_t now, int64_t n) {
+  PendEnt *p = ring_push(&rc->pend);
+  if (!p) return -1;
+  p->payload = NULL;
+  p->a = n;
+  return relay_pump_conn(rc, now);
+}
+
+/* -- the hot feed (FrameReader + TorRelay forwarding twin) --------------- */
+static int relay_on_cell(CRelayObj *r, CRelayConn *rc, int64_t now,
+                         int ctype, int circ, const char *pl,
+                         Py_ssize_t plen) {
+  if (ctype == TC_CREATE) {
+    PyObject *f = build_cell(TC_CREATED, circ, NULL, 0);
+    if (!f) return -1;
+    return relay_write(rc, now, f);
+  }
+  int ncid, ncirc;
+  int hit = rtab_get(r, rc->cid, circ, &ncid, &ncirc);
+  if (ctype == TC_CREATED) {
+    if (hit && r->conns[ncid]) {
+      PyObject *f = build_cell(TC_EXTENDED, ncirc, NULL, 0);
+      if (!f) return -1;
+      return relay_write(r->conns[ncid], now, f);
+    }
+    return 0;
+  }
+  if (ctype == TC_EXTEND && !hit) {
+    /* circuit head: the control plane (connect to the named relay)
+     * belongs to Python */
+    PyObject *plo = PyBytes_FromStringAndSize(pl, plen);
+    if (!plo) return -1;
+    PyObject *res = PyObject_CallFunction(r->on_ctrl, "(iiiO)", rc->cid,
+                                          ctype, circ, plo);
+    Py_DECREF(plo);
+    if (!res) return -1;
+    Py_DECREF(res);
+    return 0;
+  }
+  if (!hit || !r->conns[ncid]) return 0; /* no route: drop (twin) */
+  r->cells_relayed++;
+  PyObject *f = build_cell(ctype, ncirc, pl, plen);
+  if (!f) return -1;
+  return relay_write(r->conns[ncid], now, f);
+}
+
+static int relay_feed(CRelayConn *rc, int64_t now, int64_t nbytes,
+                      PyObject *payload) {
+  CRelayObj *r = rc->relay;
+  if (rc->body_left > 0 && (!payload || payload == Py_None)) {
+    int64_t take = nbytes < rc->body_left ? nbytes : rc->body_left;
+    rc->body_left -= take;
+    int ncid, ncirc;
+    if (rtab_get(r, rc->cid, rc->body_circ, &ncid, &ncirc) &&
+        r->conns[ncid]) {
+      r->bytes_relayed += take;
+      rc->busy++;
+      int w = relay_write_counted(r->conns[ncid], now, take);
+      if (--rc->busy == 0 && rc->dead) { relay_free_conn(rc); return w; }
+      if (w < 0 || rc->dead) return w;
+    }
+    if (nbytes > take) {
+      PyErr_SetString(PyExc_ValueError,
+                      "framing error: stray counted bytes");
+      return -1;
+    }
+    return 0;
+  }
+  if (!payload || payload == Py_None) {
+    PyErr_SetString(PyExc_ValueError,
+                    "framing error: counted bytes outside DATA body");
+    return -1;
+  }
+  char *pb;
+  Py_ssize_t pn;
+  if (PyBytes_AsStringAndSize(payload, &pb, &pn) < 0) return -1;
+  if (rc->buf_len + pn > rc->buf_cap) {
+    int64_t ncap = rc->buf_cap ? rc->buf_cap * 2 : 256;
+    while (ncap < rc->buf_len + pn) ncap *= 2;
+    char *nb = realloc(rc->buf, (size_t)ncap);
+    if (!nb) { PyErr_NoMemory(); return -1; }
+    rc->buf = nb;
+    rc->buf_cap = ncap;
+  }
+  memcpy(rc->buf + rc->buf_len, pb, (size_t)pn);
+  rc->buf_len += pn;
+  int64_t off = 0;
+  int rcod = 0;
+  rc->busy++;
+  while (!rc->dead && rc->buf_len - off >= TCELL_HDR) {
+    unsigned char *b = (unsigned char *)rc->buf + off;
+    int ctype = b[0];
+    int circ = ((int)b[1] << 8) | b[2];
+    int64_t ln = ((int64_t)b[3] << 8) | b[4];
+    if (ctype == TC_DATA) {
+      off += TCELL_HDR;
+      rc->body_left = ln;
+      rc->body_circ = circ;
+      /* forward the DATA header along the circuit (on_data_hdr twin) */
+      int ncid, ncirc;
+      if (rtab_get(r, rc->cid, circ, &ncid, &ncirc) && r->conns[ncid]) {
+        PyObject *f = build_cell(TC_DATA, ncirc, NULL, 0);
+        if (!f) { rcod = -1; break; }
+        char *fp = PyBytes_AS_STRING(f);
+        fp[3] = (char)((ln >> 8) & 0xFF);
+        fp[4] = (char)(ln & 0xFF);
+        if (relay_write(r->conns[ncid], now, f) < 0) { rcod = -1; break; }
+      }
+      break; /* counted body follows in subsequent chunks */
+    }
+    if (rc->buf_len - off < TCELL_HDR + ln) break;
+    if (relay_on_cell(r, rc, now, ctype, circ,
+                      rc->buf + off + TCELL_HDR, (Py_ssize_t)ln) < 0) {
+      rcod = -1;
+      break;
+    }
+    off += TCELL_HDR + ln;
+  }
+  if (!rc->dead && off) {
+    memmove(rc->buf, rc->buf + off, (size_t)(rc->buf_len - off));
+    rc->buf_len -= off;
+  }
+  if (--rc->busy == 0 && rc->dead) relay_free_conn(rc);
+  return rcod;
+}
+
+/* -- teardown cascade (relay _on_conn_close twin) ------------------------ */
+static int cmp_peer_seq(const void *a, const void *b) {
+  uint64_t x = ((const uint64_t *)a)[0], y = ((const uint64_t *)b)[0];
+  return (x > y) - (x < y);
+}
+
+static int relay_conn_closed(CRelayConn *rc) {
+  CRelayObj *r = rc->relay;
+  int cid = rc->cid;
+  if (rc->dead) return 0; /* already torn down (re-entrant cascade) */
+  r->conns[cid] = NULL;
+  rc->ep->sink = NULL;
+  /* spliced peers whose KEY side is this cid, in table insertion order
+   * (the Python twin iterates its dict) */
+  int rcod = 0;
+  uint64_t(*peers)[2] =
+      malloc(sizeof(uint64_t[2]) * (size_t)(r->tcount ? r->tcount : 1));
+  int npeers = 0;
+  if (!peers) { PyErr_NoMemory(); rcod = -1; }
+  for (int i = 0; rcod == 0 && i < r->tcap; i++) {
+    if (!r->tk[i]) continue;
+    int kcid = (int)((r->tk[i] - 1) >> 32);
+    if (kcid == cid) {
+      peers[npeers][0] = r->ts[i];
+      peers[npeers][1] = r->tv[i] >> 32;
+      npeers++;
+    }
+  }
+  if (npeers > 1)
+    qsort(peers, (size_t)npeers, sizeof(uint64_t[2]), cmp_peer_seq);
+  /* rebuild the table without entries touching cid, preserving each
+   * surviving entry's insertion seq (Python dict-comprehension rebuild
+   * keeps the original order) */
+  if (rcod == 0) {
+    uint64_t *ok = r->tk, *ov = r->tv, *os = r->ts;
+    int ocap = r->tcap;
+    r->tk = NULL;
+    r->tv = NULL;
+    r->ts = NULL;
+    r->tcap = r->tcount = 0;
+    for (int i = 0; i < ocap && rcod == 0; i++) {
+      if (!ok[i]) continue;
+      int kcid = (int)((ok[i] - 1) >> 32);
+      int vcid = (int)(ov[i] >> 32);
+      if (kcid == cid || vcid == cid) continue;
+      if (rtab_put(r, kcid, (int)(uint32_t)(ok[i] - 1), vcid,
+                   (int)(uint32_t)ov[i]) < 0) {
+        rcod = -1;
+        break;
+      }
+      /* restore the original seq (rtab_put assigned a fresh one) */
+      uint64_t k = ok[i];
+      uint64_t h = k * 0x9E3779B97F4A7C15ULL;
+      int j = (int)(h & (uint64_t)(r->tcap - 1));
+      while (r->tk[j] != k) j = (j + 1) & (r->tcap - 1);
+      r->ts[j] = os[i];
+    }
+    free(ok);
+    free(ov);
+    free(os);
+  }
+  int err = 0;
+  int64_t now = 0;
+  if (rcod == 0) {
+    now = cep_now(rc->ep, &err);
+    if (err) rcod = -1;
+  }
+  for (int i = 0; i < npeers && rcod == 0; i++) {
+    CRelayConn *pc = r->conns[(int)peers[i][1]];
+    if (!pc) continue;
+    if (pc->pend.count) {
+      pc->close_after_drain = 1;
+    } else {
+      rcod = cep_begin_close(pc->ep, now);
+    }
+  }
+  free(peers);
+  /* free now unless feed/pump frames for this conn are on the stack */
+  if (rc->busy)
+    rc->dead = 1;
+  else
+    relay_free_conn(rc);
+  return rcod;
+}
+
+/* -- the Python-visible CRelay type -------------------------------------- */
+static void CRelay_dealloc(CRelayObj *r) {
+  PyObject_GC_UnTrack(r);
+  for (int i = 0; i < r->nconns; i++) relay_detach_conn(r, i);
+  free(r->conns);
+  free(r->tk);
+  free(r->tv);
+  free(r->ts);
+  Py_XDECREF(r->core);
+  Py_XDECREF(r->on_ctrl);
+  Py_TYPE(r)->tp_free((PyObject *)r);
+}
+
+static int CRelay_traverse(CRelayObj *r, visitproc visit, void *arg) {
+  Py_VISIT(r->core);
+  Py_VISIT(r->on_ctrl);
+  for (int i = 0; i < r->nconns; i++)
+    if (r->conns[i]) Py_VISIT(r->conns[i]->ep);
+  return 0;
+}
+
+static int CRelay_clear_gc(CRelayObj *r) {
+  Py_CLEAR(r->core);
+  Py_CLEAR(r->on_ctrl);
+  /* a GC collection can run from allocations INSIDE relay_feed (e.g.
+   * build_cell), so the busy guard matters here exactly as on the
+   * runtime teardown paths (review r4) */
+  for (int i = 0; i < r->nconns; i++) relay_detach_conn(r, i);
+  return 0;
+}
+
+static PyObject *CRelay_add_conn(CRelayObj *r, PyObject *arg) {
+  if (Py_TYPE(arg) != &CEp_Type) {
+    PyErr_SetString(PyExc_TypeError, "add_conn expects a C endpoint");
+    return NULL;
+  }
+  if (r->nconns == r->conns_cap) {
+    int ncap = r->conns_cap ? r->conns_cap * 2 : 16;
+    CRelayConn **nc = realloc(r->conns,
+                              (size_t)ncap * sizeof(CRelayConn *));
+    if (!nc) return PyErr_NoMemory();
+    r->conns = nc;
+    r->conns_cap = ncap;
+  }
+  CRelayConn *rc = calloc(1, sizeof(CRelayConn));
+  if (!rc) return PyErr_NoMemory();
+  rc->relay = r;
+  Py_INCREF(arg);
+  rc->ep = (CEp *)arg;
+  rc->cid = r->nconns;
+  rc->pend.esz = sizeof(PendEnt);
+  r->conns[r->nconns++] = rc;
+  ((CEp *)arg)->sink = rc;
+  return PyLong_FromLong(rc->cid);
+}
+
+static PyObject *CRelay_splice(CRelayObj *r, PyObject *args) {
+  int cid, circ, ncid;
+  if (!PyArg_ParseTuple(args, "iii", &cid, &circ, &ncid)) return NULL;
+  int ncirc = r->next_circ++;
+  if (rtab_put(r, cid, circ, ncid, ncirc) < 0) return NULL;
+  if (rtab_put(r, ncid, ncirc, cid, circ) < 0) return NULL;
+  return PyLong_FromLong(ncirc);
+}
+
+static PyObject *CRelay_write_cell(CRelayObj *r, PyObject *args) {
+  int cid, ctype, circ;
+  Py_buffer pl = {0};
+  if (!PyArg_ParseTuple(args, "iii|y*", &cid, &ctype, &circ, &pl))
+    return NULL;
+  if (cid < 0 || cid >= r->nconns || !r->conns[cid]) {
+    PyBuffer_Release(&pl);
+    Py_RETURN_NONE; /* connection already gone */
+  }
+  PyObject *f = build_cell(ctype, circ, pl.buf, pl.len);
+  PyBuffer_Release(&pl);
+  if (!f) return NULL;
+  int err;
+  int64_t now = cep_now(r->conns[cid]->ep, &err);
+  if (err) return NULL;
+  if (relay_write(r->conns[cid], now, f) < 0) return NULL;
+  Py_RETURN_NONE;
+}
+
+static PyObject *CRelay_stats(CRelayObj *r, PyObject *noarg) {
+  (void)noarg;
+  return Py_BuildValue("(LL)", (long long)r->cells_relayed,
+                       (long long)r->bytes_relayed);
+}
+
+static PyMethodDef CRelay_methods[] = {
+    {"add_conn", (PyCFunction)CRelay_add_conn, METH_O,
+     "attach a C endpoint as a relay connection -> cid"},
+    {"splice", (PyCFunction)CRelay_splice, METH_VARARGS,
+     "(cid, circ, ncid) -> ncirc; inserts both circuit-table directions"},
+    {"write_cell", (PyCFunction)CRelay_write_cell, METH_VARARGS,
+     "(cid, ctype, circ[, payload]) -> queue a control cell"},
+    {"stats", (PyCFunction)CRelay_stats, METH_NOARGS,
+     "-> (cells_relayed, bytes_relayed)"},
+    {NULL, NULL, 0, NULL}};
+
+static PyTypeObject CRelay_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0).tp_name = "_colcore.Relay",
+    .tp_basicsize = sizeof(CRelayObj),
+    .tp_dealloc = (destructor)CRelay_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC,
+    .tp_traverse = (traverseproc)CRelay_traverse,
+    .tp_clear = (inquiry)CRelay_clear_gc,
+    .tp_methods = CRelay_methods,
+    .tp_free = PyObject_GC_Del,
+    .tp_doc = "C tor-relay data path (models/tor.py delegates)",
+};
+
+static PyObject *Core_relay_new(CoreObject *c, PyObject *args) {
+  long long hid;
+  PyObject *on_ctrl;
+  if (!PyArg_ParseTuple(args, "LO", &hid, &on_ctrl)) return NULL;
+  if (hid < 0 || hid >= c->H) {
+    PyErr_SetString(PyExc_ValueError, "host id out of range");
+    return NULL;
+  }
+  CRelayObj *r = PyObject_GC_New(CRelayObj, &CRelay_Type);
+  if (!r) return NULL;
+  memset(((char *)r) + sizeof(PyObject), 0,
+         sizeof(CRelayObj) - sizeof(PyObject));
+  Py_INCREF(c);
+  r->core = c;
+  r->hid = (int)hid;
+  Py_INCREF(on_ctrl);
+  r->on_ctrl = on_ctrl;
+  r->next_circ = 1;
+  PyObject_GC_Track((PyObject *)r);
+  return (PyObject *)r;
+}
+
 /* ---- module ------------------------------------------------------------ */
 static PyObject *mod_unit_dropped(PyObject *self, PyObject *args) {
   (void)self;
@@ -3049,7 +3683,7 @@ PyMODINIT_FUNC PyInit__colcore(void) {
   O_kind_loss = PyLong_FromLong(KIND_LOSS_C);
   if (!O_zero || !O_one || !O_kind_dgram || !O_kind_loss) return NULL;
   if (PyType_Ready(&Core_Type) < 0 || PyType_Ready(&GossipState_Type) < 0
-      || PyType_Ready(&CEp_Type) < 0)
+      || PyType_Ready(&CEp_Type) < 0 || PyType_Ready(&CRelay_Type) < 0)
     return NULL;
   PyObject *m = PyModule_Create(&colcore_module);
   if (!m) return NULL;
@@ -3059,5 +3693,7 @@ PyMODINIT_FUNC PyInit__colcore(void) {
   PyModule_AddObject(m, "GossipState", (PyObject *)&GossipState_Type);
   Py_INCREF(&CEp_Type);
   PyModule_AddObject(m, "Endpoint", (PyObject *)&CEp_Type);
+  Py_INCREF(&CRelay_Type);
+  PyModule_AddObject(m, "Relay", (PyObject *)&CRelay_Type);
   return m;
 }

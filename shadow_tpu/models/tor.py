@@ -150,9 +150,38 @@ class TorRelay:
         self._next_circ = 1
         self.cells_relayed = 0
         self.bytes_relayed = 0
+        self._c = None  # C relay data path (plain relays on the C engine)
 
     def start(self):
+        # plain relays delegate the hot path (frame parsing, circuit
+        # forwarding, pending-write pumping) to the C engine; the control
+        # plane (EXTEND connects, teardown observation) stays here.
+        # TorExit overrides enough of the cell handling that it keeps the
+        # full Python model (type check, not isinstance: subclasses opt
+        # out by existing).
+        host = getattr(self.api, "_host", None)
+        core = getattr(getattr(host, "colplane", None), "_c", None)
+        if (type(self) is TorRelay and core is not None
+                and host.pcap is None):
+            self._c = core.relay_new(host.id, self._on_ctrl)
+            self.api.listen(self.port, self._on_accept_c)
+            return
         self.api.listen(self.port, self._on_accept)
+
+    # -- C data-path control plane -----------------------------------------
+    def _on_accept_c(self, ep, now):
+        self._c.add_conn(ep)
+
+    def _on_ctrl(self, cid, ctype, circ, payload):
+        # only EXTEND-at-circuit-head reaches Python: open the next-hop
+        # connection and splice a fresh segment into the C table
+        target, port = payload.decode().rsplit(":", 1)
+        ep = self.api.connect(target, int(port))
+        ncid = self._c.add_conn(ep)
+        ncirc = self._c.splice(cid, circ, ncid)
+        ep.on_connected = lambda now: self._c.write_cell(
+            ncid, CREATE, ncirc)
+        ep.connect()
 
     def _new_conn(self, ep):
         cid = self._next_conn
@@ -229,8 +258,10 @@ class TorRelay:
         self.conns[nxt[0]].write_counted(nbytes)
 
     def stop(self):
-        self.api.log(f"relay done: cells={self.cells_relayed} "
-                     f"bytes={self.bytes_relayed}")
+        cells, nbytes = self.cells_relayed, self.bytes_relayed
+        if self._c is not None:
+            cells, nbytes = self._c.stats()
+        self.api.log(f"relay done: cells={cells} bytes={nbytes}")
 
 
 class TorExit(TorRelay):
@@ -271,11 +302,16 @@ class TorExit(TorRelay):
 
 
 class TorClient:
-    """args: [n_relays, relay_port, server, server_port, size, circuits]
+    """args: [n_relays, relay_port, server, server_port, size, circuits,
+              n_exits?]
 
-    Relay hosts must be named relay0..relayN-1 with the exit being the
-    relay chosen last; the client telescopes guard->middle->exit, BEGINs a
-    fetch of `size` bytes from `server`, and records completion.
+    Relay hosts must be named relay0..relayN-1; when ``n_exits`` is given,
+    relay0..relay{n_exits-1} are the exit-capable population (the
+    generator places TorExit processes there) and the circuit's LAST hop
+    is drawn from it — a plain TorRelay cannot terminate a BEGIN. Without
+    it, every relay is assumed exit-capable (the pre-round-4 behavior).
+    The client telescopes guard->middle->exit, BEGINs a fetch of `size`
+    bytes from `server`, and records completion.
     """
 
     def __init__(self, api, args, env):
@@ -286,6 +322,7 @@ class TorClient:
         self.server_port = int(args[3])
         self.size = parse_size(args[4]) if len(args) > 4 else 100_000
         self.n_circuits = int(args[5]) if len(args) > 5 else 1
+        self.n_exits = int(args[6]) if len(args) > 6 else self.n_relays
         self.completed = 0
         self.failed = 0
         self.completion_times = []
@@ -295,13 +332,18 @@ class TorClient:
             self._build_circuit()
 
     def _pick_hops(self):
+        # exit drawn FIRST (from the exit-capable population), then
+        # guard/middle from the full relay range excluding it — the
+        # other order can spin forever when every exit is already a
+        # guard/middle (e.g. n_exits=1)
         rng = self.api.rng
-        hops = []
+        exit_r = int(rng.integers(0, self.n_exits))
+        hops = [exit_r]
         while len(hops) < 3:
             r = int(rng.integers(0, self.n_relays))
             if r not in hops:
                 hops.append(r)
-        return [f"relay{r}" for r in hops]
+        return [f"relay{hops[1]}", f"relay{hops[2]}", f"relay{exit_r}"]
 
     def _build_circuit(self):
         api = self.api

@@ -200,10 +200,13 @@ class ColumnarPlane(DeviceRoutedPlane):
         dt = round_start - self._last_refill
         self._last_refill = round_start
         if dt > 0:
-            p = self.params
-            add_down = clamped_refill(p.rate_down, p.cap_down, dt)
-            self.tokens_down += np.minimum(add_down,
-                                           p.cap_down - self.tokens_down)
+            if self._c is not None:
+                self._c.refill_ingress(dt)
+            else:
+                p = self.params
+                add_down = clamped_refill(p.rate_down, p.cap_down, dt)
+                self.tokens_down += np.minimum(
+                    add_down, p.cap_down - self.tokens_down)
         if self._deferred:
             t0 = _walltime.perf_counter()
             self._drain_deferred(round_start)
