@@ -89,7 +89,7 @@ class ExperimentalOptions:
     unit_mtus: int = 10
     # tpu_batch knobs (ours):
     tpu_max_batch: int = 65536  # max units per device draw dispatch
-    tpu_device_floor: int = 0  # min batch to engage the device; 0 = calibrate
+    tpu_device_floor: int = 0  # min batch to engage device; 0=calibrate, -1=off
     tpu_mesh_shards: int = 0  # 0 = all local devices
     #: tpu_mesh: min due-window units for the collective program; smaller
     #: windows take the bit-identical numpy twin

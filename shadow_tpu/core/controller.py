@@ -351,10 +351,17 @@ class Controller:
             if h.pcap is not None:
                 h.pcap.close()
         self.log.flush()
+        import resource
+
         return {
             "sim_seconds": sim_sec,
             "wall_seconds": self.wall_seconds,
             "sim_sec_per_wall_sec": rate,
+            # linux ru_maxrss is KiB; the process-wide high-water mark, so
+            # it is only per-run when each run owns its process (bench.py's
+            # subprocess rows rely on this)
+            "max_rss_mb": round(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1),
             "rounds": self.rounds,
             "events": self.events,
             "units_sent": self.engine.units_sent,

@@ -325,7 +325,9 @@ class TorClient:
         self.n_exits = int(args[6]) if len(args) > 6 else self.n_relays
         self.completed = 0
         self.failed = 0
-        self.completion_times = []
+        self.attempted = 0
+        self.completion_times = []  # ns, fetch end-to-end (incl. build)
+        self.build_times = []  # ns, telescoping (CREATE..last EXTENDED)
 
     def start(self):
         for _ in range(self.n_circuits):
@@ -348,6 +350,7 @@ class TorClient:
     def _build_circuit(self):
         api = self.api
         hops = self._pick_hops()
+        self.attempted += 1
         t0 = api.now
         circ = 1
         got = {"n": 0}
@@ -368,6 +371,8 @@ class TorClient:
         def on_cell(ctype, c, payload):
             if ctype in (CREATED, EXTENDED):
                 state["stage"] += 1
+                if state["stage"] == 3:  # telescoping done; BEGIN follows
+                    self.build_times.append(api.now - t0)
                 advance()
             elif ctype == END:
                 elapsed = api.now - t0

@@ -69,7 +69,14 @@ class DeviceRoutedPlane:
         elif backend == "tpu":
             n_shards = int(getattr(tpu_options, "tpu_mesh_shards", 0) or 0)
             floor = int(getattr(tpu_options, "tpu_device_floor", 0) or 0)
-            if floor > 0:
+            if floor < 0:
+                # device draws disabled: the numpy twin serves every batch.
+                # This is the published ablation row (BENCH device_off) —
+                # results are bit-identical by construction, only wall
+                # time moves, so the knob isolates the device's
+                # contribution to any config's headline rate.
+                pass
+            elif floor > 0:
                 from shadow_tpu.ops.propagate import DeviceDrawPlane
 
                 self.device = DeviceDrawPlane(params.seed, self.max_batch,

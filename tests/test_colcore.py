@@ -28,7 +28,8 @@ subprocess.run(
     check=True, capture_output=True)
 from shadow_tpu.native import _colcore  # noqa: E402
 
-VOLATILE = ("wall_seconds", "sim_sec_per_wall_sec", "phase_wall")
+VOLATILE = ("wall_seconds", "sim_sec_per_wall_sec", "phase_wall",
+            "max_rss_mb")
 
 
 def _run(tmp_path, cfg_path, colcore, overrides=None, policy="tpu_batch"):

@@ -16,7 +16,7 @@ export JAX_PLATFORMS=cpu
 run() {
     python -m shadow_tpu examples/tgen_1k.yaml --quiet --json-summary \
         --data-directory "/tmp/ci-det-$1" \
-        | python -c 'import json,sys; d=json.load(sys.stdin); d.pop("wall_seconds"); d.pop("sim_sec_per_wall_sec"); d.pop("phase_wall", None); print(json.dumps(d,sort_keys=True))' \
+        | python -c 'import json,sys; d=json.load(sys.stdin); d.pop("wall_seconds"); d.pop("sim_sec_per_wall_sec"); d.pop("phase_wall", None); d.pop("max_rss_mb", None); print(json.dumps(d,sort_keys=True))' \
         > "/tmp/ci-det-$1.json"
     (cd "/tmp/ci-det-$1" && find hosts -type f | sort | xargs sha256sum) \
         > "/tmp/ci-det-$1.hashes"
