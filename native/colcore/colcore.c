@@ -2001,6 +2001,16 @@ typedef struct CEp {
   /* C fast sink: when set, data delivery / drain / close route to the
    * C relay machinery instead of the Python callbacks */
   struct CRelayConn *sink;
+  /* C tgen app (models/tgen.py twin; same opt-in style as the relay
+   * sink): 0 = none, 1 = server (parse the 8-byte ASCII request, push
+   * counted bytes), 2 = client (count received bytes, fire tgen_cb at
+   * completion). Replaces the per-row Python on_data / per-ack
+   * on_drain closures with C state; only once-per-transfer events
+   * (request seen, transfer complete) call back into Python. */
+  int tgen_mode;
+  int64_t tgen_pending; /* server: bytes left to push; client: received */
+  int64_t tgen_want;    /* client: completion target */
+  PyObject *tgen_cb;    /* server: on_request(want); client: cb(now, got) */
 } CEp;
 
 static PyTypeObject CEp_Type; /* fwd */
@@ -2096,6 +2106,9 @@ static int cep_cancel_timer(CEp *e, PyObject **slot) {
 }
 
 static int cs_pump(CEp *e, int64_t now);
+static int64_t cs_send(CEp *e, int64_t now, int64_t nbytes,
+                       PyObject *payload, int64_t off);
+static int tgen_push(CEp *e, int64_t now);
 static int ce_sender_drained(CEp *e, int64_t now);
 static int ce_drop(CEp *e);
 static int ce_reset(CEp *e, const char *reason);
@@ -2220,6 +2233,10 @@ static int cs_on_ack(CEp *e, int64_t now, int64_t cum_ack, int64_t wnd) {
     }
     if (e->sink && e->buffered < e->send_buffer) {
       if (relay_drain(e->sink, now) < 0) return -1;
+    } else if (e->tgen_mode == 1 && e->buffered < e->send_buffer) {
+      /* TGenServer on_drain twin (push is a no-op with no backlog,
+       * exactly like the Python closure called with room) */
+      if (tgen_push(e, now) < 0) return -1;
     } else if (e->on_drain && e->on_drain != Py_None &&
         e->buffered < e->send_buffer) {
       PyObject *room = PyLong_FromLongLong(e->send_buffer - e->buffered);
@@ -2233,11 +2250,87 @@ static int cs_on_ack(CEp *e, int64_t now, int64_t cum_ack, int64_t wnd) {
   return cs_pump(e, now);
 }
 
+/* ---- C tgen app (models/tgen.py twin) ---------------------------------- */
+static int tgen_push(CEp *e, int64_t now) {
+  /* TGenServer.push twin: offer the whole backlog; the bounded send
+   * buffer accepts what fits, the rest streams out via the ack drain */
+  if (e->tgen_pending <= 0) return 0;
+  int64_t acc = cs_send(e, now, e->tgen_pending, NULL, 0);
+  if (acc < 0) return -1;
+  e->tgen_pending -= acc;
+  return 0;
+}
+
+static int is_strip_ws(char ch) {
+  /* str.strip()'s ASCII whitespace set (NUL etc. are NOT whitespace) */
+  return ch == ' ' || ch == '\t' || ch == '\n' || ch == '\r' ||
+         ch == '\v' || ch == '\f';
+}
+
+static int tgen_srv_data(CEp *e, int64_t now, PyObject *payload) {
+  /* int(payload.decode().strip()) twin: want = 0 on any parse failure,
+   * each payload chunk parsed independently (like the Python closure).
+   * Exact for ASCII decimal (incl. sign and between-digit underscores)
+   * up to int64; declared divergences from Python int(): values beyond
+   * int64 and non-ASCII Unicode digits parse as 0 here (a request for
+   * >9.2e18 counted bytes is non-physical, and the wire format —
+   * str(size).encode() — never produces either). */
+  int64_t want = 0;
+  if (payload && PyBytes_Check(payload)) {
+    const char *s = PyBytes_AS_STRING(payload);
+    Py_ssize_t i = 0, j = PyBytes_GET_SIZE(payload);
+    while (i < j && is_strip_ws(s[i])) i++;
+    while (j > i && is_strip_ws(s[j - 1])) j--;
+    Py_ssize_t k = i;
+    int neg = 0, last_digit = 0, ok = k < j;
+    if (k < j && (s[k] == '+' || s[k] == '-')) { neg = s[k] == '-'; k++; }
+    if (k >= j) ok = 0;
+    for (; ok && k < j; k++) {
+      if (s[k] == '_') {
+        /* Python: underscores only BETWEEN digits */
+        if (!last_digit || k + 1 >= j) ok = 0;
+        last_digit = 0;
+        continue;
+      }
+      if (s[k] < '0' || s[k] > '9') { ok = 0; break; }
+      if (want > (INT64_MAX - (s[k] - '0')) / 10) { ok = 0; break; }
+      want = want * 10 + (s[k] - '0');
+      last_digit = 1;
+    }
+    if (!ok || !last_digit) want = 0;
+    else if (neg) want = -want;
+  }
+  if (want <= 0) return 0;
+  if (e->tgen_cb && e->tgen_cb != Py_None) {
+    PyObject *w = PyLong_FromLongLong(want);
+    if (!w) return -1;
+    PyObject *r = PyObject_CallOneArg(e->tgen_cb, w);
+    Py_DECREF(w);
+    if (!r) return -1;
+    Py_DECREF(r);
+  }
+  e->tgen_pending += want;
+  return tgen_push(e, now);
+}
+
 /* ---- receiver (StreamReceiver twin) ------------------------------------ */
 static int cr_deliver(CEp *e, int64_t now, int64_t nbytes,
                       PyObject *payload) {
   e->rcv_nxt += nbytes;
   e->bytes_received += nbytes;
+  if (e->tgen_mode == 2) {
+    e->tgen_pending += nbytes;
+    if (e->tgen_pending >= e->tgen_want && e->tgen_cb &&
+        e->tgen_cb != Py_None) {
+      PyObject *r = PyObject_CallFunction(e->tgen_cb, "LL", (long long)now,
+                                          (long long)e->tgen_pending);
+      if (!r) return -1;
+      Py_DECREF(r);
+    }
+    return 0;
+  }
+  if (e->tgen_mode == 1)
+    return tgen_srv_data(e, now, payload);
   if (e->sink)
     return relay_feed(e->sink, now, nbytes, payload);
   if (e->on_data && e->on_data != Py_None) {
@@ -2490,6 +2583,7 @@ static int CEp_traverse(CEp *e, visitproc visit, void *arg) {
   Py_VISIT(e->on_close);
   Py_VISIT(e->on_error);
   Py_VISIT(e->app_unread);
+  Py_VISIT(e->tgen_cb);
   return 0;
 }
 
@@ -2501,6 +2595,7 @@ static int CEp_clear_gc(CEp *e) {
   Py_CLEAR(e->on_close);
   Py_CLEAR(e->on_error);
   Py_CLEAR(e->app_unread);
+  Py_CLEAR(e->tgen_cb);
   return 0;
 }
 
@@ -2524,6 +2619,7 @@ static void CEp_dealloc(CEp *e) {
   Py_XDECREF(e->on_drain);
   Py_XDECREF(e->on_close);
   Py_XDECREF(e->on_error);
+  Py_XDECREF(e->tgen_cb);
   Py_TYPE(e)->tp_free((PyObject *)e);
 }
 
@@ -2706,6 +2802,28 @@ static PyObject *CEp_drop_fire(CEp *e, PyObject *noarg) {
   Py_RETURN_NONE;
 }
 
+/* opt-in surface for the models/tgen.py fast path; Python-plane
+ * endpoints don't have these attrs, so the model falls back to its
+ * closure implementation (getattr probe) */
+static PyObject *CEp_tgen_serve(CEp *e, PyObject *cb) {
+  e->tgen_mode = 1;
+  Py_INCREF(cb);
+  Py_XSETREF(e->tgen_cb, cb);
+  Py_RETURN_NONE;
+}
+
+static PyObject *CEp_tgen_client(CEp *e, PyObject *args) {
+  long long want;
+  PyObject *cb;
+  if (!PyArg_ParseTuple(args, "LO", &want, &cb)) return NULL;
+  e->tgen_mode = 2;
+  e->tgen_want = want;
+  e->tgen_pending = 0;
+  Py_INCREF(cb);
+  Py_XSETREF(e->tgen_cb, cb);
+  Py_RETURN_NONE;
+}
+
 static PyObject *CEp_get_self(CEp *e, void *u) {
   (void)u;
   Py_INCREF(e);
@@ -2836,6 +2954,10 @@ static PyMethodDef CEp_methods[] = {
     {"handle_fields", (PyCFunction)CEp_handle_fields, METH_VARARGS, NULL},
     {"on_loss_notify", (PyCFunction)CEp_on_loss_notify, METH_VARARGS, NULL},
     {"emit", (PyCFunction)CEp_emit, METH_VARARGS | METH_KEYWORDS, NULL},
+    {"tgen_serve", (PyCFunction)CEp_tgen_serve, METH_O,
+     "(on_request) -> None  enable the C TGenServer data path"},
+    {"tgen_client", (PyCFunction)CEp_tgen_client, METH_VARARGS,
+     "(want, on_complete) -> None  enable the C TGenClient data path"},
     {"_rto_fire", (PyCFunction)CEp_rto_fire, METH_NOARGS, NULL},
     {"_syn_fire", (PyCFunction)CEp_syn_fire, METH_NOARGS, NULL},
     {"_fin_fire", (PyCFunction)CEp_fin_fire, METH_NOARGS, NULL},

@@ -29,6 +29,17 @@ class TGenServer:
         self.api.log(f"tgen server listening on {self.port}")
 
     def _on_accept(self, conn, now):
+        serve = getattr(conn, "tgen_serve", None)
+        if serve is not None:
+            # C-engine endpoint: request parsing and counted-byte pushing
+            # run in native/colcore (exact twin of the closures below,
+            # which remain the Python-plane path); only the once-per-
+            # transfer request notification comes back here
+            def on_request(want):
+                self.transfers += 1
+
+            serve(on_request)
+            return
         pending = {"n": 0}
 
         def push(room=0):
@@ -95,31 +106,42 @@ class TGenClient:
     def _start_transfer(self, peer):
         t_start = self.api.now
         conn = self.api.connect(peer, self.port)
-        got = {"n": 0}
 
         def on_connected(now):
             conn.send(payload=str(self.size).encode().rjust(8))
 
-        def on_data(nbytes, payload, now):
-            got["n"] += nbytes
-            if got["n"] >= self.size:
-                elapsed = now - t_start
-                self.completion_times.append(elapsed)
-                self.completed += 1
-                self.api.log(
-                    f"transfer-complete peer={peer} bytes={got['n']} "
-                    f"elapsed_ms={elapsed // NS_PER_MS}"
-                )
-                conn.close()
-                self._next()
+        def finish(now, got):
+            elapsed = now - t_start
+            self.completion_times.append(elapsed)
+            self.completed += 1
+            self.api.log(
+                f"transfer-complete peer={peer} bytes={got} "
+                f"elapsed_ms={elapsed // NS_PER_MS}"
+            )
+            conn.close()
+            self._next()
 
         def on_error(msg):
             self.failed += 1
             self.api.log(f"transfer-failed peer={peer}: {msg}")
             self._next()
 
+        tgen_client = getattr(conn, "tgen_client", None)
+        if tgen_client is not None:
+            # C-engine endpoint: received-byte counting runs in
+            # native/colcore; finish fires once per transfer with the
+            # same (now, got) the Python closure would compute
+            tgen_client(self.size, finish)
+        else:
+            got = {"n": 0}
+
+            def on_data(nbytes, payload, now):
+                got["n"] += nbytes
+                if got["n"] >= self.size:
+                    finish(now, got["n"])
+
+            conn.on_data = on_data
         conn.on_connected = on_connected
-        conn.on_data = on_data
         conn.on_error = on_error
         conn.connect()
 
