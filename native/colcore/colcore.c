@@ -39,6 +39,7 @@
 #include <Python.h>
 #define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
 #include <numpy/arrayobject.h>
+#include <stddef.h>
 #include <stdint.h>
 #include <string.h>
 
@@ -255,26 +256,35 @@ static inline int64_t tup_i64(PyObject *t, Py_ssize_t i) {
 /* ---- per-host C state -------------------------------------------------- */
 typedef struct {
   int64_t t, key;
-  PyObject *row; /* owned ref while in the inbox */
-  /* dispatch fields pre-read at extraction (the tuple is cache-warm
-   * there; re-reading it at dispatch costs a cold pointer chase) */
+  PyObject *payload; /* owned ref while in the inbox (NULL = no payload) */
+  /* dispatch fields, fully packed (round 5: store rows no longer carry
+   * a Python tuple at all on the C path; the 13-tuple is materialized
+   * lazily only for Python-fallback dispatch / deferred parking) */
   int64_t nbytes, seq; /* stream dispatch: cum-ack / byte offset ride here */
   int32_t size, peer, bport, aport;
+  int32_t frag, nfrags;
   int16_t kind;
-  int16_t single_frag;
 } IRow;
 
 struct GossipState_s;
 
-/* packed per-row side-car record (StoreBatch.cdata); field meanings match
- * IRow's pre-read dispatch fields */
+/* packed per-row store record (CBatch.recs); field meanings match IRow */
 typedef struct {
   int64_t t, key;
   int64_t nbytes, seq;
   int32_t tgt, size, peer, bport, aport;
+  int32_t frag, nfrags;
   int16_t kind;
-  int16_t single_frag;
 } SRec;
+
+/* one egress row in a host's packed C egress buffer (the Host.emit_msg
+ * tuple of the Python plane, without the tuple) */
+typedef struct {
+  int64_t size, t_emit, nbytes, seq;
+  PyObject *payload; /* owned; NULL = None */
+  int32_t kind, dst, sport, dport, frag, nfrags;
+  uint8_t want_loss;
+} ERow;
 
 typedef struct {
   PyObject *host;      /* borrowed: Core->hosts list holds the ref */
@@ -293,6 +303,9 @@ typedef struct {
   /* C inbox (filled by extract, consumed by run_host) */
   IRow *inbox;
   int inbox_n, inbox_cap, inbox_last_slice, inbox_multi;
+  /* packed C egress buffer (emission-order; barrier consumes + clears) */
+  ERow *erow;
+  int erow_n, erow_cap;
   /* per-round counter deltas, flushed to host attrs after run_host */
   int64_t d_emitted, d_delivered, d_dgrams, d_dgrams_recv, d_events;
   /* stream-transport + routing counter deltas (host.counters keys) */
@@ -325,15 +338,19 @@ typedef struct {
   int brow_cap;
 } CoreObject;
 
-/* one barrier row during assembly */
+/* one barrier row during assembly (all fields packed; `payload` is an
+ * owned ref the barrier releases — or hands to the store — when done) */
 typedef struct BRow {
-  PyObject *row;   /* borrowed (host egress list holds it until we drop) */
+  PyObject *payload; /* owned during assembly; NULL = None */
   PyObject *src_obj; /* borrowed (CHost.id_obj) */
   int32_t src, dst;
   int64_t size, t_emit, depart, arrival, key;
+  int64_t nbytes, seq;
   uint64_t uid;
   uint32_t th;
   int32_t npk;
+  int32_t kind, sport, dport, frag, nfrags;
+  uint8_t want_loss;
   uint8_t drop;
 } BRow;
 
@@ -357,6 +374,83 @@ static int core_emit_dgram(CoreObject *c, CHost *h, int64_t now, int dst,
                            PyObject *payload);
 static int gossip_on_msg_c(CoreObject *c, CHost *h, GossipState *g,
                            int64_t now, PyObject *payload, int64_t src_host);
+
+/* ---- CBatch: a fully packed resolved store batch -----------------------
+ * The C-path replacement for colplane.StoreBatch (round 5): no Python
+ * row tuples — one SRec + one payload ref per row. Lives in
+ * plane.pending next to (and duck-typing) StoreBatch: head_time() and
+ * the consumed-prefix `pos` are the whole shared surface. Not
+ * GC-tracked: payloads are bytes/None by the emission contract
+ * (transport slices, gossip cells, model frames), which cannot form
+ * reference cycles. */
+typedef struct {
+  PyObject_HEAD
+  SRec *recs;
+  PyObject **pay; /* owned refs; NULL = None */
+  int n, pos;
+} CBatch;
+
+static void CBatch_dealloc(CBatch *b) {
+  for (int i = 0; i < b->n; i++) Py_XDECREF(b->pay[i]);
+  free(b->recs);
+  free(b->pay);
+  Py_TYPE(b)->tp_free((PyObject *)b);
+}
+
+static PyObject *CBatch_head_time(CBatch *b, PyObject *noarg) {
+  (void)noarg;
+  return PyLong_FromLongLong(b->pos < b->n ? b->recs[b->pos].t : T_NEVER_C);
+}
+
+static PyMethodDef CBatch_methods[] = {
+    {"head_time", (PyCFunction)CBatch_head_time, METH_NOARGS,
+     "earliest undelivered row time (StoreBatch.head_time twin)"},
+    {NULL, NULL, 0, NULL}};
+
+static PyMemberDef CBatch_members[] = {
+    {"pos", Py_T_INT, offsetof(CBatch, pos), 0, "consumed-prefix cursor"},
+    {"n", Py_T_INT, offsetof(CBatch, n), Py_READONLY, "row count"},
+    {NULL, 0, 0, 0, NULL}};
+
+static PyTypeObject CBatch_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0).tp_name = "_colcore.CBatch",
+    .tp_basicsize = sizeof(CBatch),
+    .tp_dealloc = (destructor)CBatch_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_methods = CBatch_methods,
+    .tp_members = CBatch_members,
+    .tp_doc = "packed resolved store batch (StoreBatch twin, no tuples)",
+};
+
+static CBatch *cbatch_new(int n) {
+  CBatch *b = PyObject_New(CBatch, &CBatch_Type);
+  if (!b) return NULL;
+  b->n = n;
+  b->pos = 0;
+  b->recs = malloc(sizeof(SRec) * (size_t)(n ? n : 1));
+  b->pay = calloc((size_t)(n ? n : 1), sizeof(PyObject *));
+  if (!b->recs || !b->pay) {
+    free(b->recs); free(b->pay);
+    b->recs = NULL; b->pay = NULL; b->n = 0;
+    Py_DECREF(b);
+    PyErr_NoMemory();
+    return NULL;
+  }
+  return b;
+}
+
+/* materialize the colplane 13-tuple for one packed row (Python-fallback
+ * dispatch, deferred parking, py_mode extraction) */
+static PyObject *srec_tuple(const SRec *s, PyObject *payload) {
+  PyObject *pl = payload ? payload : Py_None;
+  return Py_BuildValue("(LLiiiiiLLiiiO)", (long long)s->t,
+                       (long long)s->key, (int)s->tgt, (int)s->kind,
+                       (int)s->peer, (int)s->aport, (int)s->bport,
+                       (long long)s->nbytes, (long long)s->seq,
+                       (int)s->frag, (int)s->nfrags, (int)s->size, pl);
+}
+
+static PyObject *irow_tuple(const CHost *h, const IRow *r, int64_t tgt);
 
 /* ---- event-heap ops on EventQueue._heap (a PyList of 5-tuples) --------
  * Entries are (time, band, key, seq, task); (time, band, key, seq) is a
@@ -434,89 +528,115 @@ static int core_emit_dgram(CoreObject *c, CHost *h, int64_t now, int dst,
   TM1(3);
   return r;
 }
-static int core_emit_dgram_inner(CoreObject *c, CHost *h, int64_t now,
-                           int dst, GossipState *g, int dst_port,
-                           int64_t nbytes, PyObject *payload) {
-  PyObject *eg = h->egress;
-  if (PyList_GET_SIZE(eg) == 0) {
-    PyObject *em = PyObject_GetAttr(c->plane, S_emitters);
-    if (!em) return -1;
-    int r = PyList_Append(em, h->host);
-    Py_DECREF(em);
-    if (r < 0) return -1;
-  }
-  PyObject *t = PyTuple_New(12);
-  if (!t) return -1;
-  Py_INCREF(O_kind_dgram);
-  PyTuple_SET_ITEM(t, 0, O_kind_dgram);
-  PyTuple_SET_ITEM(t, 1, PyLong_FromLong(dst));
-  PyTuple_SET_ITEM(t, 2, PyLong_FromLongLong(nbytes + HEADER));
-  PyTuple_SET_ITEM(t, 3, PyLong_FromLongLong(now));
-  Py_INCREF(g->port_obj); /* source port == gossip port */
-  PyTuple_SET_ITEM(t, 4, g->port_obj);
-  PyTuple_SET_ITEM(t, 5, PyLong_FromLong(dst_port));
-  PyTuple_SET_ITEM(t, 6, PyLong_FromLongLong(nbytes));
-  PyTuple_SET_ITEM(t, 7, PyLong_FromLongLong(g->next_dgram++));
-  Py_INCREF(O_zero);
-  PyTuple_SET_ITEM(t, 8, O_zero);
-  Py_INCREF(O_one);
-  PyTuple_SET_ITEM(t, 9, O_one);
-  Py_INCREF(O_false);
-  PyTuple_SET_ITEM(t, 10, O_false);
-  Py_INCREF(payload);
-  PyTuple_SET_ITEM(t, 11, payload);
-  for (Py_ssize_t i = 1; i < 8; i++) {
-    if (i != 4 && !PyTuple_GET_ITEM(t, i)) {
-      Py_DECREF(t); return -1;
-    }
-  }
-  int r = PyList_Append(eg, t);
-  Py_DECREF(t);
-  if (r < 0) return -1;
-  h->d_emitted++;
-  h->d_dgrams++;
-  return 0;
-}
-
-/* generalized emission: the C twin of Host.emit_msg's columnar branch */
+/* packed emission core: append one ERow to the host's C egress buffer.
+ * Mirrors Host.emit_msg's columnar branch without the tuple; payload is
+ * INCREF'd (NULL/None accepted). */
 static int core_emit_fields(CoreObject *c, CHost *h, int64_t now,
                             int kind, int dst, int64_t size, int64_t nbytes,
                             PyObject *payload, int64_t seq, int sport,
                             int dport, int frag, int nfrags, int want_loss) {
-  PyObject *eg = h->egress;
-  if (PyList_GET_SIZE(eg) == 0) {
+  if (h->erow_n == 0 && PyList_GET_SIZE(h->egress) == 0) {
     PyObject *em = PyObject_GetAttr(c->plane, S_emitters);
     if (!em) return -1;
     int r = PyList_Append(em, h->host);
     Py_DECREF(em);
     if (r < 0) return -1;
   }
-  PyObject *t = PyTuple_New(12);
-  if (!t) return -1;
-  PyTuple_SET_ITEM(t, 0, PyLong_FromLong(kind));
-  PyTuple_SET_ITEM(t, 1, PyLong_FromLong(dst));
-  PyTuple_SET_ITEM(t, 2, PyLong_FromLongLong(size));
-  PyTuple_SET_ITEM(t, 3, PyLong_FromLongLong(now));
-  PyTuple_SET_ITEM(t, 4, PyLong_FromLong(sport));
-  PyTuple_SET_ITEM(t, 5, PyLong_FromLong(dport));
-  PyTuple_SET_ITEM(t, 6, PyLong_FromLongLong(nbytes));
-  PyTuple_SET_ITEM(t, 7, PyLong_FromLongLong(seq));
-  PyTuple_SET_ITEM(t, 8, PyLong_FromLong(frag));
-  PyTuple_SET_ITEM(t, 9, PyLong_FromLong(nfrags));
-  PyObject *wl = want_loss ? Py_True : Py_False;
-  Py_INCREF(wl);
-  PyTuple_SET_ITEM(t, 10, wl);
-  if (!payload) payload = Py_None;
-  Py_INCREF(payload);
-  PyTuple_SET_ITEM(t, 11, payload);
-  for (Py_ssize_t i = 0; i < 10; i++) {
-    if (!PyTuple_GET_ITEM(t, i)) { Py_DECREF(t); return -1; }
+  if (h->erow_n == h->erow_cap) {
+    int ncap = h->erow_cap ? h->erow_cap * 2 : 32;
+    ERow *nb = realloc(h->erow, sizeof(ERow) * (size_t)ncap);
+    if (!nb) { PyErr_NoMemory(); return -1; }
+    h->erow = nb;
+    h->erow_cap = ncap;
   }
-  int r = PyList_Append(eg, t);
-  Py_DECREF(t);
-  if (r < 0) return -1;
+  ERow *e = &h->erow[h->erow_n++];
+  e->kind = kind;
+  e->dst = dst;
+  e->size = size;
+  e->t_emit = now;
+  e->sport = sport;
+  e->dport = dport;
+  e->nbytes = nbytes;
+  e->seq = seq;
+  e->frag = frag;
+  e->nfrags = nfrags;
+  e->want_loss = (uint8_t)(want_loss != 0);
+  if (payload == Py_None) payload = NULL;
+  Py_XINCREF(payload);
+  e->payload = payload;
   h->d_emitted++;
   return 0;
+}
+
+static int core_emit_dgram_inner(CoreObject *c, CHost *h, int64_t now,
+                           int dst, GossipState *g, int dst_port,
+                           int64_t nbytes, PyObject *payload) {
+  if (core_emit_fields(c, h, now, KIND_DGRAM, dst, nbytes + HEADER, nbytes,
+                       payload, g->next_dgram++, g->port, dst_port, 0, 1,
+                       0) < 0)
+    return -1;
+  h->d_dgrams++;
+  return 0;
+}
+
+/* egress-format 12-tuple for one ERow (the Python barrier's expected row
+ * shape; used by materialize_egress and the device/mesh hand-off) */
+static PyObject *erow_tuple(const ERow *e) {
+  PyObject *pl = e->payload ? e->payload : Py_None;
+  PyObject *t = Py_BuildValue("(iiLLiiLLiiOO)", (int)e->kind, (int)e->dst,
+                              (long long)e->size, (long long)e->t_emit,
+                              (int)e->sport, (int)e->dport,
+                              (long long)e->nbytes, (long long)e->seq,
+                              (int)e->frag, (int)e->nfrags,
+                              e->want_loss ? Py_True : Py_False, pl);
+  return t;
+}
+
+/* flush every host's packed C egress into its Python egress_rows list
+ * (in emission order, ahead of any Python-appended rows? — there are
+ * none: with the C engine attached every emission routes through
+ * core_emit_fields, so egress_rows is empty until we fill it). Called
+ * by colplane before its Python barrier paths (fault_filter rounds,
+ * final flush) so those read the same rows they always did. */
+static PyObject *Core_materialize_egress(CoreObject *c, PyObject *noarg) {
+  (void)noarg;
+  for (int64_t i = 0; i < c->H; i++) {
+    CHost *h = &c->hs[i];
+    if (!h->erow_n) continue;
+    for (int j = 0; j < h->erow_n; j++) {
+      ERow *e = &h->erow[j];
+      PyObject *t = erow_tuple(e);
+      if (!t) return NULL;
+      int r = PyList_Append(h->egress, t);
+      Py_DECREF(t);
+      if (r < 0) return NULL;
+      Py_XDECREF(e->payload);
+      e->payload = NULL;
+    }
+    h->erow_n = 0;
+  }
+  Py_RETURN_NONE;
+}
+
+/* Python-callable packed emission (Host.emit_msg delegates here when the
+ * C engine is attached; pcap capture stays on the Python side) */
+static PyObject *Core_emit_row(CoreObject *c, PyObject *args) {
+  long long hid, size, t_emit, nbytes, seq;
+  int kind, dst, sport, dport, frag, nfrags, want_loss;
+  PyObject *payload;
+  if (!PyArg_ParseTuple(args, "LiiLLiiLLiipO", &hid, &kind, &dst, &size,
+                        &t_emit, &sport, &dport, &nbytes, &seq, &frag,
+                        &nfrags, &want_loss, &payload))
+    return NULL;
+  if (hid < 0 || hid >= c->H || dst < 0 || dst >= c->H) {
+    PyErr_SetString(PyExc_ValueError, "host id out of range");
+    return NULL;
+  }
+  if (core_emit_fields(c, &c->hs[hid], t_emit, kind, dst, size, nbytes,
+                       payload, seq, sport, dport, frag, nfrags,
+                       want_loss) < 0)
+    return NULL;
+  Py_RETURN_NONE;
 }
 
 /* ---- the gossip model's hot half (models/gossip.py twin) --------------- */
@@ -596,20 +716,24 @@ static int dispatch_c(CoreObject *c, CHost *h, int hid, IRow *ir,
   if (ir->kind <= TK_FINACK || ir->kind == KIND_LOSS_C)
     return dispatch_stream(c, h, hid, ir, now, now_dirty);
   GossipState *g = NULL;
-  if (ir->kind == KIND_DGRAM && ir->single_frag) {
+  if (ir->kind == KIND_DGRAM && ir->nfrags == 1) {
     for (int i = 0; i < h->nports; i++)
       if (h->port[i] == (int)ir->bport) { g = h->gs[i]; break; }
   }
   if (!g) {
     TM0(1);
-    /* Python fallback: streams, loss rows, unregistered ports, frags.
-     * host.dispatch_row does its own clock/bucket/deliver work. */
+    /* Python fallback: unregistered ports, frags. host.dispatch_row
+     * does its own clock/bucket/deliver work (13-tuple materialized
+     * here — the fallback is off the hot path by construction). */
     if (*now_dirty) {
       if (attr_set_i64(h->host, S_now, *now) < 0) return -1;
       *now_dirty = 0;
     }
+    PyObject *row = irow_tuple(h, ir, hid);
+    if (!row) return -1;
     PyObject *r = PyObject_CallMethodObjArgs(h->host, S_dispatch_row,
-                                             ir->row, NULL);
+                                             row, NULL);
+    Py_DECREF(row);
     if (!r) return -1;
     Py_DECREF(r);
     if (attr_i64(h->host, S_now, now) < 0) return -1;
@@ -625,7 +749,10 @@ static int dispatch_c(CoreObject *c, CHost *h, int hid, IRow *ir,
        * drained by colplane._drain_deferred) */
       PyObject *dl = PyObject_GetAttr(h->host, S_ingress_deferred_rows);
       if (!dl) return -1;
-      int r = PyList_Append(dl, ir->row);
+      PyObject *row = irow_tuple(h, ir, hid);
+      if (!row) { Py_DECREF(dl); return -1; }
+      int r = PyList_Append(dl, row);
+      Py_DECREF(row);
       Py_DECREF(dl);
       if (r < 0) return -1;
       if (PySet_Add(c->deferred, h->host) < 0) return -1;
@@ -635,8 +762,8 @@ static int dispatch_c(CoreObject *c, CHost *h, int hid, IRow *ir,
   h->d_delivered++;
   h->d_dgrams_recv++;
   TM0(2);
-  int rr = gossip_on_msg_c(c, h, g, *now, PyTuple_GET_ITEM(ir->row, 12),
-                           ir->peer);
+  int rr = gossip_on_msg_c(c, h, g, *now,
+                           ir->payload ? ir->payload : Py_None, ir->peer);
   TM1(2);
   return rr;
 }
@@ -718,7 +845,7 @@ static int64_t run_host_inner(CoreObject *c, CHost *h, int hid, int64_t end) {
 done:
   TM0(10);
   /* release the consumed prefix AND any unconsumed tail (error paths) */
-  for (int i = 0; i < h->inbox_n; i++) Py_DECREF(h->inbox[i].row);
+  for (int i = 0; i < h->inbox_n; i++) Py_XDECREF(h->inbox[i].payload);
   h->inbox_n = 0;
   h->inbox_multi = 0;
   TM1(10);
@@ -819,8 +946,9 @@ static int cmp_orow(const void *a, const void *b) {
   return (x->key > y->key) - (x->key < y->key);
 }
 
-/* build the sorted StoreBatch from resolved BRows (drop flags set);
- * have_flags=0 means every row survives.  Updates plane counters. */
+/* build the sorted CBatch from resolved BRows (drop flags set);
+ * have_flags=0 means every row survives.  Updates plane counters.
+ * BRow payload refs are NOT consumed (the batch takes its own). */
 static int store_build(CoreObject *c, BRow *rows, int n, int have_flags,
                        int64_t round_end) {
   int64_t sent = 0, dropped = 0, nbytes_total = 0;
@@ -831,9 +959,9 @@ static int store_build(CoreObject *c, BRow *rows, int n, int have_flags,
     BRow *b = &rows[i];
     if (have_flags && b->drop) {
       dropped++;
-      /* want_loss (egress field 10): loss-notify row back to the sender
-       * at arrival + return-path latency (fluid fast-retransmit) */
-      if (PyObject_IsTrue(PyTuple_GET_ITEM(b->row, 10))) {
+      /* want_loss: loss-notify row back to the sender at arrival +
+       * return-path latency (fluid fast-retransmit) */
+      if (b->want_loss) {
         int32_t sn = c->hostnode[b->src];
         int32_t dn = c->hostnode[b->dst];
         int64_t t = b->arrival + c->lat[(int64_t)dn * c->G + sn];
@@ -851,70 +979,30 @@ static int store_build(CoreObject *c, BRow *rows, int n, int have_flags,
     }
   }
   int rc = -1;
-  PyObject *lst = NULL, *sb = NULL, *ap = NULL, *cdata = NULL;
+  PyObject *sb = NULL, *ap = NULL;
   if (m) {
     qsort(out, (size_t)m, sizeof(ORow), cmp_orow);
-    lst = PyList_New(m);
-    cdata = PyBytes_FromStringAndSize(NULL, (Py_ssize_t)m * sizeof(SRec));
-    if (!lst || !cdata) goto done;
-    SRec *recs = (SRec *)PyBytes_AS_STRING(cdata);
+    CBatch *cb = cbatch_new(m);
+    if (!cb) goto done;
+    sb = (PyObject *)cb;
     for (int i = 0; i < m; i++) {
       BRow *b = &rows[out[i].idx];
-      PyObject *er = b->row;
-      SRec *rc2 = &recs[i];
+      SRec *rc2 = &cb->recs[i];
       rc2->t = out[i].t;
       rc2->key = out[i].key;
       rc2->tgt = out[i].loss ? b->src : b->dst;
       rc2->size = (int32_t)b->size;
       rc2->peer = out[i].loss ? b->dst : b->src;
-      rc2->bport = (int32_t)tup_i64(er, 5); /* dport */
-      rc2->aport = (int32_t)tup_i64(er, 4);  /* sport */
-      rc2->nbytes = tup_i64(er, 6);
-      rc2->seq = tup_i64(er, 7);
-      rc2->kind = out[i].loss ? KIND_LOSS_C : (int16_t)tup_i64(er, 0);
-      rc2->single_frag = tup_i64(er, 9) == 1; /* nfrags */
-      PyObject *t = PyTuple_New(13);
-      if (!t) goto done;
-      PyTuple_SET_ITEM(t, 0, PyLong_FromLongLong(out[i].t));
-      PyTuple_SET_ITEM(t, 1, PyLong_FromLongLong(out[i].key));
-      if (out[i].loss) {
-        Py_INCREF(b->src_obj);
-        PyTuple_SET_ITEM(t, 2, b->src_obj); /* tgt = sender */
-        Py_INCREF(O_kind_loss);
-        PyTuple_SET_ITEM(t, 3, O_kind_loss);
-        PyObject *d = PyTuple_GET_ITEM(er, 1);
-        Py_INCREF(d);
-        PyTuple_SET_ITEM(t, 4, d); /* peer = dst */
-      } else {
-        PyObject *d = PyTuple_GET_ITEM(er, 1);
-        Py_INCREF(d);
-        PyTuple_SET_ITEM(t, 2, d); /* tgt = dst */
-        PyObject *kk = PyTuple_GET_ITEM(er, 0);
-        Py_INCREF(kk);
-        PyTuple_SET_ITEM(t, 3, kk);
-        Py_INCREF(b->src_obj);
-        PyTuple_SET_ITEM(t, 4, b->src_obj); /* peer = src */
-      }
-      static const int emap[6] = {4, 5, 6, 7, 8, 9}; /* sport..nfrags */
-      for (int j = 0; j < 6; j++) {
-        PyObject *v = PyTuple_GET_ITEM(er, emap[j]);
-        Py_INCREF(v);
-        PyTuple_SET_ITEM(t, 5 + j, v);
-      }
-      PyObject *sz = PyTuple_GET_ITEM(er, 2);
-      Py_INCREF(sz);
-      PyTuple_SET_ITEM(t, 11, sz);
-      PyObject *pl = PyTuple_GET_ITEM(er, 11);
-      Py_INCREF(pl);
-      PyTuple_SET_ITEM(t, 12, pl);
-      if (!PyTuple_GET_ITEM(t, 0) || !PyTuple_GET_ITEM(t, 1)) {
-        Py_DECREF(t);
-        goto done;
-      }
-      PyList_SET_ITEM(lst, i, t);
+      rc2->bport = b->dport;
+      rc2->aport = b->sport;
+      rc2->nbytes = b->nbytes;
+      rc2->seq = b->seq;
+      rc2->kind = out[i].loss ? KIND_LOSS_C : (int16_t)b->kind;
+      rc2->frag = b->frag;
+      rc2->nfrags = b->nfrags;
+      Py_XINCREF(b->payload);
+      cb->pay[i] = b->payload;
     }
-    sb = PyObject_CallFunctionObjArgs(c->storebatch_cls, lst, cdata, NULL);
-    if (!sb) goto done;
     ap = PyObject_CallMethodObjArgs(c->pending, S_append, sb, NULL);
     if (!ap) goto done;
   }
@@ -926,8 +1014,6 @@ static int store_build(CoreObject *c, BRow *rows, int n, int have_flags,
 done:
   Py_XDECREF(ap);
   Py_XDECREF(sb);
-  Py_XDECREF(lst);
-  Py_XDECREF(cdata);
   free(out);
   return rc;
 }
@@ -952,10 +1038,24 @@ static PyObject *Core_store_resolved(CoreObject *c, PyObject *args) {
   for (int i = 0; i < n; i++) {
     PyObject *er = PyList_GET_ITEM(rows, i);
     BRow *b = &br[i];
-    b->row = er;
+    /* egress-format tuple -> packed fields (payload ref stays borrowed
+     * from the tuple; store_build takes its own) */
+    b->kind = (int32_t)tup_i64(er, 0);
     b->src = (int32_t)PyLong_AsLongLong(PyList_GET_ITEM(src_l, i));
     b->dst = (int32_t)tup_i64(er, 1);
     b->size = tup_i64(er, 2);
+    b->t_emit = tup_i64(er, 3);
+    b->sport = (int32_t)tup_i64(er, 4);
+    b->dport = (int32_t)tup_i64(er, 5);
+    b->nbytes = tup_i64(er, 6);
+    b->seq = tup_i64(er, 7);
+    b->frag = (int32_t)tup_i64(er, 8);
+    b->nfrags = (int32_t)tup_i64(er, 9);
+    int wl = PyObject_IsTrue(PyTuple_GET_ITEM(er, 10));
+    if (wl < 0) { free(br); return NULL; }
+    b->want_loss = (uint8_t)wl;
+    PyObject *pl = PyTuple_GET_ITEM(er, 11);
+    b->payload = pl == Py_None ? NULL : pl;
     b->arrival = PyLong_AsLongLong(PyList_GET_ITEM(arrival_l, i));
     b->key = PyLong_AsLongLong(PyList_GET_ITEM(keys_l, i));
     if (b->src < 0 || b->src >= c->H) {
@@ -1063,10 +1163,20 @@ static PyObject *Core_barrier(CoreObject *c, PyObject *args) {
   }
   if (nem > 1) qsort(ems, (size_t)nem, sizeof(Emitter), cmp_emitter);
 
-  /* collect rows + mint uids in per-host emission order */
+  /* collect rows + mint uids in per-host emission order (the packed C
+   * egress buffers; ownership of each payload ref moves to the BRow) */
   for (Py_ssize_t e = 0; e < nem; e++) {
-    PyObject *eg = c->hs[ems[e].hid].egress; /* identity-stable cache */
-    Py_ssize_t k = PyList_GET_SIZE(eg);
+    int64_t hid = ems[e].hid;
+    CHost *hstate = &c->hs[hid];
+    if (PyList_GET_SIZE(hstate->egress) != 0) {
+      /* every emission on the C plane routes through core_emit_fields /
+       * emit_row; a tuple here means a writer bypassed the packed path */
+      PyErr_SetString(PyExc_RuntimeError,
+                      "host.egress_rows is non-empty under the C engine "
+                      "(packed-emission protocol violation)");
+      goto done;
+    }
+    Py_ssize_t k = hstate->erow_n;
     if (n + k > c->brow_cap) {
       int ncap = c->brow_cap ? c->brow_cap : 4096;
       while (ncap < n + k) ncap *= 2;
@@ -1075,28 +1185,33 @@ static PyObject *Core_barrier(CoreObject *c, PyObject *args) {
       c->brow = nb;
       c->brow_cap = ncap;
     }
-    int64_t hid = ems[e].hid;
     int64_t ctr;
     if (attr_i64(ems[e].host, S_uid_counter, &ctr) < 0) goto done;
     if (attr_set_i64(ems[e].host, S_uid_counter, ctr + k) < 0) goto done;
     uint64_t base = ((uint64_t)hid << 40) | (uint64_t)ctr;
-    CHost *hstate = &c->hs[hid];
     for (Py_ssize_t i = 0; i < k; i++) {
-      PyObject *er = PyList_GET_ITEM(eg, i);
-      Py_INCREF(er); /* BRow owns it past the in-place list clear */
+      ERow *er = &hstate->erow[i];
       BRow *b = &c->brow[n++];
-      b->row = er;
+      b->payload = er->payload; /* ownership moves */
+      er->payload = NULL;
       b->src_obj = hstate->id_obj;
       b->src = (int32_t)hid;
-      b->dst = (int32_t)tup_i64(er, 1);
-      b->size = tup_i64(er, 2);
-      b->t_emit = tup_i64(er, 3);
+      b->dst = er->dst;
+      b->size = er->size;
+      b->t_emit = er->t_emit;
+      b->nbytes = er->nbytes;
+      b->seq = er->seq;
+      b->kind = er->kind;
+      b->sport = er->sport;
+      b->dport = er->dport;
+      b->frag = er->frag;
+      b->nfrags = er->nfrags;
+      b->want_loss = er->want_loss;
       b->uid = base + (uint64_t)i;
       b->drop = 0;
     }
+    hstate->erow_n = 0;
     nown = n;
-    if (PyErr_Occurred()) goto done;
-    if (PyList_SetSlice(eg, 0, k, NULL) < 0) goto done; /* clear in place */
   }
   if (n == 0) { result = Py_None; Py_INCREF(Py_None); goto done; }
 
@@ -1122,7 +1237,7 @@ static PyObject *Core_barrier(CoreObject *c, PyObject *args) {
     int64_t lat = c->lat[(int64_t)sn * c->G + dn];
     if (lat >= INF_I64) {
       bh++;
-      Py_DECREF(b->row); /* blackholed: drop our ref now (see `nown`) */
+      Py_XDECREF(b->payload); /* blackholed: drop our ref (see `nown`) */
       continue;
     }
     if (lat < mul) mul = lat;
@@ -1203,8 +1318,17 @@ static PyObject *Core_barrier(CoreObject *c, PyObject *args) {
         int fail = 0;
         for (int i = 0; i < keep && !fail; i++) {
           BRow *b = &c->brow[i];
-          Py_INCREF(b->row);
-          PyList_SET_ITEM(rows_l, i, b->row);
+          /* egress-format tuple for the Python device/mesh machinery
+           * (amortized by the batch's >= device_floor size) */
+          PyObject *row_t = Py_BuildValue(
+              "(iiLLiiLLiiOO)", (int)b->kind, (int)b->dst,
+              (long long)b->size, (long long)b->t_emit, (int)b->sport,
+              (int)b->dport, (long long)b->nbytes, (long long)b->seq,
+              (int)b->frag, (int)b->nfrags,
+              b->want_loss ? Py_True : Py_False,
+              b->payload ? b->payload : Py_None);
+          if (!row_t) { fail = 1; break; }
+          PyList_SET_ITEM(rows_l, i, row_t);
           Py_INCREF(b->src_obj);
           PyList_SET_ITEM(src_l, i, b->src_obj);
           PyObject *kv = PyLong_FromLongLong(b->key);
@@ -1252,7 +1376,7 @@ static PyObject *Core_barrier(CoreObject *c, PyObject *args) {
   Py_INCREF(Py_True);
 
 done:
-  for (int i = 0; i < nown; i++) Py_XDECREF(c->brow[i].row);
+  for (int i = 0; i < nown; i++) Py_XDECREF(c->brow[i].payload);
   free(ems);
   Py_DECREF(emitters);
   return result;
@@ -1284,46 +1408,39 @@ static inline void inbox_slice_mark(CHost *h, int slice) {
   }
 }
 
-/* side-car variant: all fields come from the packed record */
-static int inbox_push_rec(CHost *h, const SRec *s, PyObject *row,
+/* all fields come from the packed record; payload ref is INCREF'd into
+ * the IRow (released by run_host's inbox-free loop) */
+static int inbox_push_rec(CHost *h, const SRec *s, PyObject *payload,
                           int slice) {
   if (h->inbox_n == h->inbox_cap && inbox_grow(h) < 0) return -1;
   inbox_slice_mark(h, slice);
   IRow *r = &h->inbox[h->inbox_n++];
   r->t = s->t;
   r->key = s->key;
-  Py_INCREF(row);
-  r->row = row;
+  Py_XINCREF(payload);
+  r->payload = payload;
   r->kind = s->kind;
   r->peer = s->peer;
   r->bport = s->bport;
   r->aport = s->aport;
   r->nbytes = s->nbytes;
   r->seq = s->seq;
-  r->single_frag = s->single_frag;
+  r->frag = s->frag;
+  r->nfrags = s->nfrags;
   r->size = s->size;
   return 0;
 }
 
-static int inbox_push(CHost *h, int64_t t, int64_t key, PyObject *row,
-                      int slice) {
-  /* body below fills the dispatch fields from the row */
-  if (h->inbox_n == h->inbox_cap && inbox_grow(h) < 0) return -1;
-  inbox_slice_mark(h, slice);
-  IRow *r = &h->inbox[h->inbox_n++];
-  r->t = t;
-  r->key = key;
-  Py_INCREF(row);
-  r->row = row;
-  r->kind = (int16_t)tup_i64(row, 3);
-  r->peer = (int32_t)tup_i64(row, 4);
-  r->aport = (int32_t)tup_i64(row, 5);
-  r->bport = (int32_t)tup_i64(row, 6);
-  r->nbytes = tup_i64(row, 7);
-  r->seq = tup_i64(row, 8);
-  r->single_frag = tup_i64(row, 10) == 1;
-  r->size = (int32_t)tup_i64(row, 11);
-  return 0;
+/* the colplane 13-tuple for one inbox row (Python-fallback dispatch and
+ * deferred parking; tgt is the owning host) */
+static PyObject *irow_tuple(const CHost *h, const IRow *r, int64_t tgt) {
+  SRec s;
+  (void)h;
+  s.t = r->t; s.key = r->key; s.tgt = (int32_t)tgt; s.size = r->size;
+  s.peer = r->peer; s.bport = r->bport; s.aport = r->aport;
+  s.nbytes = r->nbytes; s.seq = r->seq; s.kind = r->kind;
+  s.frag = r->frag; s.nfrags = r->nfrags;
+  return srec_tuple(&s, r->payload);
 }
 
 static PyObject *Core_refill_ingress(CoreObject *c, PyObject *args) {
@@ -1358,43 +1475,32 @@ static PyObject *Core_extract(CoreObject *c, PyObject *args) {
   if (!it) return NULL;
   PyObject *batch;
   while ((batch = PyIter_Next(it))) {
-    PyObject *rows = PyObject_GetAttr(batch, S_rows);
-    if (!rows) { Py_DECREF(batch); goto fail; }
-    int64_t pos;
-    if (attr_i64(batch, S_pos, &pos) < 0) {
-      Py_DECREF(rows); Py_DECREF(batch); goto fail;
+    if (Py_TYPE(batch) != &CBatch_Type) {
+      PyErr_SetString(PyExc_TypeError,
+                      "C extract expects CBatch store batches only");
+      Py_DECREF(batch);
+      goto fail;
     }
-    Py_ssize_t ln = PyList_GET_SIZE(rows);
-    /* side-car fast path: field reads hit the packed records, the cold
-     * row tuples are only INCREF'd */
-    SRec *recs = NULL;
-    PyObject *cd = PyObject_GetAttrString(batch, "cdata");
-    if (!cd) PyErr_Clear();
-    else if (cd == Py_None) { Py_DECREF(cd); cd = NULL; }
-    else if (PyBytes_Check(cd) &&
-             PyBytes_GET_SIZE(cd) == ln * (Py_ssize_t)sizeof(SRec))
-      recs = (SRec *)PyBytes_AS_STRING(cd);
-    else { Py_DECREF(cd); cd = NULL; }
-#define ROW_T(i) (recs ? recs[i].t : tup_i64(PyList_GET_ITEM(rows, i), 0))
-    if (pos >= ln || ROW_T(pos) >= round_end) {
-      Py_XDECREF(cd); Py_DECREF(rows); Py_DECREF(batch);
+    CBatch *cb = (CBatch *)batch;
+    SRec *recs = cb->recs;
+    int pos = cb->pos, ln = cb->n;
+    if (pos >= ln || recs[pos].t >= round_end) {
+      Py_DECREF(batch);
       continue;
     }
     /* bisect_left by row time for round_end */
-    Py_ssize_t lo = pos, hi = ln;
+    int lo = pos, hi = ln;
     while (lo < hi) {
-      Py_ssize_t mid = (lo + hi) / 2;
-      if (ROW_T(mid) < round_end) lo = mid + 1;
+      int mid = (lo + hi) / 2;
+      if (recs[mid].t < round_end) lo = mid + 1;
       else hi = mid;
     }
-#undef ROW_T
-    for (Py_ssize_t i = pos; i < lo; i++) {
-      PyObject *row = PyList_GET_ITEM(rows, i);
-      int64_t tgt = recs ? recs[i].tgt : tup_i64(row, 2);
+    for (int i = pos; i < lo; i++) {
+      int64_t tgt = recs[i].tgt;
       if (tgt < 0 || tgt >= c->H) {
         if (!PyErr_Occurred())
           PyErr_SetString(PyExc_ValueError, "row target out of range");
-        Py_XDECREF(cd); Py_DECREF(rows); Py_DECREF(batch); goto fail;
+        Py_DECREF(batch); goto fail;
       }
       CHost *h = &c->hs[tgt];
       if (h->inbox_n == 0) {
@@ -1404,27 +1510,18 @@ static PyObject *Core_extract(CoreObject *c, PyObject *args) {
                                 sizeof(int64_t) * (size_t)captouched);
           if (!nt) {
             PyErr_NoMemory();
-            Py_XDECREF(cd); Py_DECREF(rows); Py_DECREF(batch); goto fail;
+            Py_DECREF(batch); goto fail;
           }
           touched = nt;
         }
         touched[ntouched++] = tgt;
       }
-      int pr;
-      if (recs)
-        pr = inbox_push_rec(h, &recs[i], row, nslices);
-      else
-        pr = inbox_push(h, tup_i64(row, 0), tup_i64(row, 1), row, nslices);
-      if (pr < 0) {
-        Py_XDECREF(cd); Py_DECREF(rows); Py_DECREF(batch); goto fail;
+      if (inbox_push_rec(h, &recs[i], cb->pay[i], nslices) < 0) {
+        Py_DECREF(batch); goto fail;
       }
     }
-    if (attr_set_i64(batch, S_pos, lo) < 0) {
-      Py_XDECREF(cd); Py_DECREF(rows); Py_DECREF(batch); goto fail;
-    }
+    cb->pos = lo;
     nslices++;
-    Py_XDECREF(cd);
-    Py_DECREF(rows);
     Py_DECREF(batch);
   }
   Py_DECREF(it);
@@ -1437,14 +1534,10 @@ static PyObject *Core_extract(CoreObject *c, PyObject *args) {
     if (np == 0) break;
     PyObject *first = PySequence_GetItem(c->pending, 0);
     if (!first) goto fail;
-    PyObject *rows = PyObject_GetAttr(first, S_rows);
-    int64_t pos = -1;
-    int bad = !rows || attr_i64(first, S_pos, &pos) < 0;
-    Py_ssize_t ln = rows ? PyList_GET_SIZE(rows) : 0;
-    Py_XDECREF(rows);
+    int done_b = Py_TYPE(first) == &CBatch_Type &&
+                 ((CBatch *)first)->pos >= ((CBatch *)first)->n;
     Py_DECREF(first);
-    if (bad) goto fail;
-    if (pos < ln) break;
+    if (!done_b) break;
     PyObject *r = PyObject_CallMethodObjArgs(c->pending, S_popleft, NULL);
     if (!r) goto fail;
     Py_DECREF(r);
@@ -1459,11 +1552,17 @@ static PyObject *Core_extract(CoreObject *c, PyObject *args) {
     if (multi && h->inbox_n > 1 && h->inbox_multi)
       qsort(h->inbox, (size_t)h->inbox_n, sizeof(IRow), cmp_irow);
     if (h->py_mode) {
-      /* pcap hosts: hand a plain Python list to Host.run_events */
+      /* pcap hosts: hand a plain Python list of 13-tuples to
+       * Host.run_events (materialized here; py_mode hosts are rare) */
       PyObject *lst = PyList_New(h->inbox_n);
       if (!lst) goto fail;
-      for (int j = 0; j < h->inbox_n; j++)
-        PyList_SET_ITEM(lst, j, h->inbox[j].row); /* steals our refs */
+      for (int j = 0; j < h->inbox_n; j++) {
+        PyObject *t = irow_tuple(h, &h->inbox[j], touched[i]);
+        if (!t) { Py_DECREF(lst); goto fail; }
+        PyList_SET_ITEM(lst, j, t);
+        Py_XDECREF(h->inbox[j].payload);
+        h->inbox[j].payload = NULL; /* cleanup passes must not re-release */
+      }
       h->inbox_n = 0;
       int r = PyObject_SetAttr(h->host, S_inbox, lst);
       Py_DECREF(lst);
@@ -1590,7 +1689,7 @@ static int Core_traverse(CoreObject *c, visitproc visit, void *arg) {
       Py_VISIT(h->conns);
       Py_VISIT(h->listeners);
       for (int j = 0; j < h->nports; j++) Py_VISIT(h->gs[j]);
-      for (int j = 0; j < h->inbox_n; j++) Py_VISIT(h->inbox[j].row);
+      /* inbox payloads / egress payloads are bytes|None (no cycles) */
     }
   }
   return 0;
@@ -1615,8 +1714,10 @@ static int Core_clear_gc(CoreObject *c) {
       Py_CLEAR(h->listeners);
       for (int j = 0; j < h->nports; j++) Py_CLEAR(h->gs[j]);
       h->nports = 0;
-      for (int j = 0; j < h->inbox_n; j++) Py_CLEAR(h->inbox[j].row);
+      for (int j = 0; j < h->inbox_n; j++) Py_CLEAR(h->inbox[j].payload);
       h->inbox_n = 0;
+      for (int j = 0; j < h->erow_n; j++) Py_CLEAR(h->erow[j].payload);
+      h->erow_n = 0;
     }
   }
   return 0;
@@ -1634,8 +1735,10 @@ static void Core_dealloc(CoreObject *c) {
       Py_XDECREF(h->egress);
       Py_XDECREF(h->conns);
       Py_XDECREF(h->listeners);
-      for (int j = 0; j < h->inbox_n; j++) Py_XDECREF(h->inbox[j].row);
+      for (int j = 0; j < h->inbox_n; j++) Py_XDECREF(h->inbox[j].payload);
       free(h->inbox);
+      for (int j = 0; j < h->erow_n; j++) Py_XDECREF(h->erow[j].payload);
+      free(h->erow);
       for (int j = 0; j < h->nports; j++) Py_XDECREF(h->gs[j]);
     }
     free(c->hs);
@@ -1885,6 +1988,13 @@ static PyMethodDef Core_methods[] = {
      "clamped ingress token refill for an elapsed window: (dt_ns)"},
     {"run_round", (PyCFunction)Core_run_round, METH_VARARGS,
      "per-round host loop over the bound active set: (round_end) -> n"},
+    {"emit_row", (PyCFunction)Core_emit_row, METH_VARARGS,
+     "packed emission (Host.emit_msg delegate): (hid, kind, dst, size, "
+     "t_emit, sport, dport, nbytes, seq, frag, nfrags, want_loss, payload)"},
+    {"materialize_egress", (PyCFunction)Core_materialize_egress,
+     METH_NOARGS,
+     "flush packed C egress into host.egress_rows tuples (Python-barrier "
+     "rounds: fault_filter)"},
     {"store_resolved", (PyCFunction)Core_store_resolved, METH_VARARGS,
      "(rows, src_l, arrival_l, keys_l, flags|None, round_end)"},
     {"bind_active", (PyCFunction)Core_bind_active, METH_O,
@@ -3031,8 +3141,7 @@ static PyObject *Core_make_endpoint(CoreObject *c, PyObject *args) {
 static int dispatch_stream(CoreObject *c, CHost *h, int hid, IRow *ir,
                            int64_t *now, int *now_dirty) {
   int k = ir->kind;
-  PyObject *pl = PyTuple_GET_ITEM(ir->row, 12);
-  if (pl == Py_None) pl = NULL;
+  PyObject *pl = ir->payload;
   if (k == KIND_LOSS_C) {
     /* loss-notify (no ingress charge): route back by four-tuple.
      * The clock attr syncs BEFORE the endpoint logic runs: transport
@@ -3071,7 +3180,10 @@ static int dispatch_stream(CoreObject *c, CHost *h, int hid, IRow *ir,
     } else {
       PyObject *dl = PyObject_GetAttr(h->host, S_ingress_deferred_rows);
       if (!dl) return -1;
-      int r = PyList_Append(dl, ir->row);
+      PyObject *row = irow_tuple(h, ir, hid);
+      if (!row) { Py_DECREF(dl); return -1; }
+      int r = PyList_Append(dl, row);
+      Py_DECREF(row);
       Py_DECREF(dl);
       if (r < 0) return -1;
       if (PySet_Add(c->deferred, h->host) < 0) return -1;
@@ -3805,7 +3917,8 @@ PyMODINIT_FUNC PyInit__colcore(void) {
   O_kind_loss = PyLong_FromLong(KIND_LOSS_C);
   if (!O_zero || !O_one || !O_kind_dgram || !O_kind_loss) return NULL;
   if (PyType_Ready(&Core_Type) < 0 || PyType_Ready(&GossipState_Type) < 0
-      || PyType_Ready(&CEp_Type) < 0 || PyType_Ready(&CRelay_Type) < 0)
+      || PyType_Ready(&CEp_Type) < 0 || PyType_Ready(&CRelay_Type) < 0
+      || PyType_Ready(&CBatch_Type) < 0)
     return NULL;
   PyObject *m = PyModule_Create(&colcore_module);
   if (!m) return NULL;

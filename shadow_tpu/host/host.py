@@ -257,16 +257,24 @@ class Host:
         reference-architecture data path."""
         cp = self.colplane
         if cp is not None:
+            if self.pcap is not None:
+                self.pcap.capture_fields(
+                    kind, sport, dport, nbytes, seq, payload, self._now,
+                    self.ip, self.controller.hosts[dst].ip)
+            c = cp._c
+            if c is not None:
+                # C engine: packed egress row, no tuple (the C side also
+                # tracks the emitters list and the emitted counter)
+                c.emit_row(self.id, kind, dst, size, self._now, sport,
+                           dport, nbytes, seq, frag_idx, nfrags,
+                           want_loss, payload)
+                return
             eg = self.egress_rows
             if not eg:
                 cp.emitters.append(self)
             eg.append((kind, dst, size, self._now, sport, dport, nbytes,
                        seq, frag_idx, nfrags, want_loss, payload))
             self._n_emitted += 1
-            if self.pcap is not None:
-                self.pcap.capture_fields(
-                    kind, sport, dport, nbytes, seq, payload, self._now,
-                    self.ip, self.controller.hosts[dst].ip)
             return
         u = Unit(
             uid=self.next_uid(),

@@ -313,6 +313,11 @@ class ColumnarPlane(DeviceRoutedPlane):
                 self._floor_cooldown_tick()
             self.phase_wall["barrier"] += _walltime.perf_counter() - t0
             return
+        if self._c is not None:
+            # fault_filter rounds run the Python barrier below: flush the
+            # packed C egress buffers into host.egress_rows first (the
+            # C engine keeps emissions packed; round 5)
+            self._c.materialize_egress()
         emitters = self.emitters
         if not emitters:
             return
