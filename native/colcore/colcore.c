@@ -332,6 +332,7 @@ typedef struct {
   int64_t unit_chunk; /* fluid quantum payload bytes (Host.unit_chunk) */
   int64_t sock_sbuf, sock_rbuf; /* experimental.socket_*_buffer */
   int mesh_mode; /* hand live batches to Python for the mesh collective */
+  int oracle_loss; /* experimental.stream_loss_recovery == "oracle" */
   CHost *hs;
   /* scratch buffers reused across barriers */
   struct BRow *brow;
@@ -1823,6 +1824,11 @@ static int Core_init(CoreObject *c, PyObject *args, PyObject *kwds) {
   if (!mp) return -1;
   c->mesh_mode = mp != Py_None;
   Py_DECREF(mp);
+  PyObject *ol = PyObject_GetAttrString(plane, "oracle_loss");
+  if (!ol) return -1;
+  c->oracle_loss = PyObject_IsTrue(ol);
+  Py_DECREF(ol);
+  if (c->oracle_loss < 0) return -1;
   c->unit_chunk = 0; /* filled from hosts[0] below (config-uniform) */
   PyObject *mod = PyImport_ImportModule("shadow_tpu.network.colplane");
   if (!mod) return -1;
@@ -2104,6 +2110,7 @@ typedef struct CEp {
   Ring rtx;     /* RtxEnt */
   /* receiver */
   int64_t recv_buffer, rcv_nxt, ooo_bytes, bytes_received, last_wnd;
+  int dup_acks; /* consecutive duplicate acks (RFC 5681 counting) */
   Ring ooo; /* RtxEnt, kept seq-sorted (insertion) */
   PyObject *app_unread; /* callable or NULL */
   /* app callbacks (None when unset) */
@@ -2236,7 +2243,10 @@ static int cs_arm_rto(CEp *e, int reset) {
 
 static int cs_emit_data(CEp *e, int64_t now, int64_t seq, int64_t nbytes,
                         PyObject *payload) {
-  return cep_emit(e, now, TK_DATA, nbytes, payload, seq, 0, 0, 1);
+  /* want_loss only in oracle mode (experimental.stream_loss_recovery);
+   * dupack mode recovers from duplicate acks like the Python twin */
+  return cep_emit(e, now, TK_DATA, nbytes, payload, seq, 0, 0,
+                  e->core->oracle_loss);
 }
 
 static int cs_pump(CEp *e, int64_t now) {
@@ -2287,17 +2297,25 @@ static int cs_pump(CEp *e, int64_t now) {
   return 0;
 }
 
-static int cs_oracle_loss(CEp *e, int64_t now, int64_t seq, int64_t nbytes,
-                          PyObject *payload) {
-  if (seq + nbytes <= e->snd_una || e->state == ST_CLOSED ||
-      e->state == ST_TIME_WAIT)
-    return 0;
+/* the shared loss response (oracle notification OR 3rd dup ack):
+ * multiplicative decrease + retransmit + RTO reset
+ * (StreamSender._loss_response twin) */
+static int cs_loss_response(CEp *e, int64_t now, int64_t seq,
+                            int64_t nbytes, PyObject *payload) {
   e->loss_events++;
   int64_t inflight = e->snd_nxt - e->snd_una;
   e->ssthresh = inflight / 2 > MIN_CWND_C ? inflight / 2 : MIN_CWND_C;
   e->cwnd = e->cwnd / 2 > MIN_CWND_C ? e->cwnd / 2 : MIN_CWND_C;
   if (cs_emit_data(e, now, seq, nbytes, payload) < 0) return -1;
   return cs_arm_rto(e, 1);
+}
+
+static int cs_oracle_loss(CEp *e, int64_t now, int64_t seq, int64_t nbytes,
+                          PyObject *payload) {
+  if (seq + nbytes <= e->snd_una || e->state == ST_CLOSED ||
+      e->state == ST_TIME_WAIT)
+    return 0;
+  return cs_loss_response(e, now, seq, nbytes, payload);
 }
 
 static int cs_on_rto(CEp *e, int64_t now) {
@@ -2318,8 +2336,10 @@ static int cs_on_rto(CEp *e, int64_t now) {
 }
 
 static int cs_on_ack(CEp *e, int64_t now, int64_t cum_ack, int64_t wnd) {
+  int64_t prev_wnd = e->adv_wnd;
   e->adv_wnd = wnd;
   if (cum_ack > e->snd_una) {
+    e->dup_acks = 0;
     int64_t newly = cum_ack - e->snd_una;
     e->snd_una = cum_ack;
     e->bytes_acked += newly;
@@ -2356,6 +2376,19 @@ static int cs_on_ack(CEp *e, int64_t now, int64_t cum_ack, int64_t wnd) {
       if (!r) return -1;
       Py_DECREF(r);
     }
+  } else if (!e->core->oracle_loss && cum_ack == e->snd_una &&
+             wnd == prev_wnd && e->snd_nxt - e->snd_una > 0 &&
+             e->rtx.count) {
+    /* duplicate ack (same cum, same window, data outstanding): 3rd
+     * CONSECUTIVE one triggers fast retransmit (StreamSender twin) */
+    e->dup_acks++;
+    if (e->dup_acks == 3) {
+      RtxEnt *re = ring_at(&e->rtx, 0);
+      if (cs_loss_response(e, now, re->seq, re->n, re->payload) < 0)
+        return -1;
+    }
+  } else {
+    e->dup_acks = 0; /* anything else breaks the consecutive run */
   }
   return cs_pump(e, now);
 }
@@ -2423,6 +2456,30 @@ static int tgen_srv_data(CEp *e, int64_t now, PyObject *payload) {
   return tgen_push(e, now);
 }
 
+/* out-of-order / duplicate / out-of-window data: real TCP acks
+ * IMMEDIATELY (RFC 5681 §4.2 — dup acks drive the sender's
+ * fast-retransmit counter). Supersedes any coalesced ack queued this
+ * round (a same-cum barrier ack would inflate the dup count). Oracle
+ * mode keeps coalescing — the StreamReceiver._dup_ack twin. */
+static int cep_dup_ack(CEp *e, int64_t now) {
+  if (e->core->oracle_loss) return cep_mark_ack(e);
+  if (e->state == ST_CLOSED || e->state == ST_TIME_WAIT) return 0;
+  CHost *h = cep_h(e);
+  PyObject *aeps = PyObject_GetAttrString(h->host, "_ack_eps");
+  if (!aeps) return -1;
+  int had = PyDict_Contains(aeps, (PyObject *)e);
+  if (had < 0) { Py_DECREF(aeps); return -1; }
+  if (had && PyDict_DelItem(aeps, (PyObject *)e) < 0) {
+    Py_DECREF(aeps);
+    return -1;
+  }
+  Py_DECREF(aeps);
+  /* re-advertise last_wnd (NOT the recomputed window): buffering the
+   * OOO segment shrinks window() every time, which would defeat the
+   * sender's same-window dup test — see StreamReceiver._dup_ack */
+  return cep_emit(e, now, TK_ACK, 0, NULL, 0, e->rcv_nxt, e->last_wnd, 0);
+}
+
 /* ---- receiver (StreamReceiver twin) ------------------------------------ */
 static int cr_deliver(CEp *e, int64_t now, int64_t nbytes,
                       PyObject *payload) {
@@ -2466,7 +2523,7 @@ static int cr_ooo_find(CEp *e, int64_t seq) {
 static int cr_on_data(CEp *e, int64_t now, int64_t seq, int64_t n,
                       PyObject *payload) {
   int err;
-  if (seq + n <= e->rcv_nxt) return cep_mark_ack(e); /* duplicate */
+  if (seq + n <= e->rcv_nxt) return cep_dup_ack(e, now); /* duplicate */
   if (seq > e->rcv_nxt) {
     if (cr_ooo_find(e, seq) < 0) {
       int64_t w = cep_window(e, &err);
@@ -2481,11 +2538,14 @@ static int cr_on_data(CEp *e, int64_t now, int64_t seq, int64_t n,
         e->ooo_bytes += n;
       }
     }
-    return cep_mark_ack(e); /* "duplicate ack" */
+    return cep_dup_ack(e, now); /* duplicate ack: rcv_nxt unchanged */
   }
   int64_t w = cep_window(e, &err);
   if (err) return -1;
-  if (n > w) return cep_mark_ack(e); /* beyond-window probe: refuse */
+  /* beyond-window probe: refuse + COALESCED re-advertisement (not a dup
+   * ack — counting probe refusals toward fast retransmit would halve
+   * cwnd during a stall where nothing was lost) */
+  if (n > w) return cep_mark_ack(e);
   if (cr_deliver(e, now, n, payload) < 0) return -1;
   for (;;) {
     int i = cr_ooo_find(e, e->rcv_nxt);

@@ -97,6 +97,11 @@ class ExperimentalOptions:
     #: C engine for the columnar plane (native/colcore). Bit-identical to
     #: the Python paths; off forces the pure-Python twin (test oracle).
     native_colcore: bool = True
+    #: stream loss recovery: "dupack" = RFC 5681-shaped 3-duplicate-ack
+    #: fast retransmit (the faithful model, default); "oracle" = the
+    #: engine notifies the sender one RTT after a dropped departure
+    #: (round 2-4 behavior, kept selectable for A/B measurement)
+    stream_loss_recovery: str = "dupack"
 
 
 @dataclass
@@ -235,6 +240,10 @@ def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
     e.tpu_mesh_shards = int(exp.get("tpu_mesh_shards", 0))
     e.tpu_mesh_floor = int(exp.get("tpu_mesh_floor", 2048))
     e.native_colcore = bool(exp.get("native_colcore", True))
+    e.stream_loss_recovery = str(exp.get("stream_loss_recovery", "dupack"))
+    _require(e.stream_loss_recovery in ("dupack", "oracle"),
+             "experimental.stream_loss_recovery must be dupack or oracle, "
+             f"got {e.stream_loss_recovery!r}")
 
     hosts_doc = doc.get("hosts", {}) or {}
     _require(isinstance(hosts_doc, dict), "hosts must be a mapping of name -> options")

@@ -78,6 +78,9 @@ class StreamSender:
         self.retries = 0
         self.loss_events = 0
         self.bytes_acked = 0
+        self.dup_acks = 0  # consecutive duplicate acks (RFC 5681 counting)
+        self.oracle = (endpoint.host.controller.cfg.experimental
+                       .stream_loss_recovery == "oracle")
 
     # -- app side ----------------------------------------------------------
     def queue(self, nbytes: int, payload: Optional[bytes]) -> int:
@@ -128,20 +131,28 @@ class StreamSender:
             self.ep._on_sender_drained()
 
     def _emit_data(self, seq: int, nbytes: int, payload: Optional[bytes]) -> None:
+        # oracle mode asks the engine for a loss notification one RTT
+        # after a dropped departure; dupack mode (default) recovers from
+        # duplicate acks like real TCP, no simulator-side information
         self.ep.emit(U.DATA, nbytes=nbytes, payload=payload, seq=seq,
-                     want_loss=True)
+                     want_loss=self.oracle)
 
     # -- loss recovery -----------------------------------------------------
-    def _on_oracle_loss(self, seq: int, nbytes: int, payload) -> None:
-        """Engine loss notification, one RTT after the dropped departure —
-        the fluid analog of fast retransmit."""
-        if seq + nbytes <= self.snd_una or self.ep.state in (CLOSED, TIME_WAIT):
-            return  # already repaired (e.g. by an RTO retransmit)
+    def _loss_response(self, seq: int, nbytes: int, payload) -> None:
+        """The shared loss response (oracle notification OR 3rd dup ack):
+        multiplicative decrease + retransmit + RTO reset."""
         self.loss_events += 1
-        self.ssthresh = max((self.snd_nxt - self.snd_una) // 2, MIN_CWND)
+        self.ssthresh = max(self.inflight // 2, MIN_CWND)
         self.cwnd = max(self.cwnd // 2, MIN_CWND)
         self._emit_data(seq, nbytes, payload)
         self._arm_rto(reset=True)
+
+    def _on_oracle_loss(self, seq: int, nbytes: int, payload) -> None:
+        """Engine loss notification, one RTT after the dropped departure —
+        the fluid analog of fast retransmit (oracle mode only)."""
+        if seq + nbytes <= self.snd_una or self.ep.state in (CLOSED, TIME_WAIT):
+            return  # already repaired (e.g. by an RTO retransmit)
+        self._loss_response(seq, nbytes, payload)
 
     def _arm_rto(self, reset: bool = False) -> None:
         if reset and self.rto_timer is not None:
@@ -179,8 +190,10 @@ class StreamSender:
 
     # -- ack processing ----------------------------------------------------
     def on_ack(self, cum_ack: int, wnd: int) -> None:
+        prev_wnd = self.adv_wnd
         self.adv_wnd = wnd
         if cum_ack > self.snd_una:
+            self.dup_acks = 0
             newly = cum_ack - self.snd_una
             self.snd_una = cum_ack
             self.bytes_acked += newly
@@ -198,6 +211,17 @@ class StreamSender:
             drained = self.ep.on_drain
             if drained is not None and self.buffered < self.send_buffer:
                 drained(self.send_buffer - self.buffered)
+        elif (not self.oracle and cum_ack == self.snd_una
+              and wnd == prev_wnd and self.inflight > 0 and self.rtx):
+            # duplicate ack (RFC 5681: same cum, same window, data
+            # outstanding); the 3rd CONSECUTIVE one triggers fast
+            # retransmit of the oldest unacked segment
+            self.dup_acks += 1
+            if self.dup_acks == 3:
+                seq, nbytes, payload = self.rtx[0]
+                self._loss_response(seq, nbytes, payload)
+        else:
+            self.dup_acks = 0  # anything else breaks the consecutive run
         self.pump()  # pump() fires _on_sender_drained when fully drained
 
 
@@ -226,19 +250,21 @@ class StreamReceiver:
     def on_data(self, seq: int, n: int, payload: Optional[bytes],
                 now: SimTime) -> None:
         if seq + n <= self.rcv_nxt:
-            self._ack()  # duplicate (retransmit after a lost ACK): re-ack
+            self._dup_ack()  # duplicate (retransmit after lost ACK): re-ack
             return
         if seq > self.rcv_nxt:
             if seq not in self.ooo and n <= self.window():
                 self.ooo[seq] = (n, payload)
                 self.ooo_bytes += n
-            self._ack()  # "duplicate ack": rcv_nxt unchanged
+            self._dup_ack()  # duplicate ack: rcv_nxt unchanged
             return
         if n > self.window():
             # beyond-window in-order data (a sender probing a closed
             # window): refuse it like TCP drops out-of-window segments —
-            # rcv_nxt stays, the duplicate ack re-advertises the window,
-            # and the sender's RTO retries until the app reads
+            # rcv_nxt stays, a COALESCED ack re-advertises the window,
+            # and the sender's RTO retries until the app reads. Not a
+            # dup ack: counting probe refusals toward fast retransmit
+            # would halve cwnd during a stall where nothing was lost.
             self._ack()
             return
         self._deliver(n, payload, now)
@@ -270,6 +296,27 @@ class StreamReceiver:
         # on bulk transfers with identical reliability (acks are cumulative
         # and the sender's RTO floor far exceeds a round width).
         self.ep.host.mark_ack(self.ep)
+
+    def _dup_ack(self) -> None:
+        """Out-of-order / duplicate data: real TCP acks IMMEDIATELY
+        (RFC 5681 §4.2 — dup acks must not be delayed, they drive the
+        sender's fast-retransmit counter). Two deliberate choices keep
+        the counter sound in the fluid model: the dup ack re-advertises
+        ``last_wnd`` (the window the peer last heard) rather than the
+        recomputed one — buffering the OOO segment shrinks window() by n
+        every time, which would make consecutive dup acks all differ and
+        defeat the sender's same-window test — and it supersedes any
+        coalesced ack queued this round (a same-cum barrier ack would
+        inflate the count). Oracle mode keeps plain coalescing (the
+        round 2-4 behavior the A/B compares against)."""
+        ep = self.ep
+        if ep.sender.oracle:
+            self._ack()
+            return
+        if ep.state in (CLOSED, TIME_WAIT):
+            return
+        ep.host._ack_eps.pop(ep, None)
+        ep.emit(U.ACK, acked=self.rcv_nxt, wnd=self.last_wnd)
 
     def flush_ack(self) -> None:
         self.last_wnd = self.window()
