@@ -172,7 +172,7 @@ def managed_bench(n_servers: int = 10, n_clients: int = 40,
     return out
 
 
-def managed_dense_bench(n_procs: int = 4, iters: int = 15000,
+def managed_dense_bench(n_procs: int = 4, iters: int = 40000,
                         chunk: int = 512) -> dict:
     """Syscall-DENSE managed benchmark (VERDICT r3 item #5 / weak #4):
     each process does ``iters`` write+read round trips through an

@@ -233,6 +233,119 @@ static int shim_recv_fd(int64_t *val_out) {
   return fd;
 }
 
+/* ---- shared-memory pipe rings (native/shring.h) ------------------------
+ * The worker backs emulated pipes with a memfd ring mapped here on first
+ * use (SHIM_RET_MAPRING reply + SCM_RIGHTS). Non-blocking reads/writes
+ * are then served entirely locally — zero worker round trips; blocking
+ * edges (empty read, full/atomic-split write, EPIPE) forward as before.
+ * Strict turn-taking makes the shared state race-free. The buffer
+ * pointer the guest passed is dereferenced directly (a bad pointer that
+ * the kernel would EFAULT faults here instead — cooperative guests). */
+#include "../shring.h"
+#define SHIM_RET_MAPRING (-1000001)
+#define SHIM_RING_MAX 128
+
+struct shim_ring_ent {
+  long vfd;
+  int role; /* 0 = read end, 1 = write end */
+  volatile struct shring *h;
+};
+static struct shim_ring_ent shim_rings[SHIM_RING_MAX];
+
+static volatile struct shring *shim_ring_find(long fd, int role) {
+  for (int i = 0; i < SHIM_RING_MAX; i++)
+    if (shim_rings[i].h && shim_rings[i].vfd == fd &&
+        shim_rings[i].role == role)
+      return shim_rings[i].h;
+  return NULL;
+}
+
+static void shim_ring_drop(long fd) {
+  for (int i = 0; i < SHIM_RING_MAX; i++)
+    if (shim_rings[i].h && shim_rings[i].vfd == fd) {
+      raw3(SYS_munmap, (long)shim_rings[i].h, SHRING_SIZE, 0);
+      shim_rings[i].h = NULL;
+    }
+}
+
+static long raw6_asm(long, long, long, long, long, long, long);
+
+static void shim_ring_install(long vfd, int role, int mfd) {
+  shim_gadget_fn m = shim_gadget ? shim_gadget : raw6_asm;
+  long p = m(9 /* mmap */, 0, SHRING_SIZE, 3 /* RW */, 1 /* SHARED */,
+             mfd, 0);
+  raw3(SYS_close, mfd, 0, 0);
+  if (p <= 0 || ((volatile struct shring *)p)->magic != SHRING_MAGIC ||
+      ((volatile struct shring *)p)->cap != SHRING_CAP) {
+    if (p > 0) raw3(SYS_munmap, p, SHRING_SIZE, 0);
+    return;
+  }
+  int slot = -1;
+  for (int i = 0; i < SHIM_RING_MAX; i++) {
+    if (shim_rings[i].h && shim_rings[i].vfd == vfd &&
+        shim_rings[i].role == role) {
+      /* post-fork/duplicate re-offer: replace the inherited mapping */
+      raw3(SYS_munmap, (long)shim_rings[i].h, SHRING_SIZE, 0);
+      shim_rings[i].h = NULL;
+      slot = i;
+      break;
+    }
+    if (!shim_rings[i].h && slot < 0) slot = i;
+  }
+  if (slot < 0) { raw3(SYS_munmap, p, SHRING_SIZE, 0); return; } /* full */
+  shim_rings[slot].vfd = vfd;
+  shim_rings[slot].role = role;
+  shim_rings[slot].h = (volatile struct shring *)p;
+}
+
+static int shim_page_rw; /* the clock page mapped writable (counter slot) */
+
+static void shim_ring_mark(volatile struct shring *h) {
+  h->shim_ops++;
+  h->dirty = 1; /* worker's wake scan is gated on the page counter */
+  if (shim_page_rw) shim_time_page[SHIM_PAGE_FASTOPS]++;
+}
+
+/* local service; INT64_MIN = not serviceable here, forward to worker */
+static int64_t shim_ring_read(long fd, uint64_t buf, uint64_t count) {
+  volatile struct shring *h = shim_ring_find(fd, 0);
+  /* without a writable counter slot the worker cannot observe local
+   * activity (wake scans would starve parked peers): forward everything */
+  if (!h || !h->fast_ok || !shim_page_rw) return INT64_MIN;
+  uint64_t avail = h->wpos - h->rpos;
+  if (avail == 0) return INT64_MIN; /* EOF / park / EAGAIN: worker's call */
+  uint64_t k = count < avail ? count : avail;
+  if (k == 0) return 0;
+  uint64_t off = h->rpos % SHRING_CAP;
+  uint64_t first = SHRING_CAP - off;
+  if (first > k) first = k;
+  memcpy((void *)buf, (const void *)(SHRING_DATA(h) + off), first);
+  if (k > first)
+    memcpy((void *)(buf + first), (const void *)SHRING_DATA(h), k - first);
+  h->rpos += k;
+  shim_ring_mark(h);
+  return (int64_t)k;
+}
+
+static int64_t shim_ring_write(long fd, uint64_t buf, uint64_t count) {
+  volatile struct shring *h = shim_ring_find(fd, 1);
+  if (!h || !h->fast_ok || !shim_page_rw) return INT64_MIN;
+  if (h->readers == 0) return INT64_MIN; /* EPIPE + SIGPIPE: worker path */
+  if (count == 0) return 0;
+  uint64_t room = SHRING_CAP - (h->wpos - h->rpos);
+  if (room < count) return INT64_MIN; /* partial/atomic/park: worker */
+  uint64_t off = h->wpos % SHRING_CAP;
+  uint64_t first = SHRING_CAP - off;
+  if (first > count) first = count;
+  memcpy((void *)(SHRING_DATA(h) + off), (const void *)buf, first);
+  if (count > first)
+    memcpy((void *)SHRING_DATA(h), (const void *)(buf + first),
+           count - first);
+  h->wpos += count;
+  shim_ring_mark(h);
+  return (int64_t)count;
+}
+
 /* the child re-reads its real pid from /proc (getpid is trapped and would
  * return the VIRTUAL pid; the cached parent ids are wrong post-fork).
  * raw3 rides the gadget, so this open is IP-allowed native and reads the
@@ -467,6 +580,41 @@ static void sigsys_handler(int signo, siginfo_t *info, void *vctx) {
     g[REG_RAX] = 1;
     return;
   }
+  /* shared-memory pipe fast path (zero round trips when it hits).
+   * Covers vfds AND the trapped stdio fds — a shell pipeline dup2's
+   * pipe ends onto 0/1, and those reads/writes trap (gen_bpf.py READ /
+   * WRITE branches); the mapping's existence is what says "this fd is
+   * currently a ring pipe" (offers ride its service replies, and every
+   * close / dup2-over / close_range drops the entry). */
+  {
+    long fd0 = (long)g[REG_RDI];
+    if (info->si_syscall == SYS_read &&
+        (fd0 == 0 || fd0 >= SHIM_VFD_BASE)) {
+      int64_t r = shim_ring_read(fd0, (uint64_t)g[REG_RSI],
+                                 (uint64_t)g[REG_RDX]);
+      if (r != INT64_MIN) { g[REG_RAX] = (greg_t)r; return; }
+    } else if (info->si_syscall == SYS_write &&
+               (fd0 == 1 || fd0 == 2 || fd0 >= SHIM_VFD_BASE)) {
+      int64_t r = shim_ring_write(fd0, (uint64_t)g[REG_RSI],
+                                  (uint64_t)g[REG_RDX]);
+      if (r != INT64_MIN) { g[REG_RAX] = (greg_t)r; return; }
+    } else if (info->si_syscall == SYS_close) {
+      shim_ring_drop(fd0); /* then forward the close */
+    }
+  }
+  if ((info->si_syscall == SYS_dup2 || info->si_syscall == SYS_dup3) &&
+      (long)g[REG_RSI] != (long)g[REG_RDI])
+    shim_ring_drop((long)g[REG_RSI]); /* newfd implicitly closed
+                                         (dup2(x,x) closes nothing) */
+  if (info->si_syscall == SYS_close_range && !((long)g[REG_RDX] & 4)) {
+    /* CLOSE_RANGE_CLOEXEC (flag 4) marks without closing */
+    for (int i = 0; i < SHIM_RING_MAX; i++)
+      if (shim_rings[i].h && shim_rings[i].vfd >= (long)g[REG_RDI] &&
+          shim_rings[i].vfd <= (long)g[REG_RSI]) {
+        raw3(SYS_munmap, (long)shim_rings[i].h, SHRING_SIZE, 0);
+        shim_rings[i].h = NULL;
+      }
+  }
   if (info->si_syscall == 9) {
     /* mmap of a virtualized file: the worker replies with the real
      * backing fd (host-tree fd or a memfd snapshot of synthesized
@@ -497,6 +645,15 @@ static void sigsys_handler(int signo, siginfo_t *info, void *vctx) {
                         (uint64_t)g[REG_RSI], (uint64_t)g[REG_RDX],
                         (uint64_t)g[REG_R10], (uint64_t)g[REG_R8],
                         (uint64_t)g[REG_R9]);
+  if (ret == SHIM_RET_MAPRING) {
+    /* a ring memfd + role follows, then the real result of this op */
+    int64_t role = 0;
+    int mfd = shim_recv_fd(&role);
+    if (mfd >= 0) shim_ring_install((long)g[REG_RDI], (int)role, mfd);
+    int64_t fin = -EPIPE;
+    if (read_all(&fin, sizeof fin) != 0) fin = -EPIPE;
+    ret = fin;
+  }
   if (ret == SHIM_RET_NATIVE) {
     /* the worker chose passthrough for this one (virtual-FS policy) */
     shim_gadget_fn reissue = shim_gadget ? shim_gadget : raw6_asm;
@@ -1184,11 +1341,25 @@ __attribute__((constructor)) static void shim_init(void) {
 
   const char *shm = getenv("SHADOW_TIME_SHM");
   if (shm) {
-    int fd = open(shm, O_RDONLY);
+    /* RW: the shim reads the clock AND writes the fast-op counter slot
+     * (shring.h SHIM_PAGE_FASTOPS). Falls back to RO (counter writes
+     * gated on shim_page_rw) if the worker ever hands a sealed fd. */
+    int fd = open(shm, O_RDWR);
     if (fd >= 0) {
-      void *p = mmap(NULL, 4096, PROT_READ, MAP_SHARED, fd, 0);
-      if (p != MAP_FAILED) shim_time_page = (volatile int64_t *)p;
+      void *p = mmap(NULL, 4096, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+      if (p != MAP_FAILED) {
+        shim_time_page = (volatile int64_t *)p;
+        shim_page_rw = 1;
+      }
       close(fd);
+    }
+    if (!shim_time_page) {
+      fd = open(shm, O_RDONLY);
+      if (fd >= 0) {
+        void *p = mmap(NULL, 4096, PROT_READ, MAP_SHARED, fd, 0);
+        if (p != MAP_FAILED) shim_time_page = (volatile int64_t *)p;
+        close(fd);
+      }
     }
   }
 

@@ -1,0 +1,58 @@
+/* shring.h — the shared-memory pipe ring (worker <-> guest shim).
+ *
+ * Reference analog: upstream Shadow's shared-memory data channel
+ * (SURVEY.md §2 "Shmem allocator" / shim-side syscall service, §3.3
+ * latency budget): the byte buffer behind an emulated pipe lives in a
+ * memfd mapped into BOTH the Python worker and the guest process, so the
+ * shim services non-blocking pipe reads/writes entirely locally — zero
+ * worker round trips — and only blocking edges (empty read, full or
+ * atomic-split write, EOF/EPIPE) forward to the worker.
+ *
+ * Concurrency: none needed. Strict turn-taking means exactly one of
+ * {worker, any guest thread} runs at any instant, globally; all fields
+ * are plain loads/stores (volatile keeps the compiler honest across the
+ * blocking boundaries).
+ *
+ * Layout: one 4 KiB header page + SHRING_CAP data bytes. rpos/wpos are
+ * free-running u64 byte counters (data index = pos % SHRING_CAP).
+ */
+#ifndef SHRING_H
+#define SHRING_H
+
+#include <stdint.h>
+
+#define SHRING_MAGIC 0x53524E47u /* "SRNG" */
+#define SHRING_CAP 65536
+#define SHRING_PIPE_BUF 4096 /* POSIX atomic-write bound (worker twin) */
+
+struct shring {
+  volatile uint32_t magic;
+  volatile uint32_t cap; /* == SHRING_CAP (layout check) */
+  volatile uint64_t rpos;
+  volatile uint64_t wpos;
+  /* maintained by the worker (end refcounts; EPIPE/EOF decisions) */
+  volatile uint32_t readers;
+  volatile uint32_t writers;
+  /* worker sets when a thread/poller parks on this pipe; the shim then
+   * marks dirty on every local op so the worker's wake scan is O(dirty) */
+  volatile uint32_t has_waiters;
+  volatile uint32_t dirty;
+  /* worker gate: 0 disables shim-local service (strace mode,
+   * model_unblocked_syscall_latency, teardown) */
+  volatile uint32_t fast_ok;
+  uint32_t pad0;
+  /* shim-local ops on THIS ring (worker folds into per-pipe stats) */
+  volatile uint64_t shim_ops;
+};
+
+#define SHRING_HDR 4096
+#define SHRING_SIZE (SHRING_HDR + SHRING_CAP)
+#define SHRING_DATA(h) ((volatile uint8_t *)(h) + SHRING_HDR)
+
+/* clock-page extension: slot [2] counts shim-local fast ops process-wide
+ * (the worker compares it against its last fold to decide whether any
+ * ring needs a wake scan; doubles as the serviced-syscall count delta).
+ * Slots [0]=emulated ns, [1]=virtual pid (native/identity.py). */
+#define SHIM_PAGE_FASTOPS 2
+
+#endif /* SHRING_H */

@@ -1112,3 +1112,99 @@ def test_exec_from_non_main_thread_managed():
     out = Path("/tmp/st-threadexec/hosts/box/thread_exec.0.stdout").read_text()
     assert out.count("elapsed_ms=250") == 3, out
     assert "ok" in out
+
+
+# ---- shared-memory pipe rings (native/shring.h, round 5) ------------------
+
+PUMP_CFG = f"""
+general:
+  stop_time: 10s
+  seed: 5
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        edge [ source 0 target 0 latency "5 ms" ]
+      ]
+hosts:
+  box:
+    network_node_id: 0
+    processes:
+      - path: {BUILD}/pump
+        args: ["2000", "512"]
+        start_time: 1s
+        expected_final_state: {{exited: 0}}
+"""
+
+
+def test_shring_fast_path_engages_and_is_deterministic():
+    """The pump guest's pipe ops ride the guest-shared memory ring: the
+    shim services them locally (shim_fast_syscalls counts them), the
+    data is intact (pump checksums every chunk), and two runs match."""
+    sums = []
+    for tag in ("a", "b"):
+        cfg = parse_config(yaml.safe_load(PUMP_CFG), {
+            "general.data_directory": f"/tmp/st-shring-{tag}"})
+        c = Controller(cfg, mirror_log=False)
+        result = c.run()
+        assert result["process_errors"] == [], result["process_errors"]
+        fast = result["counters"].get("shim_fast_syscalls", 0)
+        # 2000 iterations x (write + read), minus the two offer trips
+        assert fast >= 3900, f"ring fast path barely engaged: {fast}"
+        out = Path(f"/tmp/st-shring-{tag}/hosts/box/pump.0.stdout"
+                   ).read_text()
+        assert "pump-ok iters=2000" in out, out
+        sums.append((out, result["counters"]))
+    assert sums[0] == sums[1]
+
+
+def test_shring_disabled_under_strace():
+    """strace mode must see every syscall: ring pipes are not minted and
+    everything goes through the worker."""
+    cfg = parse_config(yaml.safe_load(PUMP_CFG), {
+        "general.data_directory": "/tmp/st-shring-strace",
+        "experimental.strace_logging_mode": "standard"})
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    assert result["counters"].get("shim_fast_syscalls", 0) == 0
+    st = Path("/tmp/st-shring-strace/hosts/box/pump.0.strace").read_text()
+    assert st.count("syscall_1(") >= 2000, "strace must log every pipe write"
+
+
+def test_shring_cross_process_pipeline():
+    """A fork-pipe guest (parent writes, child reads across processes)
+    stays correct with ring-backed pipes: the parked reader is woken by
+    the writer's shim-local data at its next trap."""
+    cfg_text = SLEEP_CFG.replace("sleep_clock", "fork_pipe")
+    cfg = parse_config(yaml.safe_load(cfg_text), {
+        "general.data_directory": "/tmp/st-shring-fork"})
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    out = Path("/tmp/st-shring-fork/hosts/box/fork_pipe.0.stdout").read_text()
+    assert "fork-complete child=40000" in out, out
+
+
+def test_shring_stdio_pipeline_fast_path():
+    """A real shell pipeline (pipe ends dup2'd onto stdio, stages
+    fork+exec'd): the ring mapping follows the stdio fds, the exec'd
+    stages get their own clock pages (round-5 fix: fork-child records
+    used to exec with SHADOW_TIME_SHM=None), and a large fraction of the
+    data-plane ops run shim-local."""
+    cfg_text = SLEEP_CFG.replace(
+        f"path: {BUILD}/sleep_clock",
+        'path: /bin/sh\n        args: ["-c", '
+        '"head -c 400000 /dev/zero | wc -c"]')
+    cfg = parse_config(yaml.safe_load(cfg_text), {
+        "general.data_directory": "/tmp/st-shring-pipeline"})
+    c = Controller(cfg, mirror_log=False)
+    result = c.run()
+    assert result["process_errors"] == [], result["process_errors"]
+    fast = result["counters"].get("shim_fast_syscalls", 0)
+    assert fast >= 50, f"stdio pipeline fast path barely engaged: {fast}"
+    out = Path("/tmp/st-shring-pipeline/hosts/box/sh.f1.stdout").read_text()
+    assert out.strip() == "400000", out
