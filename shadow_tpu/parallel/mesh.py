@@ -47,6 +47,7 @@ the table rides ICI, not HBM-resident state; per-shard bucket state is O(H/N).
 
 from __future__ import annotations
 
+import time as _walltime
 from functools import partial
 
 import numpy as np
@@ -282,6 +283,9 @@ class MeshDataPlane:
         self.units_per_shard = int(units_per_shard)
         self.mesh = Mesh(np.array(devices[:n]), (AXIS,))
         self.params = params
+        #: per-window wall attribution for the collective (exchange_rounds)
+        self.phase = {"build": 0.0, "dispatch": 0.0, "readback": 0.0,
+                      "windows": 0}
 
         h = params.rate_up.shape[0]
         self.h_pad = -(-h // n) * n
@@ -429,7 +433,9 @@ class MeshDataPlane:
             return out
         i = 0
         step = self.EXCHANGE_BUCKETS[-1]
+        ph = self.phase
         while i < total:
+            t0 = _walltime.perf_counter()
             j = min(total, i + step)
             sl = slice(i, j)
             sh = np.asarray(src[sl], dtype=np.int64) % n
@@ -453,9 +459,25 @@ class MeshDataPlane:
                 packed[3, shs, rank] = np.asarray(npk[sl], np.int64)[order]
                 packed[4, shs, rank] = np.asarray(th[sl], np.int64)[order]
                 packed[5, shs, rank] = 1
-            recv, _gmin = self._get_exchange(m, w)(
+            t1 = _walltime.perf_counter()
+            handle = self._get_exchange(m, w)(
                 tuple(jnp.asarray(packed[k]) for k in range(6)))
+            # async dispatch: without this barrier the device's execution
+            # wall would land in the readback bucket and the published
+            # attribution would blame the wrong phase
+            jax.block_until_ready(handle)
+            t2 = _walltime.perf_counter()
+            recv, _gmin = handle
             out.append(np.asarray(recv).reshape(-1, 4))
+            t3 = _walltime.perf_counter()
+            # per-window wall attribution (VERDICT r4 item #7): host-side
+            # build/compact vs program dispatch vs result readback —
+            # published per shard count so the 4/8-shard tail-off is
+            # evidence, not assertion
+            ph["build"] += t1 - t0
+            ph["dispatch"] += t2 - t1
+            ph["readback"] += t3 - t2
+            ph["windows"] += 1
             i = j
         return out
 
