@@ -1984,6 +1984,7 @@ static PyObject *Core_fold_counters(CoreObject *c, PyObject *noarg) {
 
 static PyObject *Core_make_endpoint(CoreObject *c, PyObject *args);
 static PyObject *Core_relay_new(CoreObject *c, PyObject *args);
+static PyObject *Core_tor_client_sink(CoreObject *c, PyObject *args);
 
 static PyMethodDef Core_methods[] = {
     {"barrier", (PyCFunction)Core_barrier, METH_VARARGS,
@@ -2013,6 +2014,8 @@ static PyMethodDef Core_methods[] = {
      "(hid, lport, rhost, rport, initiator, sbuf, rbuf) -> Endpoint"},
     {"relay_new", (PyCFunction)Core_relay_new, METH_VARARGS,
      "(hid, on_ctrl) -> Relay (C tor-relay data path)"},
+    {"tor_client_sink", (PyCFunction)Core_tor_client_sink, METH_VARARGS,
+     "(endpoint, on_cell) -> TorSink (C tor-client data path)"},
     {NULL, NULL, 0, NULL}};
 
 static PyTypeObject Core_Type = {
@@ -2118,6 +2121,10 @@ typedef struct CEp {
   /* C fast sink: when set, data delivery / drain / close route to the
    * C relay machinery instead of the Python callbacks */
   struct CRelayConn *sink;
+  /* C tor-client sink (borrowed back-pointer; the sink owns the ep):
+   * terminal frame parsing + DATA-body byte counting in C, one Python
+   * callback per CONTROL cell (models/tor.py TorClient twin) */
+  struct CTorSink *tsink;
   /* C tgen app (models/tgen.py twin; same opt-in style as the relay
    * sink): 0 = none, 1 = server (parse the 8-byte ASCII request, push
    * counted bytes), 2 = client (count received bytes, fire tgen_cb at
@@ -2139,6 +2146,10 @@ static int relay_drain(struct CRelayConn *rc, int64_t now);
 static int relay_conn_closed(struct CRelayConn *rc);
 
 static CHost *cep_h(CEp *e) { return &e->core->hs[e->hid]; }
+
+struct CTorSink;
+static int tsink_feed(struct CTorSink *s, int64_t nbytes,
+                      PyObject *payload);
 
 /* current sim clock of the owning host: used by timer-driven entry
  * points; row-driven entry points pass `now` explicitly */
@@ -2485,6 +2496,8 @@ static int cr_deliver(CEp *e, int64_t now, int64_t nbytes,
                       PyObject *payload) {
   e->rcv_nxt += nbytes;
   e->bytes_received += nbytes;
+  if (e->tsink)
+    return tsink_feed(e->tsink, nbytes, payload);
   if (e->tgen_mode == 2) {
     e->tgen_pending += nbytes;
     if (e->tgen_pending >= e->tgen_want && e->tgen_cb &&
@@ -2570,6 +2583,7 @@ static int ce_drop(CEp *e) {
   if (ce_cancel_ctl(e) < 0) return -1;
   if (cep_cancel_timer(e, &e->rto_timer) < 0) return -1;
   e->state = ST_CLOSED;
+  e->tsink = NULL; /* borrowed back-pointer; the sink still owns us */
   /* host.drop_endpoint twin: pop our four-tuple from the cached
    * identity-stable host._conns dict */
   PyObject *conns = cep_h(e)->conns;
@@ -3892,6 +3906,159 @@ static PyObject *Core_relay_new(CoreObject *c, PyObject *args) {
   return (PyObject *)r;
 }
 
+/* ======================================================================
+ * C tor-client sink (models/tor.py TorClient data path).
+ *
+ * The client's steady state is receiving a stream of DATA cells +
+ * counted bodies through its guard connection; the Python model only
+ * needs to see CONTROL cells (CREATED/EXTENDED during telescoping,
+ * CONNECTED, END at completion) — a handful per circuit. This sink
+ * owns the frame parsing and body-byte counting in C and calls
+ * on_cell(ctype, circ, payload, bytes_received) for control cells
+ * only. At tor_100k scale (100,000 clients) this removes the per-chunk
+ * Python FrameReader cost the same way the relay data path did for
+ * relays. Exits (TorExit) keep the full Python model (declared gap).
+ * ====================================================================== */
+
+typedef struct CTorSink {
+  PyObject_HEAD
+  CEp *ep;            /* owned; ep->tsink is the borrowed back-pointer */
+  PyObject *on_cell;  /* owned: callable(ctype, circ, payload, got) */
+  char *buf;
+  int64_t buf_len, buf_cap;
+  int64_t body_left;
+  int64_t got; /* counted DATA body bytes received (circuit-agnostic,
+                  like the Python twin's on_body) */
+} CTorSink;
+
+static PyTypeObject CTorSink_Type;
+
+static int tsink_feed(CTorSink *s, int64_t nbytes, PyObject *payload) {
+  if (s->body_left > 0 && (!payload || payload == Py_None)) {
+    int64_t take = nbytes < s->body_left ? nbytes : s->body_left;
+    s->body_left -= take;
+    s->got += take;
+    if (nbytes > take) {
+      PyErr_SetString(PyExc_ValueError,
+                      "framing error: stray counted bytes");
+      return -1;
+    }
+    return 0;
+  }
+  if (!payload || payload == Py_None) {
+    PyErr_SetString(PyExc_ValueError,
+                    "framing error: counted bytes outside DATA body");
+    return -1;
+  }
+  char *pb;
+  Py_ssize_t pn;
+  if (PyBytes_AsStringAndSize(payload, &pb, &pn) < 0) return -1;
+  if (s->buf_len + pn > s->buf_cap) {
+    int64_t ncap = s->buf_cap ? s->buf_cap * 2 : 256;
+    while (ncap < s->buf_len + pn) ncap *= 2;
+    char *nb = realloc(s->buf, (size_t)ncap);
+    if (!nb) { PyErr_NoMemory(); return -1; }
+    s->buf = nb;
+    s->buf_cap = ncap;
+  }
+  memcpy(s->buf + s->buf_len, pb, (size_t)pn);
+  s->buf_len += pn;
+  int64_t off = 0;
+  int rcod = 0;
+  Py_INCREF(s); /* the callback may drop the model's last reference */
+  while (s->buf_len - off >= TCELL_HDR) {
+    unsigned char *b = (unsigned char *)s->buf + off;
+    int ctype = b[0];
+    int circ = ((int)b[1] << 8) | b[2];
+    int64_t ln = ((int64_t)b[3] << 8) | b[4];
+    if (ctype == TC_DATA) {
+      off += TCELL_HDR;
+      s->body_left = ln;
+      break; /* counted body follows in subsequent chunks */
+    }
+    if (s->buf_len - off < TCELL_HDR + ln) break;
+    PyObject *pl = PyBytes_FromStringAndSize(s->buf + off + TCELL_HDR,
+                                             (Py_ssize_t)ln);
+    if (!pl) { rcod = -1; break; }
+    PyObject *r = PyObject_CallFunction(s->on_cell, "iiNL", ctype, circ,
+                                        pl, (long long)s->got);
+    if (!r) { rcod = -1; break; }
+    Py_DECREF(r);
+    off += TCELL_HDR + ln;
+  }
+  if (off && rcod == 0) {
+    memmove(s->buf, s->buf + off, (size_t)(s->buf_len - off));
+    s->buf_len -= off;
+  }
+  Py_DECREF(s);
+  return rcod;
+}
+
+static int CTorSink_traverse(CTorSink *s, visitproc visit, void *arg) {
+  Py_VISIT(s->ep);
+  Py_VISIT(s->on_cell);
+  return 0;
+}
+
+static int CTorSink_clear_gc(CTorSink *s) {
+  if (s->ep && s->ep->tsink == s) s->ep->tsink = NULL;
+  Py_CLEAR(s->ep);
+  Py_CLEAR(s->on_cell);
+  return 0;
+}
+
+static void CTorSink_dealloc(CTorSink *s) {
+  PyObject_GC_UnTrack(s);
+  if (s->ep && s->ep->tsink == s) s->ep->tsink = NULL;
+  Py_XDECREF(s->ep);
+  Py_XDECREF(s->on_cell);
+  free(s->buf);
+  Py_TYPE(s)->tp_free((PyObject *)s);
+}
+
+static PyObject *CTorSink_bytes_received(CTorSink *s, PyObject *noarg) {
+  (void)noarg;
+  return PyLong_FromLongLong(s->got);
+}
+
+static PyMethodDef CTorSink_methods[] = {
+    {"bytes_received", (PyCFunction)CTorSink_bytes_received, METH_NOARGS,
+     "counted DATA body bytes received so far"},
+    {NULL, NULL, 0, NULL}};
+
+static PyTypeObject CTorSink_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0).tp_name = "_colcore.TorSink",
+    .tp_basicsize = sizeof(CTorSink),
+    .tp_dealloc = (destructor)CTorSink_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC,
+    .tp_traverse = (traverseproc)CTorSink_traverse,
+    .tp_clear = (inquiry)CTorSink_clear_gc,
+    .tp_methods = CTorSink_methods,
+    .tp_free = PyObject_GC_Del,
+    .tp_doc = "C tor-client frame sink (models/tor.py TorClient twin)",
+};
+
+static PyObject *Core_tor_client_sink(CoreObject *c, PyObject *args) {
+  (void)c;
+  PyObject *ep_o, *on_cell;
+  if (!PyArg_ParseTuple(args, "OO", &ep_o, &on_cell)) return NULL;
+  if (Py_TYPE(ep_o) != &CEp_Type) {
+    PyErr_SetString(PyExc_TypeError, "tor_client_sink expects a C endpoint");
+    return NULL;
+  }
+  CTorSink *s = PyObject_GC_New(CTorSink, &CTorSink_Type);
+  if (!s) return NULL;
+  memset(((char *)s) + sizeof(PyObject), 0,
+         sizeof(CTorSink) - sizeof(PyObject));
+  Py_INCREF(ep_o);
+  s->ep = (CEp *)ep_o;
+  Py_INCREF(on_cell);
+  s->on_cell = on_cell;
+  s->ep->tsink = s;
+  PyObject_GC_Track((PyObject *)s);
+  return (PyObject *)s;
+}
+
 /* ---- module ------------------------------------------------------------ */
 static PyObject *mod_unit_dropped(PyObject *self, PyObject *args) {
   (void)self;
@@ -3978,7 +4145,8 @@ PyMODINIT_FUNC PyInit__colcore(void) {
   if (!O_zero || !O_one || !O_kind_dgram || !O_kind_loss) return NULL;
   if (PyType_Ready(&Core_Type) < 0 || PyType_Ready(&GossipState_Type) < 0
       || PyType_Ready(&CEp_Type) < 0 || PyType_Ready(&CRelay_Type) < 0
-      || PyType_Ready(&CBatch_Type) < 0)
+      || PyType_Ready(&CBatch_Type) < 0
+      || PyType_Ready(&CTorSink_Type) < 0)
     return NULL;
   PyObject *m = PyModule_Create(&colcore_module);
   if (!m) return NULL;

@@ -137,6 +137,24 @@ class _Conn:
             self.ep.on_drain = pump_then_close
 
 
+class _WriteConn:
+    """The write half of _Conn, for connections whose READ side is
+    served by the C engine (TorSink): the bounded-send pending queue +
+    on_drain pump, with nothing wired to on_data."""
+
+    __slots__ = ("ep", "pending", "sink")
+
+    def __init__(self, ep):
+        self.ep = ep
+        self.pending = []
+        self.sink = None  # the C TorSink, kept alive with the connection
+        ep.on_drain = lambda room: self._pump()
+
+    write = _Conn.write
+    write_counted = _Conn.write_counted
+    _pump = _Conn._pump
+
+
 class TorRelay:
     """args: [or_port]"""
 
@@ -353,7 +371,6 @@ class TorClient:
         self.attempted += 1
         t0 = api.now
         circ = 1
-        got = {"n": 0}
         state = {"stage": 0}  # hops established so far (guard = 1)
 
         ep = api.connect(hops[0], self.relay_port)
@@ -368,28 +385,50 @@ class TorClient:
                     BEGIN, circ,
                     f"{self.server}:{self.server_port}:{self.size}".encode()))
 
-        def on_cell(ctype, c, payload):
+        def finish_fetch(got):
+            elapsed = api.now - t0
+            if got >= self.size:
+                self.completed += 1
+                self.completion_times.append(elapsed)
+                api.log(f"circuit-complete hops={hops} bytes={got} "
+                        f"elapsed_ms={elapsed // 1_000_000}")
+            else:
+                self.failed += 1
+            conn.ep.close()
+            self._finish()
+
+        def on_ctrl(ctype, got):
             if ctype in (CREATED, EXTENDED):
                 state["stage"] += 1
                 if state["stage"] == 3:  # telescoping done; BEGIN follows
                     self.build_times.append(api.now - t0)
                 advance()
             elif ctype == END:
-                elapsed = api.now - t0
-                if got["n"] >= self.size:
-                    self.completed += 1
-                    self.completion_times.append(elapsed)
-                    api.log(f"circuit-complete hops={hops} bytes={got['n']} "
-                            f"elapsed_ms={elapsed // 1_000_000}")
-                else:
-                    self.failed += 1
-                conn.ep.close()
-                self._finish()
+                finish_fetch(got)
 
-        def on_body(c, nbytes):
-            got["n"] += nbytes
+        host = getattr(api, "_host", None)
+        core = getattr(getattr(host, "colplane", None), "_c", None)
+        make_sink = getattr(core, "tor_client_sink", None)
+        if make_sink is not None and host.pcap is None:
+            # C-engine endpoint: frame parsing + DATA-body byte counting
+            # run in native/colcore (TorSink); only control cells — a
+            # handful per circuit — reach Python. The writer side keeps
+            # the Python pending queue (telescoping cells are tiny and
+            # rare). Exact twin of the closures below.
+            conn = _WriteConn(ep)
+            sink = make_sink(
+                ep, lambda ctype, c, payload, got: on_ctrl(ctype, got))
+            conn.sink = sink  # keep the sink alive with the connection
+        else:
+            got = {"n": 0}
 
-        conn = _Conn(ep, on_cell, on_body)
+            def on_cell(ctype, c, payload):
+                on_ctrl(ctype, got["n"])
+
+            def on_body(c, nbytes):
+                got["n"] += nbytes
+
+            conn = _Conn(ep, on_cell, on_body)
 
         def on_connected(now):
             conn.write(cell(CREATE, circ))
