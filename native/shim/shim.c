@@ -441,11 +441,11 @@ static long shim_do_fork(uint64_t nr, greg_t *g) {
 
 /* BEGIN GENERATED EMU BITMAP (tools/gen_bpf.py) */
 static const uint8_t shim_emu_bitmap[64] = {
-    0xd4, 0x40, 0xe0, 0x00, 0x8a, 0xfe, 0xff, 0xef,
-    0x00, 0x90, 0xbd, 0x02, 0x1d, 0x40, 0x00, 0x00,
-    0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x04,
-    0x00, 0x16, 0x20, 0x00, 0xf0, 0x03, 0x00, 0x00,
-    0xc6, 0xe9, 0x00, 0xda, 0x3d, 0x00, 0x00, 0x50,
+    0xd4, 0x40, 0xe0, 0x00, 0x8a, 0xff, 0xff, 0xef,
+    0x00, 0x90, 0xbd, 0x02, 0x1f, 0x40, 0x00, 0x00,
+    0x08, 0x00, 0x00, 0x00, 0x01, 0x00, 0x00, 0x04,
+    0x00, 0x16, 0x20, 0x00, 0xf0, 0x03, 0x00, 0xe0,
+    0xc6, 0xe9, 0x18, 0xde, 0x7f, 0x40, 0x00, 0x50,
     0x00, 0x10, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00,
     0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x98, 0x00,
     0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00,
@@ -1071,97 +1071,110 @@ void pthread_exit(void *retval) {
 
 static int install_seccomp(void) {
   /* BEGIN GENERATED BPF (tools/gen_bpf.py) */
-  struct sock_filter prog[] = {  /* 119 instructions */
+  struct sock_filter prog[] = {  /* 132 instructions */
       LD(BPF_ARCHF),
-      JEQ(AUDIT_ARCH_X86_64, 0, 116),
+      JEQ(AUDIT_ARCH_X86_64, 0, 129),
       LD(BPF_IPHI),
       JEQ((uint32_t)((uintptr_t)SHIM_GADGET_ADDR >> 32), 0, 3),
       LD(BPF_IPLO),
       JGE((uint32_t)(uintptr_t)SHIM_GADGET_ADDR, 0, 1),
-      JGE(((uint32_t)(uintptr_t)SHIM_GADGET_ADDR + 4096), 0, 111),
+      JGE(((uint32_t)(uintptr_t)SHIM_GADGET_ADDR + 4096), 0, 124),
       LD(BPF_NR),
-      JEQ(0, 85, 0),  /* read */
-      JEQ(1, 89, 0),  /* write */
-      JEQ(3, 98, 0),  /* close */
-      JEQ(19, 82, 0),  /* readv */
-      JEQ(20, 86, 0),  /* writev */
-      JEQ(16, 100, 0),  /* ioctl */
-      JEQ(72, 99, 0),  /* fcntl */
-      JEQ(32, 98, 0),  /* dup */
-      JEQ(5, 97, 0),  /* fstat */
-      JEQ(8, 96, 0),  /* lseek */
-      JEQ(217, 95, 0),  /* getdents64 */
-      JEQ(77, 94, 0),  /* ftruncate */
-      JEQ(74, 93, 0),  /* fsync */
-      JEQ(75, 92, 0),  /* fdatasync */
-      JEQ(81, 91, 0),  /* fchdir */
-      JEQ(17, 90, 0),  /* pread64 */
-      JEQ(18, 89, 0),  /* pwrite64 */
-      JEQ(9, 86, 0),  /* mmap */
-      JEQ(35, 90, 0),  /* nanosleep */
-      JEQ(230, 89, 0),  /* clock_nanosleep */
-      JEQ(228, 88, 0),  /* clock_gettime */
-      JEQ(96, 87, 0),  /* gettimeofday */
-      JEQ(201, 86, 0),  /* time */
-      JEQ(318, 85, 0),  /* getrandom */
-      JEQ(7, 84, 0),  /* poll */
-      JEQ(271, 83, 0),  /* ppoll */
-      JEQ(213, 82, 0),  /* epoll_create */
-      JEQ(291, 81, 0),  /* epoll_create1 */
-      JEQ(233, 80, 0),  /* epoll_ctl */
-      JEQ(232, 79, 0),  /* epoll_wait */
-      JEQ(281, 78, 0),  /* epoll_pwait */
-      JEQ(288, 77, 0),  /* accept4 */
-      JEQ(435, 76, 0),  /* clone3 */
-      JEQ(39, 75, 0),  /* getpid */
-      JEQ(110, 74, 0),  /* getppid */
-      JEQ(186, 73, 0),  /* gettid */
-      JEQ(283, 72, 0),  /* timerfd_create */
-      JEQ(286, 71, 0),  /* timerfd_settime */
-      JEQ(287, 70, 0),  /* timerfd_gettime */
-      JEQ(284, 69, 0),  /* eventfd */
-      JEQ(290, 68, 0),  /* eventfd2 */
-      JEQ(202, 67, 0),  /* futex */
-      JEQ(14, 66, 0),  /* rt_sigprocmask */
-      JEQ(22, 65, 0),  /* pipe */
-      JEQ(293, 64, 0),  /* pipe2 */
-      JEQ(61, 63, 0),  /* wait4 */
-      JEQ(231, 62, 0),  /* exit_group */
-      JEQ(436, 61, 0),  /* close_range */
-      JEQ(23, 60, 0),  /* select */
-      JEQ(270, 59, 0),  /* pselect6 */
-      JEQ(62, 58, 0),  /* kill */
-      JEQ(63, 57, 0),  /* uname */
-      JEQ(100, 56, 0),  /* times */
-      JEQ(229, 55, 0),  /* clock_getres */
-      JEQ(204, 54, 0),  /* sched_getaffinity */
-      JEQ(99, 53, 0),  /* sysinfo */
-      JEQ(98, 52, 0),  /* getrusage */
-      JEQ(2, 51, 0),  /* open */
-      JEQ(257, 50, 0),  /* openat */
-      JEQ(85, 49, 0),  /* creat */
-      JEQ(4, 48, 0),  /* stat */
-      JEQ(6, 47, 0),  /* lstat */
-      JEQ(332, 46, 0),  /* statx */
-      JEQ(21, 45, 0),  /* access */
-      JEQ(269, 44, 0),  /* faccessat */
-      JEQ(439, 43, 0),  /* faccessat2 */
-      JEQ(262, 42, 0),  /* newfstatat */
-      JEQ(87, 41, 0),  /* unlink */
-      JEQ(263, 40, 0),  /* unlinkat */
-      JEQ(83, 39, 0),  /* mkdir */
-      JEQ(258, 38, 0),  /* mkdirat */
-      JEQ(84, 37, 0),  /* rmdir */
-      JEQ(82, 36, 0),  /* rename */
-      JEQ(264, 35, 0),  /* renameat */
-      JEQ(316, 34, 0),  /* renameat2 */
-      JEQ(89, 33, 0),  /* readlink */
-      JEQ(267, 32, 0),  /* readlinkat */
-      JEQ(80, 31, 0),  /* chdir */
-      JEQ(79, 30, 0),  /* getcwd */
-      JEQ(76, 29, 0),  /* truncate */
-      JEQ(33, 28, 0),  /* dup2 */
-      JEQ(292, 27, 0),  /* dup3 */
+      JEQ(0, 98, 0),  /* read */
+      JEQ(1, 102, 0),  /* write */
+      JEQ(3, 111, 0),  /* close */
+      JEQ(19, 95, 0),  /* readv */
+      JEQ(20, 99, 0),  /* writev */
+      JEQ(16, 113, 0),  /* ioctl */
+      JEQ(72, 112, 0),  /* fcntl */
+      JEQ(32, 111, 0),  /* dup */
+      JEQ(5, 110, 0),  /* fstat */
+      JEQ(8, 109, 0),  /* lseek */
+      JEQ(217, 108, 0),  /* getdents64 */
+      JEQ(77, 107, 0),  /* ftruncate */
+      JEQ(74, 106, 0),  /* fsync */
+      JEQ(75, 105, 0),  /* fdatasync */
+      JEQ(81, 104, 0),  /* fchdir */
+      JEQ(17, 103, 0),  /* pread64 */
+      JEQ(18, 102, 0),  /* pwrite64 */
+      JEQ(9, 99, 0),  /* mmap */
+      JEQ(35, 103, 0),  /* nanosleep */
+      JEQ(230, 102, 0),  /* clock_nanosleep */
+      JEQ(228, 101, 0),  /* clock_gettime */
+      JEQ(96, 100, 0),  /* gettimeofday */
+      JEQ(201, 99, 0),  /* time */
+      JEQ(318, 98, 0),  /* getrandom */
+      JEQ(7, 97, 0),  /* poll */
+      JEQ(271, 96, 0),  /* ppoll */
+      JEQ(213, 95, 0),  /* epoll_create */
+      JEQ(291, 94, 0),  /* epoll_create1 */
+      JEQ(233, 93, 0),  /* epoll_ctl */
+      JEQ(232, 92, 0),  /* epoll_wait */
+      JEQ(281, 91, 0),  /* epoll_pwait */
+      JEQ(288, 90, 0),  /* accept4 */
+      JEQ(435, 89, 0),  /* clone3 */
+      JEQ(39, 88, 0),  /* getpid */
+      JEQ(110, 87, 0),  /* getppid */
+      JEQ(186, 86, 0),  /* gettid */
+      JEQ(283, 85, 0),  /* timerfd_create */
+      JEQ(286, 84, 0),  /* timerfd_settime */
+      JEQ(287, 83, 0),  /* timerfd_gettime */
+      JEQ(284, 82, 0),  /* eventfd */
+      JEQ(290, 81, 0),  /* eventfd2 */
+      JEQ(202, 80, 0),  /* futex */
+      JEQ(14, 79, 0),  /* rt_sigprocmask */
+      JEQ(22, 78, 0),  /* pipe */
+      JEQ(293, 77, 0),  /* pipe2 */
+      JEQ(61, 76, 0),  /* wait4 */
+      JEQ(231, 75, 0),  /* exit_group */
+      JEQ(436, 74, 0),  /* close_range */
+      JEQ(23, 73, 0),  /* select */
+      JEQ(270, 72, 0),  /* pselect6 */
+      JEQ(62, 71, 0),  /* kill */
+      JEQ(63, 70, 0),  /* uname */
+      JEQ(100, 69, 0),  /* times */
+      JEQ(229, 68, 0),  /* clock_getres */
+      JEQ(204, 67, 0),  /* sched_getaffinity */
+      JEQ(99, 66, 0),  /* sysinfo */
+      JEQ(98, 65, 0),  /* getrusage */
+      JEQ(2, 64, 0),  /* open */
+      JEQ(257, 63, 0),  /* openat */
+      JEQ(85, 62, 0),  /* creat */
+      JEQ(4, 61, 0),  /* stat */
+      JEQ(6, 60, 0),  /* lstat */
+      JEQ(332, 59, 0),  /* statx */
+      JEQ(21, 58, 0),  /* access */
+      JEQ(269, 57, 0),  /* faccessat */
+      JEQ(439, 56, 0),  /* faccessat2 */
+      JEQ(262, 55, 0),  /* newfstatat */
+      JEQ(87, 54, 0),  /* unlink */
+      JEQ(263, 53, 0),  /* unlinkat */
+      JEQ(83, 52, 0),  /* mkdir */
+      JEQ(258, 51, 0),  /* mkdirat */
+      JEQ(84, 50, 0),  /* rmdir */
+      JEQ(82, 49, 0),  /* rename */
+      JEQ(264, 48, 0),  /* renameat */
+      JEQ(316, 47, 0),  /* renameat2 */
+      JEQ(89, 46, 0),  /* readlink */
+      JEQ(267, 45, 0),  /* readlinkat */
+      JEQ(80, 44, 0),  /* chdir */
+      JEQ(79, 43, 0),  /* getcwd */
+      JEQ(76, 42, 0),  /* truncate */
+      JEQ(33, 41, 0),  /* dup2 */
+      JEQ(292, 40, 0),  /* dup3 */
+      JEQ(40, 39, 0),  /* sendfile */
+      JEQ(131, 38, 0),  /* sigaltstack */
+      JEQ(97, 37, 0),  /* getrlimit */
+      JEQ(160, 36, 0),  /* setrlimit */
+      JEQ(302, 35, 0),  /* prlimit64 */
+      JEQ(282, 34, 0),  /* signalfd */
+      JEQ(289, 33, 0),  /* signalfd4 */
+      JEQ(275, 32, 0),  /* splice */
+      JEQ(276, 31, 0),  /* tee */
+      JEQ(253, 30, 0),  /* inotify_init */
+      JEQ(294, 29, 0),  /* inotify_init1 */
+      JEQ(254, 28, 0),  /* inotify_add_watch */
+      JEQ(255, 27, 0),  /* inotify_rm_watch */
       JEQ(47, 13, 0),  /* recvmsg */
       JEQ(56, 15, 0),  /* clone */
       JGE(41, 0, 25),  /* socket */
@@ -1192,98 +1205,111 @@ static int install_seccomp(void) {
       RET(SECCOMP_RET_TRAP),
       RET(SECCOMP_RET_ALLOW),
   };
-  struct sock_filter prog_audit[] = {  /* 120 instructions */
+  struct sock_filter prog_audit[] = {  /* 133 instructions */
       LD(BPF_ARCHF),
-      JEQ(AUDIT_ARCH_X86_64, 0, 117),
+      JEQ(AUDIT_ARCH_X86_64, 0, 130),
       LD(BPF_IPHI),
       JEQ((uint32_t)((uintptr_t)SHIM_GADGET_ADDR >> 32), 0, 3),
       LD(BPF_IPLO),
       JGE((uint32_t)(uintptr_t)SHIM_GADGET_ADDR, 0, 1),
-      JGE(((uint32_t)(uintptr_t)SHIM_GADGET_ADDR + 4096), 0, 112),
+      JGE(((uint32_t)(uintptr_t)SHIM_GADGET_ADDR + 4096), 0, 125),
       LD(BPF_NR),
-      JEQ(15, 110, 0),
-      JEQ(0, 85, 0),  /* read */
-      JEQ(1, 89, 0),  /* write */
-      JEQ(3, 98, 0),  /* close */
-      JEQ(19, 82, 0),  /* readv */
-      JEQ(20, 86, 0),  /* writev */
-      JEQ(16, 100, 0),  /* ioctl */
-      JEQ(72, 99, 0),  /* fcntl */
-      JEQ(32, 98, 0),  /* dup */
-      JEQ(5, 97, 0),  /* fstat */
-      JEQ(8, 96, 0),  /* lseek */
-      JEQ(217, 95, 0),  /* getdents64 */
-      JEQ(77, 94, 0),  /* ftruncate */
-      JEQ(74, 93, 0),  /* fsync */
-      JEQ(75, 92, 0),  /* fdatasync */
-      JEQ(81, 91, 0),  /* fchdir */
-      JEQ(17, 90, 0),  /* pread64 */
-      JEQ(18, 89, 0),  /* pwrite64 */
-      JEQ(9, 86, 0),  /* mmap */
-      JEQ(35, 90, 0),  /* nanosleep */
-      JEQ(230, 89, 0),  /* clock_nanosleep */
-      JEQ(228, 88, 0),  /* clock_gettime */
-      JEQ(96, 87, 0),  /* gettimeofday */
-      JEQ(201, 86, 0),  /* time */
-      JEQ(318, 85, 0),  /* getrandom */
-      JEQ(7, 84, 0),  /* poll */
-      JEQ(271, 83, 0),  /* ppoll */
-      JEQ(213, 82, 0),  /* epoll_create */
-      JEQ(291, 81, 0),  /* epoll_create1 */
-      JEQ(233, 80, 0),  /* epoll_ctl */
-      JEQ(232, 79, 0),  /* epoll_wait */
-      JEQ(281, 78, 0),  /* epoll_pwait */
-      JEQ(288, 77, 0),  /* accept4 */
-      JEQ(435, 76, 0),  /* clone3 */
-      JEQ(39, 75, 0),  /* getpid */
-      JEQ(110, 74, 0),  /* getppid */
-      JEQ(186, 73, 0),  /* gettid */
-      JEQ(283, 72, 0),  /* timerfd_create */
-      JEQ(286, 71, 0),  /* timerfd_settime */
-      JEQ(287, 70, 0),  /* timerfd_gettime */
-      JEQ(284, 69, 0),  /* eventfd */
-      JEQ(290, 68, 0),  /* eventfd2 */
-      JEQ(202, 67, 0),  /* futex */
-      JEQ(14, 66, 0),  /* rt_sigprocmask */
-      JEQ(22, 65, 0),  /* pipe */
-      JEQ(293, 64, 0),  /* pipe2 */
-      JEQ(61, 63, 0),  /* wait4 */
-      JEQ(231, 62, 0),  /* exit_group */
-      JEQ(436, 61, 0),  /* close_range */
-      JEQ(23, 60, 0),  /* select */
-      JEQ(270, 59, 0),  /* pselect6 */
-      JEQ(62, 58, 0),  /* kill */
-      JEQ(63, 57, 0),  /* uname */
-      JEQ(100, 56, 0),  /* times */
-      JEQ(229, 55, 0),  /* clock_getres */
-      JEQ(204, 54, 0),  /* sched_getaffinity */
-      JEQ(99, 53, 0),  /* sysinfo */
-      JEQ(98, 52, 0),  /* getrusage */
-      JEQ(2, 51, 0),  /* open */
-      JEQ(257, 50, 0),  /* openat */
-      JEQ(85, 49, 0),  /* creat */
-      JEQ(4, 48, 0),  /* stat */
-      JEQ(6, 47, 0),  /* lstat */
-      JEQ(332, 46, 0),  /* statx */
-      JEQ(21, 45, 0),  /* access */
-      JEQ(269, 44, 0),  /* faccessat */
-      JEQ(439, 43, 0),  /* faccessat2 */
-      JEQ(262, 42, 0),  /* newfstatat */
-      JEQ(87, 41, 0),  /* unlink */
-      JEQ(263, 40, 0),  /* unlinkat */
-      JEQ(83, 39, 0),  /* mkdir */
-      JEQ(258, 38, 0),  /* mkdirat */
-      JEQ(84, 37, 0),  /* rmdir */
-      JEQ(82, 36, 0),  /* rename */
-      JEQ(264, 35, 0),  /* renameat */
-      JEQ(316, 34, 0),  /* renameat2 */
-      JEQ(89, 33, 0),  /* readlink */
-      JEQ(267, 32, 0),  /* readlinkat */
-      JEQ(80, 31, 0),  /* chdir */
-      JEQ(79, 30, 0),  /* getcwd */
-      JEQ(76, 29, 0),  /* truncate */
-      JEQ(33, 28, 0),  /* dup2 */
-      JEQ(292, 27, 0),  /* dup3 */
+      JEQ(15, 123, 0),
+      JEQ(0, 98, 0),  /* read */
+      JEQ(1, 102, 0),  /* write */
+      JEQ(3, 111, 0),  /* close */
+      JEQ(19, 95, 0),  /* readv */
+      JEQ(20, 99, 0),  /* writev */
+      JEQ(16, 113, 0),  /* ioctl */
+      JEQ(72, 112, 0),  /* fcntl */
+      JEQ(32, 111, 0),  /* dup */
+      JEQ(5, 110, 0),  /* fstat */
+      JEQ(8, 109, 0),  /* lseek */
+      JEQ(217, 108, 0),  /* getdents64 */
+      JEQ(77, 107, 0),  /* ftruncate */
+      JEQ(74, 106, 0),  /* fsync */
+      JEQ(75, 105, 0),  /* fdatasync */
+      JEQ(81, 104, 0),  /* fchdir */
+      JEQ(17, 103, 0),  /* pread64 */
+      JEQ(18, 102, 0),  /* pwrite64 */
+      JEQ(9, 99, 0),  /* mmap */
+      JEQ(35, 103, 0),  /* nanosleep */
+      JEQ(230, 102, 0),  /* clock_nanosleep */
+      JEQ(228, 101, 0),  /* clock_gettime */
+      JEQ(96, 100, 0),  /* gettimeofday */
+      JEQ(201, 99, 0),  /* time */
+      JEQ(318, 98, 0),  /* getrandom */
+      JEQ(7, 97, 0),  /* poll */
+      JEQ(271, 96, 0),  /* ppoll */
+      JEQ(213, 95, 0),  /* epoll_create */
+      JEQ(291, 94, 0),  /* epoll_create1 */
+      JEQ(233, 93, 0),  /* epoll_ctl */
+      JEQ(232, 92, 0),  /* epoll_wait */
+      JEQ(281, 91, 0),  /* epoll_pwait */
+      JEQ(288, 90, 0),  /* accept4 */
+      JEQ(435, 89, 0),  /* clone3 */
+      JEQ(39, 88, 0),  /* getpid */
+      JEQ(110, 87, 0),  /* getppid */
+      JEQ(186, 86, 0),  /* gettid */
+      JEQ(283, 85, 0),  /* timerfd_create */
+      JEQ(286, 84, 0),  /* timerfd_settime */
+      JEQ(287, 83, 0),  /* timerfd_gettime */
+      JEQ(284, 82, 0),  /* eventfd */
+      JEQ(290, 81, 0),  /* eventfd2 */
+      JEQ(202, 80, 0),  /* futex */
+      JEQ(14, 79, 0),  /* rt_sigprocmask */
+      JEQ(22, 78, 0),  /* pipe */
+      JEQ(293, 77, 0),  /* pipe2 */
+      JEQ(61, 76, 0),  /* wait4 */
+      JEQ(231, 75, 0),  /* exit_group */
+      JEQ(436, 74, 0),  /* close_range */
+      JEQ(23, 73, 0),  /* select */
+      JEQ(270, 72, 0),  /* pselect6 */
+      JEQ(62, 71, 0),  /* kill */
+      JEQ(63, 70, 0),  /* uname */
+      JEQ(100, 69, 0),  /* times */
+      JEQ(229, 68, 0),  /* clock_getres */
+      JEQ(204, 67, 0),  /* sched_getaffinity */
+      JEQ(99, 66, 0),  /* sysinfo */
+      JEQ(98, 65, 0),  /* getrusage */
+      JEQ(2, 64, 0),  /* open */
+      JEQ(257, 63, 0),  /* openat */
+      JEQ(85, 62, 0),  /* creat */
+      JEQ(4, 61, 0),  /* stat */
+      JEQ(6, 60, 0),  /* lstat */
+      JEQ(332, 59, 0),  /* statx */
+      JEQ(21, 58, 0),  /* access */
+      JEQ(269, 57, 0),  /* faccessat */
+      JEQ(439, 56, 0),  /* faccessat2 */
+      JEQ(262, 55, 0),  /* newfstatat */
+      JEQ(87, 54, 0),  /* unlink */
+      JEQ(263, 53, 0),  /* unlinkat */
+      JEQ(83, 52, 0),  /* mkdir */
+      JEQ(258, 51, 0),  /* mkdirat */
+      JEQ(84, 50, 0),  /* rmdir */
+      JEQ(82, 49, 0),  /* rename */
+      JEQ(264, 48, 0),  /* renameat */
+      JEQ(316, 47, 0),  /* renameat2 */
+      JEQ(89, 46, 0),  /* readlink */
+      JEQ(267, 45, 0),  /* readlinkat */
+      JEQ(80, 44, 0),  /* chdir */
+      JEQ(79, 43, 0),  /* getcwd */
+      JEQ(76, 42, 0),  /* truncate */
+      JEQ(33, 41, 0),  /* dup2 */
+      JEQ(292, 40, 0),  /* dup3 */
+      JEQ(40, 39, 0),  /* sendfile */
+      JEQ(131, 38, 0),  /* sigaltstack */
+      JEQ(97, 37, 0),  /* getrlimit */
+      JEQ(160, 36, 0),  /* setrlimit */
+      JEQ(302, 35, 0),  /* prlimit64 */
+      JEQ(282, 34, 0),  /* signalfd */
+      JEQ(289, 33, 0),  /* signalfd4 */
+      JEQ(275, 32, 0),  /* splice */
+      JEQ(276, 31, 0),  /* tee */
+      JEQ(253, 30, 0),  /* inotify_init */
+      JEQ(294, 29, 0),  /* inotify_init1 */
+      JEQ(254, 28, 0),  /* inotify_add_watch */
+      JEQ(255, 27, 0),  /* inotify_rm_watch */
       JEQ(47, 13, 0),  /* recvmsg */
       JEQ(56, 15, 0),  /* clone */
       JGE(41, 0, 24),  /* socket */

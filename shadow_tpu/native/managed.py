@@ -61,6 +61,12 @@ MAPRING = -1000001
 SYS_wait4, SYS_exit_group, SYS_pipe, SYS_pipe2 = 61, 231, 22, 293
 SYS_dup, SYS_dup2, SYS_dup3 = 32, 33, 292
 SYS_fstat, SYS_lseek, SYS_newfstatat = 5, 8, 262
+SYS_sendfile, SYS_sigaltstack = 40, 131
+SYS_getrlimit, SYS_setrlimit, SYS_prlimit64 = 97, 160, 302
+SYS_signalfd, SYS_signalfd4 = 282, 289
+SYS_splice, SYS_tee = 275, 276
+SYS_inotify_init, SYS_inotify_add_watch = 253, 254
+SYS_inotify_rm_watch, SYS_inotify_init1 = 255, 294
 # the virtual file surface (native/vfs.py)
 SYS_pread64, SYS_pwrite64 = 17, 18
 SYS_open, SYS_stat, SYS_lstat, SYS_access = 2, 4, 6, 21
@@ -76,9 +82,16 @@ AT_SYMLINK_NOFOLLOW = 0x100
 
 
 def _sfd(v: int) -> int:
-    """Sign-extend a u64 syscall fd argument (AT_FDCWD arrives as
-    0xFFFF...FF9C)."""
-    return v - (1 << 64) if v >= (1 << 63) else v
+    """Sign-extend a syscall fd argument. AT_FDCWD arrives either as a
+    full u64 pattern (0xFFFF...FF9C) or as a 32-bit one (0xFFFFFF9C)
+    when the libc wrapper writes the int arg with a 32-bit mov and the
+    upper register half happens to be zero. No legitimate fd lives in
+    [2^31, 2^32) (vfds start at 0x100000), so both decode safely."""
+    if v >= (1 << 63):
+        return v - (1 << 64)
+    if 0x80000000 <= v <= 0xFFFFFFFF:
+        return v - (1 << 32)
+    return v
 SYS_close_range = 436
 SYS_select, SYS_pselect6 = 23, 270
 SYS_kill = 62
@@ -138,6 +151,7 @@ FIONREAD, FIONBIO = 0x541B, 0x5421
 SYS_clone, SYS_fork, SYS_vfork, SYS_execve, SYS_clone3 = 56, 57, 58, 59, 435
 
 EPERM, EBADF, EAGAIN, EFAULT, EINVAL, EPIPE = 1, 9, 11, 14, 22, 32
+ESPIPE = 29
 E2BIG = 7
 ENOSYS, ENOTCONN, ECONNRESET, ETIMEDOUT, EAFNOSUPPORT, ENETUNREACH = (
     38, 107, 104, 110, 97, 101)
@@ -211,7 +225,8 @@ class VSocket:
                  "interest",
                  "expirations", "interval_ns", "deadline", "timer_handle",
                  "evt_counter", "refs", "pipe", "pipe_out", "timer_clock",
-                 "vfile")
+                 "vfile", "sig_mask", "sig_q", "watches", "next_wd",
+                 "ino_q")
 
     def __init__(self, vfd: int, kind: str = "stream") -> None:
         self.vfd = vfd
@@ -245,6 +260,11 @@ class VSocket:
         self.pipe = None  # PipeBuf when kind is pipe_r/pipe_w (read side
         # for "spair" duplex ends)
         self.pipe_out = None  # "spair": the buffer this end WRITES
+        self.sig_mask = 0  # signalfd: u64 signal mask
+        self.sig_q: list = []  # signalfd: pending (signo, sender_vpid)
+        self.watches: dict = {}  # inotify: wd -> watched real path
+        self.next_wd = 1  # inotify: next watch descriptor
+        self.ino_q: list = []  # inotify: pending packed events
 
 
 class PipeBuf:
@@ -307,7 +327,8 @@ class PipeBuf:
         parked, self.waiting = self.waiting, []
         for proc, th in parked:
             w = th.waiting
-            if not w or th.dead or w[0] not in ("pipe_r", "pipe_w"):
+            if not w or th.dead or w[0] not in ("pipe_r", "pipe_w",
+                                                "sendfile", "splice"):
                 continue
             proc._pipe_retry(th, w)
         self.set_waiters(bool(self.waiting))
@@ -438,11 +459,12 @@ class GuestThread:
     """
 
     __slots__ = ("slot", "sock", "waiting", "dead", "retval", "joiners",
-                 "joined")
+                 "joined", "altstack")
 
     def __init__(self, slot: int, sock: socket.socket) -> None:
         self.slot = slot
         self.sock = sock
+        self.altstack = None  # sigaltstack bookkeeping: (sp, flags, size)
         self.waiting = None  # (kind, ...) while parked on a continuation
         self.dead = False
         self.retval = 0  # pthread-style exit value (int64, reply-ready)
@@ -503,6 +525,7 @@ class ManagedProcess(ProcessLifecycle):
         #: the per-host virtual file surface (native/vfs.py): synthesized
         #: /etc files, host-data-dir tree, native passthrough elsewhere
         self.vfs = HostVFS(self)
+        self.vfs.on_mutate = self._ino_mutate  # inotify bridge
         # deterministic virtual pid (real pids would leak host scheduling
         # nondeterminism into any guest that prints or hashes its pid)
         self.vpid = 1000 + host.id * 64 + index
@@ -1199,6 +1222,8 @@ class ManagedProcess(ProcessLifecycle):
         # descriptions (refcounted); per-process capture files stay fresh
         self.fds = dict(parent.fds)
         self.fd_cloexec = set(parent.fd_cloexec)
+        if hasattr(parent, "_rlimits"):  # setrlimit overrides inherit
+            self._rlimits = dict(parent._rlimits)
         for vs in self.fds.values():
             vs.refs += 1
             if vs.pipe is not None:
@@ -1240,6 +1265,399 @@ class ManagedProcess(ProcessLifecycle):
             except ProcessLookupError:
                 pass
 
+    # -- round-5 syscall-family breadth (SURVEY §2 SyscallHandler) ---------
+    #: deterministic resource limits, part of the virtual identity
+    #: (res -> (cur, max)); RLIM_INFINITY for everything unlisted
+    _RLIM_INF = (1 << 64) - 1
+    _RLIMITS_DEFAULT = {
+        3: (8 << 20, _RLIM_INF),   # RLIMIT_STACK
+        4: (0, _RLIM_INF),         # RLIMIT_CORE
+        6: (4096, 4096),           # RLIMIT_NPROC
+        7: (1024, 1 << 20),        # RLIMIT_NOFILE
+        12: (819200, 819200),      # RLIMIT_MSGQUEUE
+    }
+
+    def _rlimit_get(self, res: int):
+        ovr = getattr(self, "_rlimits", None)
+        if ovr and res in ovr:
+            return ovr[res]
+        return self._RLIMITS_DEFAULT.get(res, (self._RLIM_INF,
+                                               self._RLIM_INF))
+
+    def _rlimit(self, nr: int, args):
+        """getrlimit/setrlimit/prlimit64: a deterministic limit table
+        (virtual identity) with per-process overrides. prlimit64 serves
+        self (pid 0 or own vpid) only."""
+        if nr == SYS_prlimit64:
+            pid, res, newp, oldp = args[0], args[1], args[2], args[3]
+            pid &= 0xFFFFFFFF
+            if pid not in (0, self.vpid):
+                return -EPERM
+        else:
+            res, ptr = args[0], args[1]
+            newp = ptr if nr == SYS_setrlimit else 0
+            oldp = ptr if nr == SYS_getrlimit else 0
+        if res > 15:
+            return -EINVAL
+        if oldp:
+            cur, mx = self._rlimit_get(res)
+            self.mem.write(oldp, struct.pack("<QQ", cur, mx))
+        if newp:
+            cur, mx = struct.unpack("<QQ", self.mem.read(newp, 16))
+            if cur > mx:
+                return -EINVAL
+            if not hasattr(self, "_rlimits"):
+                self._rlimits = {}
+            self._rlimits[res] = (cur, mx)
+        return 0
+
+    def _sigaltstack(self, th: GuestThread, args):
+        """Bookkeeping + native passthrough: the record keeps strace and
+        determinism surfaces coherent, while the real kernel stack switch
+        still happens (genuine faults — e.g. the TSC SIGSEGV service —
+        must honor the guest's alternate stack)."""
+        ss_ptr, old_ptr = args[0], args[1]
+        if old_ptr:
+            sp, fl, sz = th.altstack or (0, 2, 0)  # SS_DISABLE when unset
+            self.mem.write(old_ptr, struct.pack("<QiiQ", sp, fl, 0, sz))
+        if ss_ptr:
+            sp, fl, _pad, sz = struct.unpack("<QiiQ",
+                                             self.mem.read(ss_ptr, 24))
+            if not (fl & 2) and sz < 2048:  # MINSIGSTKSZ
+                return -12  # ENOMEM
+            th.altstack = (sp, fl, sz)
+        return RETRY_NATIVE
+
+    def _sendfile(self, args, th: GuestThread = None):
+        """sendfile(2): virtual file -> simulated socket or pipe. Reads
+        at the explicit offset (or the file position), sends what the
+        destination accepts NOW, and advances by exactly the returned
+        count (POSIX); a blocking socket with no room parks and retries
+        whole (see _on_drain). All-real-fd calls pass through native."""
+        out_fd, in_fd, off_ptr, count = args[0], args[1], args[2], args[3]
+        out_vs = self.fds.get(out_fd)
+        in_vs = self.fds.get(in_fd)
+        if in_vs is None and out_vs is None:
+            return RETRY_NATIVE
+        if in_vs is None or in_vs.kind != "file":
+            return -EINVAL
+        if out_vs is None:
+            return -EBADF
+        count = min(count, 1 << 20)
+        off = None
+        if off_ptr:
+            off = struct.unpack("<q", self.mem.read(off_ptr, 8))[0]
+            data = self.vfs.pread(in_vs, count, off)
+        else:
+            data = self.vfs.pread(in_vs, count, in_vs.vfile.off)
+        if isinstance(data, int):
+            return data
+        if not data:
+            return 0
+        if out_vs.kind in ("pipe_w", "spair"):
+            pb = self._wbuf(out_vs)
+            if pb is None or pb.readers == 0:
+                return -EPIPE
+            k = min(len(data), pb.room())
+            if k <= 0:
+                if out_vs.nonblock:
+                    return -EAGAIN
+                tgt = th if th is not None else self._cur
+                tgt.waiting = ("sendfile", out_vs, args)
+                self._park_on(pb, tgt)
+                return _BLOCK
+            pb.append_bytes(data[:k])
+            pb.wake()
+        elif out_vs.endpoint is not None and out_vs.connected:
+            if out_vs.peer_closed:
+                return -EPIPE
+            k = out_vs.endpoint.send(payload=data)
+            if k <= 0:
+                if out_vs.nonblock:
+                    return -EAGAIN
+                tgt = th if th is not None else self._cur
+                tgt.waiting = ("sendfile", out_vs, args)
+                return _BLOCK
+        else:
+            return -EINVAL
+        if off_ptr:
+            self.mem.write(off_ptr, struct.pack("<q", off + k))
+        else:
+            in_vs.vfile.off += k
+        return k
+
+    def _signalfd(self, args, four: bool):
+        """signalfd(4): a virtual signal fd. Model: an emulated kill(2)
+        whose signal is in a signalfd's mask is captured there (the
+        blocked-signal semantics real callers set up; per-thread signal
+        masks are not otherwise modeled — documented scope)."""
+        fd, mask_ptr = _sfd(args[0]), args[1]
+        mask = struct.unpack("<Q", self.mem.read(mask_ptr, 8))[0]
+        flags = args[3] if four else 0
+        if fd == -1:
+            vs = VSocket(self._next_vfd, "sigfd")
+            self._next_vfd += 1
+            vs.sig_mask = mask
+            if flags & 0o4000:  # SFD_NONBLOCK
+                vs.nonblock = True
+            if flags & O_CLOEXEC:
+                self.fd_cloexec.add(vs.vfd)
+            self.fds[vs.vfd] = vs
+            return vs.vfd
+        vs = self.fds.get(fd)
+        if vs is None or vs.kind != "sigfd":
+            return -EINVAL
+        vs.sig_mask = mask
+        return fd
+
+    _SFD_SIZE = 128  # sizeof(struct signalfd_siginfo)
+
+    def _sigfd_read(self, vs: VSocket, bufaddr: int, buflen: int):
+        if buflen < self._SFD_SIZE:
+            return -EINVAL
+        if not vs.sig_q:
+            if vs.nonblock:
+                return -EAGAIN
+            self._waiting = ("sigread", vs, bufaddr, buflen)
+            return _BLOCK
+        out = b""
+        while vs.sig_q and len(out) + self._SFD_SIZE <= buflen:
+            signo, spid = vs.sig_q.pop(0)
+            rec = bytearray(self._SFD_SIZE)
+            struct.pack_into("<IiiII", rec, 0, signo, 0, 0, spid, 0)
+            out += bytes(rec)
+        self.mem.write(bufaddr, out)
+        return len(out)
+
+    def _sigfd_deliver(self, sig: int, sender_vpid: int) -> bool:
+        """Queue sig on the first signalfd whose mask has it; wake its
+        reader/pollers. Returns True if captured."""
+        for vs in self.fds.values():
+            if vs.kind == "sigfd" and (vs.sig_mask >> (sig - 1)) & 1:
+                vs.sig_q.append((sig, sender_vpid))
+                th, w = self._find_waiter((("sigread",), vs))
+                if th is not None:
+                    self._resume(th, self._sigfd_read(vs, w[2], w[3]))
+                else:
+                    self._notify()
+                return True
+        return False
+
+    def _splice(self, args, tee: bool, th: GuestThread = None):
+        """splice/tee between virtual pipes (and file->pipe for splice).
+        Progress-now semantics with parking on an empty blocking input;
+        all-real-fd calls pass through native."""
+        if tee:
+            fd_in, fd_out, count = args[0], args[1], args[2]
+            off_in = off_out = 0
+        else:
+            fd_in, off_in, fd_out, off_out, count = (
+                args[0], args[1], args[2], args[3], args[4])
+        in_vs = self.fds.get(fd_in)
+        out_vs = self.fds.get(fd_out)
+        if in_vs is None and out_vs is None:
+            return RETRY_NATIVE
+        count = min(count, 1 << 20)
+        # destination must be a virtual pipe (or file for splice-out)
+        if out_vs is not None and out_vs.kind == "pipe_w":
+            pb_out = out_vs.pipe
+        else:
+            pb_out = None
+        if in_vs is not None and in_vs.kind == "pipe_r":
+            pb_in = in_vs.pipe
+            if pb_in is None:
+                return 0
+            if off_in:
+                return -ESPIPE
+            avail = pb_in.avail()
+            if avail == 0:
+                if pb_in.writers == 0:
+                    return 0
+                if in_vs.nonblock:
+                    return -EAGAIN
+                tgt = th if th is not None else self._cur
+                tgt.waiting = ("splice", in_vs, args, tee)
+                self._park_on(pb_in, tgt)
+                return _BLOCK
+            if tee:
+                if pb_out is None or pb_out.readers == 0:
+                    return -EINVAL if pb_out is None else -EPIPE
+                k = min(avail, count, pb_out.room())
+                if k <= 0:  # output full: block like tee(2), never 0
+                    if out_vs.nonblock:
+                        return -EAGAIN
+                    tgt = th if th is not None else self._cur
+                    tgt.waiting = ("splice", out_vs, args, tee)
+                    self._park_on(pb_out, tgt)
+                    return _BLOCK
+                pb_out.append_bytes(pb_in.peek(k))  # tee: no consume
+                pb_out.wake()
+                return k
+            if pb_out is not None:
+                if pb_out.readers == 0:
+                    return -EPIPE
+                k = min(avail, count, pb_out.room())
+                if k <= 0:  # output full: block like splice(2)
+                    if out_vs.nonblock:
+                        return -EAGAIN
+                    tgt = th if th is not None else self._cur
+                    tgt.waiting = ("splice", out_vs, args, tee)
+                    self._park_on(pb_out, tgt)
+                    return _BLOCK
+                pb_out.append_bytes(pb_in.take(k))
+                pb_in.wake()
+                pb_out.wake()
+                return k
+            if out_vs is not None and out_vs.kind == "file":
+                # write FIRST, consume what was actually written (an
+                # error or short write must not lose pipe bytes)
+                k = min(avail, count)
+                data = pb_in.peek(k)
+                if off_out:
+                    off = struct.unpack("<q",
+                                        self.mem.read(off_out, 8))[0]
+                    r = self.vfs.pwrite(out_vs, data, off)
+                else:
+                    r = self.vfs.write(out_vs, data)
+                if r > 0:
+                    pb_in.take(r)
+                    pb_in.wake()
+                    if off_out:
+                        self.mem.write(off_out,
+                                       struct.pack("<q", off + r))
+                return r
+            return -EINVAL
+        if (not tee and in_vs is not None and in_vs.kind == "file"
+                and pb_out is not None):
+            if pb_out.readers == 0:
+                return -EPIPE
+            k = min(count, pb_out.room())
+            if k <= 0:
+                return -EAGAIN
+            if off_in:
+                off = struct.unpack("<q", self.mem.read(off_in, 8))[0]
+                data = self.vfs.pread(in_vs, k, off)
+            else:
+                data = self.vfs.pread(in_vs, k, in_vs.vfile.off)
+            if isinstance(data, int):
+                return data
+            if not data:
+                return 0
+            if off_in:
+                self.mem.write(off_in,
+                               struct.pack("<q", off + len(data)))
+            else:
+                in_vs.vfile.off += len(data)
+            pb_out.append_bytes(data)
+            pb_out.wake()
+            return len(data)
+        return -EINVAL
+
+    # -- inotify (directory watches over the virtual file surface) ---------
+    _INO_HDR = struct.Struct("<iIII")  # wd, mask, cookie, len
+
+    def _inotify_init(self, flags: int):
+        vs = VSocket(self._next_vfd, "inotify")
+        self._next_vfd += 1
+        if flags & 0o4000:  # IN_NONBLOCK
+            vs.nonblock = True
+        if flags & O_CLOEXEC:
+            self.fd_cloexec.add(vs.vfd)
+        self.fds[vs.vfd] = vs
+        return vs.vfd
+
+    def _inotify_add(self, args):
+        """Watches on DIRECTORIES within the worker-served tree; events
+        are generated for direct children at the vfs mutation points
+        (create/delete/move/modify — the families real build tools and
+        event loops watch for). Self-events and native-passthrough paths
+        are out of scope (documented)."""
+        vs = self.fds.get(args[0])
+        if vs is None or vs.kind != "inotify":
+            return -EINVAL
+        path = self.vfs._path_arg(args[1])
+        if path is None:
+            return -EFAULT
+        r = self.vfs.resolve(AT_FDCWD, path)
+        if r is None or r[0] == "synth":
+            return -EPERM  # only the worker-served tree is watchable
+        real = r[1]
+        if not os.path.isdir(real):
+            return -20  # ENOTDIR (file watches: out of scope)
+        real = real.rstrip("/")
+        wmask = args[2] & 0xFFFFFFFF
+        for wd, (p, _m) in vs.watches.items():
+            if p == real:
+                vs.watches[wd] = (real, wmask)
+                return wd
+        wd = vs.next_wd
+        vs.next_wd += 1
+        vs.watches[wd] = (real, wmask)
+        return wd
+
+    def _inotify_rm(self, args):
+        vs = self.fds.get(args[0])
+        if vs is None or vs.kind != "inotify":
+            return -EINVAL
+        if args[1] not in vs.watches:
+            return -EINVAL
+        del vs.watches[args[1]]
+        return 0
+
+    def _ino_read(self, vs: VSocket, bufaddr: int, buflen: int):
+        if not vs.ino_q:
+            if vs.nonblock:
+                return -EAGAIN
+            self._waiting = ("inoread", vs, bufaddr, buflen)
+            return _BLOCK
+        out = b""
+        while vs.ino_q and len(out) + len(vs.ino_q[0]) <= buflen:
+            out += vs.ino_q.pop(0)
+        if not out:
+            return -EINVAL  # buffer smaller than the next event
+        self.mem.write(bufaddr, out)
+        return len(out)
+
+    def _ino_mutate(self, real_path: str, mask: int, cookie: int = 0):
+        """A vfs mutation happened at ``real_path``: deliver an event to
+        every inotify watch (ANY process on this host — the tree is
+        shared) whose directory is the path's parent."""
+        parent = os.path.dirname(real_path.rstrip("/"))
+        name = os.path.basename(real_path.rstrip("/"))
+        nb = name.encode()
+        pad = (-(len(nb) + 1)) % 8 + 1  # NUL + align to 8
+        seen: set = set()  # fork/dup share VSockets: queue + wake ONCE
+        for proc in self.host.processes:
+            for vs in getattr(proc, "fds", {}).values():
+                if vs.kind != "inotify" or id(vs) in seen:
+                    continue
+                seen.add(id(vs))
+                for wd, (wpath, wmask) in vs.watches.items():
+                    if wpath != parent or not (wmask & mask & 0xFFF):
+                        continue
+                    rec = (self._INO_HDR.pack(wd, mask, cookie,
+                                              len(nb) + pad)
+                           + nb + b"\0" * pad)
+                    # coalesce identical consecutive unread events
+                    # (kernel behavior for e.g. repeated IN_MODIFY)
+                    if vs.ino_q and vs.ino_q[-1] == rec:
+                        continue
+                    vs.ino_q.append(rec)
+                    # the blocked reader may be ANY process sharing the
+                    # fd (fork); wake the first match, notify the rest
+                    for p2 in self.host.processes:
+                        fw = getattr(p2, "_find_waiter", None)
+                        if fw is None:
+                            continue
+                        th, w = fw((("inoread",), vs))
+                        if th is not None:
+                            p2._resume(th, p2._ino_read(vs, w[2], w[3]))
+                            break
+                    else:
+                        for p2 in self.host.processes:
+                            if getattr(p2, "running", False):
+                                p2._notify()
+
     def _kill(self, args):
         """kill(2) between managed guests of one simulated host: vpid
         resolution + DEFAULT dispositions emulated worker-side (terminate /
@@ -1263,6 +1681,8 @@ class ManagedProcess(ProcessLifecycle):
             return -ESRCH
         if sig == 0:
             return 0  # existence probe
+        if sig != 9 and target._sigfd_deliver(sig, self.vpid):
+            return 0  # captured by a signalfd (blocked-signal semantics)
         if sig in _IGN_SIGS or sig not in _TERM_SIGS and sig != 9:
             return 0  # default-ignore, or dispositions we don't model
         target._signal_hint = -sig
@@ -1468,6 +1888,16 @@ class ManagedProcess(ProcessLifecycle):
 
     def _pipe_retry(self, th: GuestThread, w) -> None:
         """Re-attempt a parked pipe op (called from PipeBuf.wake)."""
+        if w[0] == "sendfile":
+            r = self._sendfile(w[2], th=th)
+            if r is not _BLOCK:
+                self._resume(th, r)
+            return
+        if w[0] == "splice":
+            r = self._splice(w[2], w[3], th=th)
+            if r is not _BLOCK:
+                self._resume(th, r)
+            return
         vs = w[1]
         pb = vs.pipe
         if w[0] == "pipe_r":
@@ -1738,6 +2168,10 @@ class ManagedProcess(ProcessLifecycle):
                 return len(data)
             if vs is not None and vs.kind in ("timer", "event"):
                 return self._counter_read(vs, args[1], args[2])
+            if vs is not None and vs.kind == "sigfd":
+                return self._sigfd_read(vs, args[1], args[2])
+            if vs is not None and vs.kind == "inotify":
+                return self._ino_read(vs, args[1], args[2])
             if vs is not None and vs.kind in ("pipe_r", "spair"):
                 ret = self._pipe_read(vs, [(args[1], args[2])])
                 if vs.kind == "pipe_r":
@@ -2114,6 +2548,23 @@ class ManagedProcess(ProcessLifecycle):
             return self._pipe(args[0], args[1] if nr == SYS_pipe2 else 0)
         if nr == SYS_socketpair:
             return self._socketpair(args)
+        if nr == SYS_sendfile:
+            return self._sendfile(args)
+        if nr == SYS_sigaltstack:
+            return self._sigaltstack(self._cur, args)
+        if nr in (SYS_getrlimit, SYS_setrlimit, SYS_prlimit64):
+            return self._rlimit(nr, args)
+        if nr in (SYS_signalfd, SYS_signalfd4):
+            return self._signalfd(args, nr == SYS_signalfd4)
+        if nr in (SYS_splice, SYS_tee):
+            return self._splice(args, nr == SYS_tee)
+        if nr in (SYS_inotify_init, SYS_inotify_init1):
+            return self._inotify_init(args[0] if nr == SYS_inotify_init1
+                                      else 0)
+        if nr == SYS_inotify_add_watch:
+            return self._inotify_add(args)
+        if nr == SYS_inotify_rm_watch:
+            return self._inotify_rm(args)
         if nr == SYS_close_range:
             # close the range's VFDS only; real fds — including the shim's
             # reserved IPC window — survive (the guest can't be allowed to
@@ -2263,6 +2714,10 @@ class ManagedProcess(ProcessLifecycle):
             return vs.expirations > 0
         if vs.kind == "event":
             return vs.evt_counter > 0
+        if vs.kind == "sigfd":
+            return bool(vs.sig_q)
+        if vs.kind == "inotify":
+            return bool(vs.ino_q)
         if vs.kind in ("pipe_r", "spair"):
             if vs.pipe is None:
                 return True  # SHUT_RD: reads return EOF immediately
@@ -2382,8 +2837,13 @@ class ManagedProcess(ProcessLifecycle):
         ep.receiver.app_unread = lambda: len(vs.rxbuf)
 
     def _on_drain(self, vs: VSocket) -> None:
-        th, w = self._find_waiter((("send", "smsg"), vs))
+        th, w = self._find_waiter((("send", "smsg", "sendfile"), vs))
         if th is not None:
+            if w[0] == "sendfile":
+                r = self._sendfile(w[2], th=th)
+                if r is not _BLOCK:
+                    self._resume(th, r)
+                return
             if w[0] == "send":
                 data = self.mem.read(w[2], min(w[3], 1 << 20))
             else:

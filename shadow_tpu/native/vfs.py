@@ -100,11 +100,23 @@ class HostVFS:
     tracked per process (fork children copy it); the synthesized /etc
     files are built once per simulation from the controller's host list."""
 
+    #: inotify event bits delivered through on_mutate
+    IN_MODIFY, IN_MOVED_FROM, IN_MOVED_TO = 0x2, 0x40, 0x80
+    IN_CREATE, IN_DELETE, IN_ISDIR = 0x100, 0x200, 0x40000000
+
     def __init__(self, proc) -> None:
         self.proc = proc
         self.root = str(proc.host.controller.data_dir / "hosts"
                         / proc.host.name)
         self.cwd = self.root
+        #: inotify bridge: called (real_path, mask, cookie) after a
+        #: successful mutation of the worker-served tree
+        self.on_mutate = None
+        self._mv_cookie = 0
+
+    def _mutated(self, real: str, mask: int, cookie: int = 0) -> None:
+        if self.on_mutate is not None:
+            self.on_mutate(real, mask, cookie)
 
     # -- path resolution ----------------------------------------------------
     def _synth(self, path: str):
@@ -294,6 +306,8 @@ class HostVFS:
             fd = os.open(real, flags & ~O_DIRECTORY, mode & 0o777 or 0o644)
         except OSError as e:
             return -e.errno
+        if not exists:  # O_CREAT made it
+            self._mutated(real, self.IN_CREATE)
         vf = VFile(real, real, fd, None, flags)
         if flags & O_APPEND:
             vf.off = os.fstat(fd).st_size
@@ -369,9 +383,12 @@ class HostVFS:
         if vf.flags & O_ACCMODE == 0:  # O_RDONLY
             return -EBADF
         try:
-            return os.pwrite(vf.fd, data, off)
+            k = os.pwrite(vf.fd, data, off)
         except OSError as e:
             return -e.errno
+        if k:
+            self._mutated(vf.path, self.IN_MODIFY)
+        return k
 
     def write(self, vs, data: bytes) -> int:
         vf = vs.vfile
@@ -386,6 +403,8 @@ class HostVFS:
         except OSError as e:
             return -e.errno
         vf.off += k
+        if k:
+            self._mutated(vf.path, self.IN_MODIFY)
         return k
 
     def lseek(self, vs, off: int, whence: int) -> int:
@@ -586,8 +605,10 @@ class HostVFS:
         try:
             if flags & 0x200:  # AT_REMOVEDIR
                 os.rmdir(tgt)
+                self._mutated(tgt, self.IN_DELETE | self.IN_ISDIR)
             else:
                 os.unlink(tgt)
+                self._mutated(tgt, self.IN_DELETE)
         except OSError as e:
             return -e.errno
         return 0
@@ -604,6 +625,7 @@ class HostVFS:
             return -EEXIST
         try:
             os.mkdir(tgt, mode & 0o777)
+            self._mutated(tgt, self.IN_CREATE | self.IN_ISDIR)
         except OSError as e:
             return -e.errno
         return 0
@@ -622,6 +644,9 @@ class HostVFS:
             return -errno.EXDEV  # across the virtualization boundary
         try:
             os.rename(ro[1], rn[1])
+            self._mv_cookie += 1
+            self._mutated(ro[1], self.IN_MOVED_FROM, self._mv_cookie)
+            self._mutated(rn[1], self.IN_MOVED_TO, self._mv_cookie)
         except OSError as e:
             return -e.errno
         return 0

@@ -1208,3 +1208,41 @@ def test_shring_stdio_pipeline_fast_path():
     assert fast >= 50, f"stdio pipeline fast path barely engaged: {fast}"
     out = Path("/tmp/st-shring-pipeline/hosts/box/sh.f1.stdout").read_text()
     assert out.strip() == "400000", out
+
+
+# ---- round-5 syscall-family breadth ---------------------------------------
+
+def test_sysbreadth_native_oracle():
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        r = subprocess.run([str(BUILD / "sysbreadth")], capture_output=True,
+                           text=True, timeout=30, cwd=d)
+    assert r.returncode == 0, r.stderr
+    assert "sysbreadth-ok" in r.stdout
+
+
+def test_sysbreadth_managed_matches_native():
+    """rlimits, sigaltstack, sendfile (incl. explicit offset), signalfd,
+    splice/tee, and inotify produce the native oracle's exact transcript
+    under the emulated surface, twice (determinism)."""
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        native = subprocess.run([str(BUILD / "sysbreadth")],
+                                capture_output=True, text=True,
+                                timeout=30, cwd=d)
+    assert native.returncode == 0, native.stderr
+    cfg_text = SLEEP_CFG.replace("sleep_clock", "sysbreadth")
+    outs = []
+    for tag in ("a", "b"):
+        import shutil
+        shutil.rmtree(f"/tmp/st-sysb-{tag}", ignore_errors=True)
+        cfg = parse_config(yaml.safe_load(cfg_text), {
+            "general.data_directory": f"/tmp/st-sysb-{tag}"})
+        c = Controller(cfg, mirror_log=False)
+        result = c.run()
+        assert result["process_errors"] == [], result["process_errors"]
+        out = Path(f"/tmp/st-sysb-{tag}/hosts/box/sysbreadth.0.stdout"
+                   ).read_text()
+        assert out == native.stdout, (out, native.stdout)
+        outs.append(out)
+    assert outs[0] == outs[1]

@@ -35,7 +35,12 @@ SYS = dict(read=0, write=1, open=2, close=3, stat=4, fstat=5, lstat=6,
            wait4=61, execve=59, exit_group=231, clone3=435,
            close_range=436, select=23, pselect6=270, kill=62,
            uname=63, times=100, clock_getres=229,
-           sched_getaffinity=204, sysinfo=99, getrusage=98)
+           sched_getaffinity=204, sysinfo=99, getrusage=98,
+           sendfile=40, sigaltstack=131,
+           getrlimit=97, setrlimit=160, prlimit64=302,
+           signalfd=282, signalfd4=289, splice=275, tee=276,
+           inotify_init=253, inotify_init1=294,
+           inotify_add_watch=254, inotify_rm_watch=255)
 
 CLONE_THREAD = 0x10000
 
@@ -61,6 +66,14 @@ UNCONDITIONAL = [
     # VIRTUAL mapping (a shell restoring its saved stdout after `cmd >
     # file`) must clear the worker's mapping or the two fd tables diverge
     "dup2", "dup3",
+    # round 5 syscall-family breadth (SURVEY §2 SyscallHandler): resource
+    # limits and signal/file-event fds are part of the deterministic
+    # virtual identity; sendfile/splice/tee bridge the virtual file
+    # surface into sockets and pipes (all-real-fd cases RETRY_NATIVE)
+    "sendfile", "sigaltstack", "getrlimit", "setrlimit", "prlimit64",
+    "signalfd", "signalfd4", "splice", "tee",
+    "inotify_init", "inotify_init1", "inotify_add_watch",
+    "inotify_rm_watch",
 ]
 
 #: syscalls trapped only when arg0 is a virtual fd
