@@ -2125,6 +2125,9 @@ typedef struct CEp {
    * terminal frame parsing + DATA-body byte counting in C, one Python
    * callback per CONTROL cell (models/tor.py TorClient twin) */
   struct CTorSink *tsink;
+  /* C tor-exit stream (OWNED; the stream borrows the ep back): counted
+   * server bytes re-framed as circuit DATA cells in C (TorExit twin) */
+  PyObject *xsink;
   /* C tgen app (models/tgen.py twin; same opt-in style as the relay
    * sink): 0 = none, 1 = server (parse the 8-byte ASCII request, push
    * counted bytes), 2 = client (count received bytes, fire tgen_cb at
@@ -2150,6 +2153,8 @@ static CHost *cep_h(CEp *e) { return &e->core->hs[e->hid]; }
 struct CTorSink;
 static int tsink_feed(struct CTorSink *s, int64_t nbytes,
                       PyObject *payload);
+struct CExitStream;
+static int exit_feed(struct CExitStream *s, int64_t now, int64_t nbytes);
 
 /* current sim clock of the owning host: used by timer-driven entry
  * points; row-driven entry points pass `now` explicitly */
@@ -2498,6 +2503,8 @@ static int cr_deliver(CEp *e, int64_t now, int64_t nbytes,
   e->bytes_received += nbytes;
   if (e->tsink)
     return tsink_feed(e->tsink, nbytes, payload);
+  if (e->xsink)
+    return exit_feed((struct CExitStream *)e->xsink, now, nbytes);
   if (e->tgen_mode == 2) {
     e->tgen_pending += nbytes;
     if (e->tgen_pending >= e->tgen_want && e->tgen_cb &&
@@ -2584,6 +2591,7 @@ static int ce_drop(CEp *e) {
   if (cep_cancel_timer(e, &e->rto_timer) < 0) return -1;
   e->state = ST_CLOSED;
   e->tsink = NULL; /* borrowed back-pointer; the sink still owns us */
+  Py_CLEAR(e->xsink); /* the exit stream dies with its server conn */
   /* host.drop_endpoint twin: pop our four-tuple from the cached
    * identity-stable host._conns dict */
   PyObject *conns = cep_h(e)->conns;
@@ -2768,6 +2776,7 @@ static int CEp_traverse(CEp *e, visitproc visit, void *arg) {
   Py_VISIT(e->on_error);
   Py_VISIT(e->app_unread);
   Py_VISIT(e->tgen_cb);
+  Py_VISIT(e->xsink);
   return 0;
 }
 
@@ -2780,6 +2789,7 @@ static int CEp_clear_gc(CEp *e) {
   Py_CLEAR(e->on_error);
   Py_CLEAR(e->app_unread);
   Py_CLEAR(e->tgen_cb);
+  Py_CLEAR(e->xsink);
   return 0;
 }
 
@@ -2804,6 +2814,7 @@ static void CEp_dealloc(CEp *e) {
   Py_XDECREF(e->on_close);
   Py_XDECREF(e->on_error);
   Py_XDECREF(e->tgen_cb);
+  Py_XDECREF(e->xsink);
   Py_TYPE(e)->tp_free((PyObject *)e);
 }
 
@@ -3352,7 +3363,9 @@ static int dispatch_stream(CoreObject *c, CHost *h, int hid, IRow *ir,
 #define TC_CREATED 1
 #define TC_EXTEND 2
 #define TC_EXTENDED 3
+#define TC_BEGIN 4
 #define TC_DATA 6
+#define TC_END 7
 
 typedef struct { PyObject *payload; int64_t a; } PendEnt;
 /* payload != NULL: byte frame, a = send offset; NULL: counted, a = left */
@@ -3387,6 +3400,7 @@ typedef struct CRelayObj {
   uint64_t tseq;
   int tcap, tcount;
   int next_circ;
+  int exit_mode; /* BEGIN at the circuit endpoint reaches on_ctrl */
   int64_t cells_relayed, bytes_relayed;
 } CRelayObj;
 
@@ -3458,6 +3472,20 @@ static int rtab_put(CRelayObj *r, int cid, int circ, int ncid, int ncirc) {
 }
 
 /* -- frames -------------------------------------------------------------- */
+static PyObject *build_cell(int ctype, int circ, const char *payload,
+                            Py_ssize_t plen);
+
+/* a DATA header announcing `body_len` counted bytes (the len field
+ * describes the FOLLOWING body, not an inline payload) */
+static PyObject *build_data_hdr(int circ, int64_t body_len) {
+  PyObject *hdr = build_cell(TC_DATA, circ, NULL, 0);
+  if (!hdr) return NULL;
+  char *hp = PyBytes_AS_STRING(hdr);
+  hp[3] = (char)((body_len >> 8) & 0xFF);
+  hp[4] = (char)(body_len & 0xFF);
+  return hdr;
+}
+
 static PyObject *build_cell(int ctype, int circ, const char *payload,
                             Py_ssize_t plen) {
   PyObject *b = PyBytes_FromStringAndSize(NULL, TCELL_HDR + plen);
@@ -3587,9 +3615,10 @@ static int relay_on_cell(CRelayObj *r, CRelayConn *rc, int64_t now,
     }
     return 0;
   }
-  if (ctype == TC_EXTEND && !hit) {
-    /* circuit head: the control plane (connect to the named relay)
-     * belongs to Python */
+  if ((ctype == TC_EXTEND || (r->exit_mode && ctype == TC_BEGIN))
+      && !hit) {
+    /* circuit head (EXTEND) or exit termination (BEGIN): the control
+     * plane — connecting through the simulated network — is Python's */
     PyObject *plo = PyBytes_FromStringAndSize(pl, plen);
     if (!plo) return -1;
     PyObject *res = PyObject_CallFunction(r->on_ctrl, "(iiiO)", rc->cid,
@@ -3661,11 +3690,8 @@ static int relay_feed(CRelayConn *rc, int64_t now, int64_t nbytes,
       /* forward the DATA header along the circuit (on_data_hdr twin) */
       int ncid, ncirc;
       if (rtab_get(r, rc->cid, circ, &ncid, &ncirc) && r->conns[ncid]) {
-        PyObject *f = build_cell(TC_DATA, ncirc, NULL, 0);
+        PyObject *f = build_data_hdr(ncirc, ln);
         if (!f) { rcod = -1; break; }
-        char *fp = PyBytes_AS_STRING(f);
-        fp[3] = (char)((ln >> 8) & 0xFF);
-        fp[4] = (char)(ln & 0xFF);
         if (relay_write(r->conns[ncid], now, f) < 0) { rcod = -1; break; }
       }
       break; /* counted body follows in subsequent chunks */
@@ -3855,6 +3881,103 @@ static PyObject *CRelay_write_cell(CRelayObj *r, PyObject *args) {
   Py_RETURN_NONE;
 }
 
+/* ---- C tor-exit stream (TorExit data path) -----------------------------
+ * Attached to the exit's SERVER-side connection: every counted chunk the
+ * destination streams back is re-framed as a circuit DATA cell (header +
+ * counted body) toward the client, entirely in C; at `want` bytes the
+ * server connection closes and an END cell terminates the fetch — the
+ * exact order of the Python twin (models/tor.py TorExit._on_cell). The
+ * endpoint OWNS the stream (ep->xsink); the stream borrows the ep. */
+typedef struct CExitStream {
+  PyObject_HEAD
+  CEp *ep;          /* borrowed: the owner */
+  CRelayObj *relay; /* owned */
+  int cid, circ;
+  int done;
+  int64_t want, got;
+} CExitStream;
+
+static PyTypeObject CExitStream_Type;
+
+static int exit_feed(CExitStream *s, int64_t now, int64_t nbytes) {
+  CRelayObj *r = s->relay;
+  CRelayConn *rc = (s->cid >= 0 && s->cid < r->nconns)
+                       ? r->conns[s->cid] : NULL;
+  if (rc) {
+    PyObject *hdr = build_data_hdr(s->circ, nbytes);
+    if (!hdr) return -1;
+    if (relay_write(rc, now, hdr) < 0) return -1;
+    rc = (s->cid < r->nconns) ? r->conns[s->cid] : NULL; /* may close */
+    if (rc && relay_write_counted(rc, now, nbytes) < 0) return -1;
+  }
+  s->got += nbytes;
+  if (s->got >= s->want && !s->done) {
+    s->done = 1;
+    if (cep_begin_close(s->ep, now) < 0) return -1;
+    rc = (s->cid >= 0 && s->cid < r->nconns) ? r->conns[s->cid] : NULL;
+    if (rc) {
+      PyObject *endc = build_cell(TC_END, s->circ, NULL, 0);
+      if (!endc) return -1;
+      if (relay_write(rc, now, endc) < 0) return -1;
+    }
+  }
+  return 0;
+}
+
+static int CExitStream_traverse(CExitStream *s, visitproc visit,
+                                void *arg) {
+  Py_VISIT(s->relay);
+  return 0;
+}
+
+static int CExitStream_clear_gc(CExitStream *s) {
+  Py_CLEAR(s->relay);
+  return 0;
+}
+
+static void CExitStream_dealloc(CExitStream *s) {
+  PyObject_GC_UnTrack(s);
+  Py_XDECREF(s->relay);
+  Py_TYPE(s)->tp_free((PyObject *)s);
+}
+
+static PyTypeObject CExitStream_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0).tp_name = "_colcore.ExitStream",
+    .tp_basicsize = sizeof(CExitStream),
+    .tp_dealloc = (destructor)CExitStream_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC,
+    .tp_traverse = (traverseproc)CExitStream_traverse,
+    .tp_clear = (inquiry)CExitStream_clear_gc,
+    .tp_free = PyObject_GC_Del,
+    .tp_doc = "C tor-exit reframe stream (models/tor.py TorExit twin)",
+};
+
+static PyObject *CRelay_exit_stream(CRelayObj *r, PyObject *args) {
+  PyObject *ep_o;
+  int cid, circ;
+  long long want;
+  if (!PyArg_ParseTuple(args, "OiiL", &ep_o, &cid, &circ, &want))
+    return NULL;
+  if (Py_TYPE(ep_o) != &CEp_Type) {
+    PyErr_SetString(PyExc_TypeError, "exit_stream expects a C endpoint");
+    return NULL;
+  }
+  CEp *e = (CEp *)ep_o;
+  CExitStream *s = PyObject_GC_New(CExitStream, &CExitStream_Type);
+  if (!s) return NULL;
+  memset(((char *)s) + sizeof(PyObject), 0,
+         sizeof(CExitStream) - sizeof(PyObject));
+  s->ep = e;
+  Py_INCREF(r);
+  s->relay = r;
+  s->cid = cid;
+  s->circ = circ;
+  s->want = want;
+  PyObject_GC_Track((PyObject *)s);
+  Py_XSETREF(e->xsink, (PyObject *)s); /* the ep owns the stream */
+  Py_RETURN_NONE;
+}
+
 static PyObject *CRelay_stats(CRelayObj *r, PyObject *noarg) {
   (void)noarg;
   return Py_BuildValue("(LL)", (long long)r->cells_relayed,
@@ -3868,6 +3991,8 @@ static PyMethodDef CRelay_methods[] = {
      "(cid, circ, ncid) -> ncirc; inserts both circuit-table directions"},
     {"write_cell", (PyCFunction)CRelay_write_cell, METH_VARARGS,
      "(cid, ctype, circ[, payload]) -> queue a control cell"},
+    {"exit_stream", (PyCFunction)CRelay_exit_stream, METH_VARARGS,
+     "(endpoint, cid, circ, want) -> attach the C exit reframe stream"},
     {"stats", (PyCFunction)CRelay_stats, METH_NOARGS,
      "-> (cells_relayed, bytes_relayed)"},
     {NULL, NULL, 0, NULL}};
@@ -3887,7 +4012,9 @@ static PyTypeObject CRelay_Type = {
 static PyObject *Core_relay_new(CoreObject *c, PyObject *args) {
   long long hid;
   PyObject *on_ctrl;
-  if (!PyArg_ParseTuple(args, "LO", &hid, &on_ctrl)) return NULL;
+  int exit_mode = 0;
+  if (!PyArg_ParseTuple(args, "LO|p", &hid, &on_ctrl, &exit_mode))
+    return NULL;
   if (hid < 0 || hid >= c->H) {
     PyErr_SetString(PyExc_ValueError, "host id out of range");
     return NULL;
@@ -3902,6 +4029,7 @@ static PyObject *Core_relay_new(CoreObject *c, PyObject *args) {
   Py_INCREF(on_ctrl);
   r->on_ctrl = on_ctrl;
   r->next_circ = 1;
+  r->exit_mode = exit_mode;
   PyObject_GC_Track((PyObject *)r);
   return (PyObject *)r;
 }
@@ -4146,7 +4274,8 @@ PyMODINIT_FUNC PyInit__colcore(void) {
   if (PyType_Ready(&Core_Type) < 0 || PyType_Ready(&GossipState_Type) < 0
       || PyType_Ready(&CEp_Type) < 0 || PyType_Ready(&CRelay_Type) < 0
       || PyType_Ready(&CBatch_Type) < 0
-      || PyType_Ready(&CTorSink_Type) < 0)
+      || PyType_Ready(&CTorSink_Type) < 0
+      || PyType_Ready(&CExitStream_Type) < 0)
     return NULL;
   PyObject *m = PyModule_Create(&colcore_module);
   if (!m) return NULL;

@@ -170,18 +170,25 @@ class TorRelay:
         self.bytes_relayed = 0
         self._c = None  # C relay data path (plain relays on the C engine)
 
-    def start(self):
-        # plain relays delegate the hot path (frame parsing, circuit
-        # forwarding, pending-write pumping) to the C engine; the control
-        # plane (EXTEND connects, teardown observation) stays here.
-        # TorExit overrides enough of the cell handling that it keeps the
-        # full Python model (type check, not isinstance: subclasses opt
-        # out by existing).
+    def _c_engine(self):
+        """The engine gate shared by relay and exit starts: the C relay
+        data path engages when the C engine runs this host and no pcap
+        capture needs the Python dispatch."""
         host = getattr(self.api, "_host", None)
         core = getattr(getattr(host, "colplane", None), "_c", None)
-        if (type(self) is TorRelay and core is not None
-                and host.pcap is None):
-            self._c = core.relay_new(host.id, self._on_ctrl)
+        if core is not None and host.pcap is None:
+            return core
+        return None
+
+    def start(self):
+        # relays delegate the hot path (frame parsing, circuit
+        # forwarding, pending-write pumping) to the C engine; the control
+        # plane (EXTEND connects, teardown observation) stays here.
+        # TorExit runs the same C relay in exit mode (BEGIN cells reach
+        # its _on_ctrl; the reframe loop is a C ExitStream).
+        core = self._c_engine()
+        if type(self) is TorRelay and core is not None:
+            self._c = core.relay_new(self.api._host.id, self._on_ctrl)
             self.api.listen(self.port, self._on_accept_c)
             return
         self.api.listen(self.port, self._on_accept)
@@ -286,8 +293,41 @@ class TorExit(TorRelay):
     """An exit relay: terminates BEGIN cells by fetching from the
     destination (a tgen-format server) and streaming DATA back.
 
+    On the C engine the whole data path is native (round 5): forwarding
+    rides the C relay like plain relays, and the server->client reframe
+    loop runs as a C ExitStream — only the BEGIN/EXTEND control cells
+    (one each per circuit) reach Python.
+
     args: [or_port]
     """
+
+    def start(self):
+        core = self._c_engine()
+        if core is not None:
+            self._c = core.relay_new(self.api._host.id, self._on_ctrl,
+                                     True)
+            self.api.listen(self.port, self._on_accept_c)
+            return
+        self.api.listen(self.port, self._on_accept)
+
+    def _on_ctrl(self, cid, ctype, circ, payload):
+        if ctype != BEGIN:
+            super()._on_ctrl(cid, ctype, circ, payload)
+            return
+        # exit termination: connect to the destination, announce
+        # CONNECTED, and hand the reframe loop to the C stream
+        dest, port, want = payload.decode().split(":")
+        api = self.api
+        ep = api.connect(dest, int(port))
+        want_n = int(want)
+
+        def on_connected(now):
+            ep.send(payload=str(want_n).encode().rjust(8))
+            self._c.write_cell(cid, CONNECTED, circ)
+
+        ep.on_connected = on_connected
+        self._c.exit_stream(ep, cid, circ, want_n)
+        ep.connect()
 
     def _on_cell(self, cid, ctype, circ, payload):
         if ctype != BEGIN or (cid, circ) in self.table:
