@@ -254,11 +254,8 @@ def managed_dense_contended(n_procs: int = 100, iters: int = 4000,
     managed processes pumping simultaneously, so the number includes
     worker-loop scheduling across many live guests, not just the
     per-round-trip floor the 4-process row measures."""
-    out = managed_dense_bench(n_procs=n_procs, iters=iters, chunk=chunk,
-                              tag="managed_dense_contended")
-    log(f"managed_dense_contended: {out['syscalls_per_wall_sec']:.0f}/s "
-        f"across {n_procs} live guests")
-    return out
+    return managed_dense_bench(n_procs=n_procs, iters=iters, chunk=chunk,
+                               tag="managed_dense_contended")
 
 
 def real_binary_bench(n_servers: int = 3, n_clients: int = 12,

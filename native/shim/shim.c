@@ -598,8 +598,12 @@ static void sigsys_handler(int signo, siginfo_t *info, void *vctx) {
       int64_t r = shim_ring_write(fd0, (uint64_t)g[REG_RSI],
                                   (uint64_t)g[REG_RDX]);
       if (r != INT64_MIN) { g[REG_RAX] = (greg_t)r; return; }
-    } else if (info->si_syscall == SYS_close) {
-      shim_ring_drop(fd0); /* then forward the close */
+    } else if (info->si_syscall == SYS_close ||
+               info->si_syscall == SYS_shutdown) {
+      /* close drops both roles; shutdown conservatively drops them too
+       * (a SHUT_RD end must EOF instead of serving buffered ring data —
+       * subsequent ops forward and the worker owns the semantics) */
+      shim_ring_drop(fd0); /* then forward */
     }
   }
   if ((info->si_syscall == SYS_dup2 || info->si_syscall == SYS_dup3) &&

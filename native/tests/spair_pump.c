@@ -1,0 +1,28 @@
+/* spair_pump — socketpair analog of pump.c for the shring fast path. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+  long iters = argc > 1 ? atol(argv[1]) : 10000;
+  size_t chunk = argc > 2 ? (size_t)atol(argv[2]) : 512;
+  if (chunk > 4096) chunk = 4096;
+  int sv[2];
+  if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) {
+    perror("socketpair");
+    return 1;
+  }
+  char *buf = malloc(chunk);
+  memset(buf, 0x5a, chunk);
+  unsigned long sum = 0;
+  for (long i = 0; i < iters; i++) {
+    buf[0] = (char)(i & 0xFF);
+    if (write(sv[0], buf, chunk) != (ssize_t)chunk) { perror("write"); return 1; }
+    if (read(sv[1], buf, chunk) != (ssize_t)chunk) { perror("read"); return 1; }
+    sum += (unsigned char)buf[0];
+  }
+  printf("spair-pump-ok iters=%ld chunk=%zu sum=%lu\n", iters, chunk, sum);
+  return 0;
+}

@@ -355,7 +355,7 @@ class RingPipeBuf(PipeBuf):
     u64, readers u32, writers u32, has_waiters u32, dirty u32, fast_ok
     u32, pad u32, shim_ops u64. rpos/wpos are free-running counters."""
 
-    __slots__ = ("memfd", "mm", "registry")
+    __slots__ = ("memfd", "mm", "registry", "attached")
     HDR = 4096
     MAGIC = 0x53524E47
 
@@ -374,6 +374,10 @@ class RingPipeBuf(PipeBuf):
         #: one sim's rings never leak into the next.
         self.registry = registry
         registry[self] = None
+        #: set once both ends are wired (sync_refs at creation); the
+        #: retire/fast-off guards key on THIS, not r_end — a spair
+        #: shutdown(SHUT_RD) nulls r_end and must not defeat them
+        self.attached = False
 
     # positions
     def _rw(self):
@@ -419,9 +423,13 @@ class RingPipeBuf(PipeBuf):
     def sync_refs(self) -> None:
         if self.mm.closed:
             return
+        if self.r_end is not None or self.w_end is not None:
+            self.attached = True
         struct.pack_into("<II", self.mm, 24, self.readers, self.writers)
-        if (self.r_end is not None and self.readers == 0
-                and self.writers == 0):
+        if self.attached and self.readers == 0:
+            # nobody may read (last close OR shutdown(SHUT_RD)): local
+            # service must stop — a fork sibling's installed mapping
+            # would otherwise fast-read bytes that must EOF
             struct.pack_into("<I", self.mm, 40, 0)  # fast_ok off
 
     def maybe_retire(self) -> None:
@@ -888,9 +896,10 @@ class ManagedProcess(ProcessLifecycle):
         # inert and leak a shim table slot
         traps = fd >= VFD_BASE or (fd == 0 if role == 0 else fd in (1, 2))
         if (not traps or not isinstance(pb, RingPipeBuf) or pb.mm.closed
-                or not isinstance(ret, int) or fd in self._ring_offered):
+                or not isinstance(ret, int)
+                or (fd, role) in self._ring_offered):
             return ret
-        self._ring_offered.add(fd)
+        self._ring_offered.add((fd, role))
         th = self._cur
         try:
             th.sock.sendall(struct.pack("<q", MAPRING))
@@ -1756,17 +1765,19 @@ class ManagedProcess(ProcessLifecycle):
         return 0
 
     # -- pipes + dup (descriptor-table breadth; pipes work across fork) ----
-    def _pipe(self, fds_ptr: int, flags: int):
+    def _ring_bufs(self, n: int) -> list:
+        """``n`` guest-shared memory rings (native/shring.h — the shim
+        serves non-blocking ops locally, zero worker round trips) when
+        eligible; plain worker-side buffers under strace / modeled
+        syscall latency, which must see every call."""
         if self._strace is None and self._syscall_latency == 0:
-            # guest-shared memory ring (native/shring.h): the shim serves
-            # non-blocking reads/writes locally, zero worker round trips.
-            # strace / modeled-syscall-latency need to see every call, so
-            # those modes keep the plain worker-side buffer.
             reg = self.host.controller.__dict__.setdefault(
                 "_ring_registry", {})
-            pb = RingPipeBuf(reg)
-        else:
-            pb = PipeBuf()
+            return [RingPipeBuf(reg) for _ in range(n)]
+        return [PipeBuf() for _ in range(n)]
+
+    def _pipe(self, fds_ptr: int, flags: int):
+        (pb,) = self._ring_bufs(1)
         pb.procs.add(self)
         r = VSocket(self._next_vfd, "pipe_r")
         w = VSocket(self._next_vfd + 1, "pipe_w")
@@ -1795,13 +1806,17 @@ class ManagedProcess(ProcessLifecycle):
         a = VSocket(self._next_vfd, "spair")
         b = VSocket(self._next_vfd + 1, "spair")
         self._next_vfd += 2
-        ab, ba = PipeBuf(), PipeBuf()  # a->b and b->a byte streams
+        # each direction is one ring; an end maps its read ring (role 0)
+        # and its write ring (role 1) via separate offers
+        ab, ba = self._ring_bufs(2)
         ab.procs.add(self)
         ba.procs.add(self)
         ab.w_end, ab.r_end = a, b
         ba.w_end, ba.r_end = b, a
         a.pipe, a.pipe_out = ba, ab
         b.pipe, b.pipe_out = ab, ba
+        ab.sync_refs()  # headers must see readers/writers NOW (shim gate)
+        ba.sync_refs()
         if args[1] & 0o4000:  # SOCK_NONBLOCK
             a.nonblock = b.nonblock = True
         if args[1] & O_CLOEXEC:
@@ -1824,7 +1839,8 @@ class ManagedProcess(ProcessLifecycle):
             old = self.fds.pop(newfd, None)
             if old is not None:
                 self._close_vs(old)
-            self._ring_offered.discard(newfd)  # rebound to a new object
+            self._ring_offered.discard((newfd, 0))  # rebound fd number
+            self._ring_offered.discard((newfd, 1))
         vs.refs += 1
         self.fds[newfd] = vs
         self.fd_cloexec.discard(newfd)  # dup/dup2 clear FD_CLOEXEC
@@ -2148,9 +2164,7 @@ class ManagedProcess(ProcessLifecycle):
             if vs is not None and vs.kind in ("pipe_w", "spair"):
                 ret = self._pipe_write(
                     vs, self.mem.read(addr, min(n, 1 << 20)))
-                if vs.kind == "pipe_w":
-                    return self._maybe_offer_ring(fd, vs, 1, ret)
-                return ret
+                return self._maybe_offer_ring(fd, vs, 1, ret)
             if vs is not None and vs.kind == "pipe_r":
                 return -EBADF  # write on the read end
             if vs is not None and vs.kind in ("file", "dir"):
@@ -2174,9 +2188,7 @@ class ManagedProcess(ProcessLifecycle):
                 return self._ino_read(vs, args[1], args[2])
             if vs is not None and vs.kind in ("pipe_r", "spair"):
                 ret = self._pipe_read(vs, [(args[1], args[2])])
-                if vs.kind == "pipe_r":
-                    return self._maybe_offer_ring(args[0], vs, 0, ret)
-                return ret
+                return self._maybe_offer_ring(args[0], vs, 0, ret)
             if vs is not None and vs.kind == "pipe_w":
                 return -EBADF  # read on the write end
             return self._vfd_recv(args[0], args[1], args[2])
@@ -2189,7 +2201,8 @@ class ManagedProcess(ProcessLifecycle):
             if vs is None:
                 return -EBADF
             self.fd_cloexec.discard(args[0])
-            self._ring_offered.discard(args[0])  # fd number may be reused
+            self._ring_offered.discard((args[0], 0))  # fd may be reused
+            self._ring_offered.discard((args[0], 1))
             self._close_vs(vs)
             return 0
         if nr == SYS_clock_gettime:
@@ -2577,7 +2590,8 @@ class ManagedProcess(ProcessLifecycle):
                 return 0
             for fd in [f for f in self.fds if lo <= f <= hi]:
                 self.fd_cloexec.discard(fd)
-                self._ring_offered.discard(fd)
+                self._ring_offered.discard((fd, 0))
+                self._ring_offered.discard((fd, 1))
                 self._close_vs(self.fds.pop(fd))
             return 0
         if nr == SYS_mmap:

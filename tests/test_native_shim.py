@@ -1246,3 +1246,27 @@ def test_sysbreadth_managed_matches_native():
         assert out == native.stdout, (out, native.stdout)
         outs.append(out)
     assert outs[0] == outs[1]
+
+
+def test_shring_socketpair_fast_path():
+    """Socketpairs ride the shared-memory rings too (round 5): the dense
+    spair pump runs almost entirely shim-local, data intact, twice."""
+    import shutil
+    cfg_text = SLEEP_CFG.replace(
+        f"path: {BUILD}/sleep_clock",
+        f'path: {BUILD}/spair_pump\n        args: ["3000", "512"]')
+    sums = []
+    for tag in ("a", "b"):
+        shutil.rmtree(f"/tmp/st-sppump-{tag}", ignore_errors=True)
+        cfg = parse_config(yaml.safe_load(cfg_text), {
+            "general.data_directory": f"/tmp/st-sppump-{tag}"})
+        c = Controller(cfg, mirror_log=False)
+        result = c.run()
+        assert result["process_errors"] == [], result["process_errors"]
+        fast = result["counters"].get("shim_fast_syscalls", 0)
+        assert fast >= 5900, f"spair ring barely engaged: {fast}"
+        out = Path(f"/tmp/st-sppump-{tag}/hosts/box/spair_pump.0.stdout"
+                   ).read_text()
+        assert "spair-pump-ok iters=3000" in out, out
+        sums.append((out, result["counters"]))
+    assert sums[0] == sums[1]
