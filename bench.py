@@ -716,11 +716,27 @@ def draw_plane_throughput(n: int = 1_000_000) -> dict:
     np_flags = loss_flags(7, lo, hi, npk, th)
     np_s = time.perf_counter() - t0
     assert (dev_flags == np_flags).all(), "draw-plane bitmatch violated"
+    # the per-PROGRAM floor: dispatch+readback of a minimal batch — this
+    # is the physics behind the ~1.0 device factor on committed configs
+    # (a simulation round carries tens-to-hundreds of units; one program
+    # round trip on a tunneled chip costs the same as numpy-ing
+    # thousands), and why wins need batch size (below) or multi-chip
+    # collectives, not per-round offload
+    k = 512
+    plane.dispatch(lo[:k], hi[:k], npk[:k], th[:k]).read()  # warm shape
+    t0 = time.perf_counter()
+    reps = 10
+    for _ in range(reps):
+        plane.dispatch(lo[:k], hi[:k], npk[:k], th[:k]).read()
+    rt_ms = (time.perf_counter() - t0) / reps * 1000
     out = {
         "batch": n,
         "device_units_per_sec": n / dev_s,
         "numpy_units_per_sec": n / np_s,
         "device_speedup": np_s / dev_s,
+        "device_round_trip_ms_small_batch": round(rt_ms, 3),
+        "numpy_breakeven_units": int(rt_ms / 1000 / max(
+            np_s / n, 1e-12)),
     }
     log(f"draw-plane @1M units: device {out['device_units_per_sec']:.3g}/s "
         f"vs numpy {out['numpy_units_per_sec']:.3g}/s "
