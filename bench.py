@@ -769,6 +769,20 @@ def main() -> None:
     detail: dict = {"machine_note": "tpu_batch uses the local JAX default "
                     "device; thread_per_core is the CPU baseline policy"}
 
+    # untimed warm-up pass per policy BEFORE the measured repetitions
+    # (VERDICT r5 weak #1): the first tpu run of a process pays one-time
+    # costs the steady-state loop never sees again — device attach/floor
+    # calibration finishing mid-run, JAX/XLA compile, numpy/module import,
+    # allocator growth — which made measured run 1 ~2x slower than runs
+    # 2-3 while warmup_wall_seconds (build-phase wall only) reported
+    # 0.2-0.7 s. One full throwaway run per policy moves ALL of that
+    # outside the measurement; its wall is published, not hidden.
+    warmup_runs = {}
+    for pol, tag in (("thread_per_core", "tpc"), ("tpu_batch", "tpu")):
+        r = run_config(args.config, pol, f"{tag}-warmup")
+        warmup_runs[pol] = round(r["total_wall_seconds"], 3)
+    log(f"untimed warm-up runs done: {warmup_runs} (excluded from medians)")
+
     # median-of-3 per policy, INTERLEAVED (VERDICT r3 weak #1): shared-
     # machine load drifts on the scale of one run, so grouping a policy's
     # repetitions correlates the noise with the policy and corrupts the
@@ -804,12 +818,15 @@ def main() -> None:
             tpu["sim_sec_per_wall_sec"] / base["sim_sec_per_wall_sec"], 4),
         "raw_tpu": rates(runs["tpu_batch"]),
         "raw_baseline": rates(runs["thread_per_core"]),
-        "aggregation": f"median-of-{N}, interleaved",
+        "aggregation": f"median-of-{N}, interleaved, after one untimed "
+                       f"full warm-up run per policy",
+        "warmup_run_wall_s": warmup_runs,
     }
     detail["tgen_1k"] = {
         "thread_per_core": base, "tpu_batch": tpu,
         "raw_rates": {p: rates(r) for p, r in runs.items()},
         "spread_rel": spread,
+        "warmup_run_wall_s": warmup_runs,
     }
 
     # results must be identical across policies — a benchmark that diverged

@@ -2341,7 +2341,7 @@ static int cs_on_rto(CEp *e, int64_t now) {
     return 0;
   if (e->adv_wnd > 0) e->retries++;
   if (e->retries > DATA_RETRIES_C)
-    return ce_reset(e, "data retransmission retries exhausted");
+    return ce_reset(e, "connection timed out (ETIMEDOUT): data retransmission retries exhausted");
   int64_t inflight = e->snd_nxt - e->snd_una;
   e->ssthresh = inflight / 2 > MIN_CWND_C ? inflight / 2 : MIN_CWND_C;
   e->cwnd = MIN_CWND_C;
@@ -2676,7 +2676,7 @@ static int ce_sender_drained(CEp *e, int64_t now) {
 static int ce_send_syn(CEp *e, int64_t now) {
   e->syn_tries++;
   if (e->syn_tries > SYN_RETRIES_C)
-    return ce_reset(e, "connection timed out (SYN retries exhausted)");
+    return ce_reset(e, "connection timed out (ETIMEDOUT): SYN retries exhausted");
   int err;
   int64_t w = cep_window(e, &err);
   if (err) return -1;

@@ -107,6 +107,8 @@ def main(argv=None) -> int:
                 "network": cfg.network,
                 "experimental": dataclasses.asdict(cfg.experimental),
                 "hosts": [dataclasses.asdict(h) for h in cfg.hosts],
+                **({"faults": dataclasses.asdict(cfg.faults)}
+                   if cfg.faults is not None else {}),
             },
             indent=2, default=enc,
         ))

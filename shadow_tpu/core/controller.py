@@ -107,6 +107,19 @@ class Controller:
 
         from shadow_tpu.network.fluid import MTU
 
+        #: fault injection (shadow_tpu/faults.py): a faults: section forces
+        #: the pure-Python planes — the C engine caches structures the
+        #: injector mutates mid-run, and the Python planes are the semantic
+        #: reference (cross-policy determinism under churn is asserted by
+        #: tests/test_faults.py). Only wall time moves.
+        faults_cfg = cfg.faults
+        have_faults = faults_cfg is not None and (
+            faults_cfg.events or faults_cfg.churn)
+        if have_faults and cfg.experimental.native_colcore:
+            cfg.experimental.native_colcore = False
+            self.log.info("faults configured: C engine disabled "
+                          "(pure-Python planes carry fault semantics)")
+
         params = NetParams.build(
             host_node=host_node,
             rate_up=rate_up,
@@ -178,6 +191,19 @@ class Controller:
                 if popts.shutdown_time is not None:
                     host.schedule(popts.shutdown_time, proc.shutdown)
 
+        self.faults = None
+        if have_faults:
+            from shadow_tpu.faults import FaultInjector
+
+            self.engine.faults_active = True
+            for h in self.hosts:
+                h.faults_active = True
+            self.faults = FaultInjector(self)
+            self.log.info(
+                f"fault timeline: {len(self.faults.actions)} transitions "
+                f"({len(faults_cfg.events)} configured events, "
+                f"{len(faults_cfg.churn)} churn groups)")
+
         self.counters = Counters()
         self.rounds = 0
         self.events = 0
@@ -224,7 +250,14 @@ class Controller:
         t0 = _walltime.perf_counter()
         now: SimTime = 0
         dyn = cfg.experimental.use_dynamic_runahead
+        faults = self.faults
         while now < stop:
+            if faults is not None:
+                # fault transitions apply at round starts: an action at
+                # time t takes effect at the first boundary >= t — the
+                # same quantization the conservative barrier imposes on
+                # every cross-host effect, so it is policy-independent
+                faults.apply_due(now)
             if dyn:
                 # widen to the smallest latency traffic has actually used
                 # (never narrower than the static conservative window)
@@ -270,11 +303,17 @@ class Controller:
                 nt = min(min((hosts[i].equeue.next_time()
                               for i in self._active), default=T_NEVER),
                          self.engine.pending_head())
+                if faults is not None:
+                    # a pending fault transition is a wake-up: skip-ahead
+                    # must not jump over it (a reboot creates new events)
+                    nt = min(nt, faults.next_time())
                 while self.engine.earliest_outstanding() < nt:
                     self.engine.flush_due(nt)
                     nt = min(min((hosts[i].equeue.next_time()
                                   for i in self._active), default=T_NEVER),
                              self.engine.pending_head())
+                    if faults is not None:
+                        nt = min(nt, faults.next_time())
                 if nt >= T_NEVER:
                     self.log.info(
                         f"no further events at {format_time(round_end)}; ending early"
@@ -366,6 +405,10 @@ class Controller:
             "events": self.events,
             "units_sent": self.engine.units_sent,
             "units_dropped": self.engine.units_dropped,
+            # previously a silent bare attribute (VERDICT: blackholed units
+            # discarded without surfacing); per-host counts additionally
+            # land in the counters under fault injection
+            "units_blackholed": self.engine.units_blackholed,
             "bytes_sent": self.engine.bytes_sent,
             "counters": self.counters.as_dict(),
             "process_errors": errors,
@@ -377,6 +420,8 @@ class Controller:
                 **{k: round(v, 4)
                    for k, v in self.engine.phase_wall.items()},
             },
+            **({"fault_transitions_applied": self.faults.applied}
+               if self.faults is not None else {}),
         }
 
 

@@ -59,6 +59,14 @@ class Host:
         self._n_dgrams = 0
         self._n_dgrams_recv = 0
         self._n_events = 0
+        #: fault injection (shadow_tpu/faults.py): crashed-host flag, and
+        #: per-host accounting for units dropped by teardown (arrivals at
+        #: a down host + parked units cleared at crash) and units the
+        #: engine blackholed for this source (cut links / no route)
+        self.down = False
+        self.faults_active = False  # set when a faults: section exists
+        self._n_teardown = 0
+        self._n_blackholed = 0
         self.ingress_deferred: list[Unit] = []  # ingress-bucket backlog
         self.processes: list = []
         # sockets
@@ -99,9 +107,19 @@ class Host:
             self.counters.add("dgrams_received", self._n_dgrams_recv)
         if self._n_events:
             self.counters.add("events", self._n_events)
+        if self._n_teardown:
+            self.counters.add("units_teardown_dropped", self._n_teardown)
+        if self._n_blackholed:
+            self.counters.add("units_blackholed", self._n_blackholed)
+        if self._n_teardown or self._n_blackholed:
+            # per-host observability for fault experiments: the counts land
+            # in this host's own log file beside the merged totals
+            self.log(f"fault accounting: blackholed={self._n_blackholed} "
+                     f"teardown_dropped={self._n_teardown}")
         self._n_emitted = self._n_delivered = self._n_dgrams = 0
         self._n_dgrams_recv = 0
         self._n_events = 0
+        self._n_teardown = self._n_blackholed = 0
 
     def run_events(self, end: SimTime) -> int:
         """Execute all pending events with time < end (one round's worth).
@@ -172,6 +190,12 @@ class Host:
             ep = self._conns.get((aport, peer, bport))
             if ep is not None:
                 ep.on_loss_notify(seq, nbytes, payload)
+            return
+        if self.down:
+            # crashed host: the arrival is consumed by the dead NIC — no
+            # token charge, no delivery, no response; peers discover the
+            # failure through their own RTO machinery (faults.py)
+            self._n_teardown += 1
             return
         eng = self.engine
         if t >= eng.bootstrap_end:
@@ -338,6 +362,66 @@ class Host:
             self.counters.add("units_unroutable", 1)
             return
         ep.handle(u, now)
+
+    # -- fault lifecycle (shadow_tpu/faults.py) ---------------------------
+    def crash(self, now: SimTime) -> None:
+        """Host crash: instant power loss at a round start. Sockets and
+        parked ingress units are torn down, application timers die with
+        the host; queued BAND_NET arrivals stay queued and are discarded
+        at delivery (event-count parity with the columnar plane, whose
+        resolved arrivals live outside the heap). Processes are killed
+        without exit status; reboot() respawns fresh instances."""
+        from shadow_tpu.core.events import BAND_APP, BAND_FAULT
+
+        self.down = True
+        self.counters.add("host_crashes", 1)
+        torn = 0
+        for ep in list(self._conns.values()):
+            cancel_ctl = getattr(ep, "_cancel_ctl", None)
+            if cancel_ctl is not None:
+                cancel_ctl()
+                ep.sender._cancel_rto()
+                ep.state = 0  # CLOSED — a lingering reference can't emit
+            torn += 1
+        if torn:
+            self.counters.add("conns_torn_down", torn)
+        self._conns.clear()
+        self._listeners.clear()
+        self._udp.clear()
+        self._ack_eps.clear()
+        parked = len(self.ingress_deferred) + len(self.ingress_deferred_rows)
+        if parked:
+            self._n_teardown += parked
+            self.ingress_deferred.clear()
+            self.ingress_deferred_rows.clear()
+        self.equeue.clear_band(BAND_APP)
+        # also clear BAND_FAULT: churn's minimum-1ns downtime draws can
+        # quantize a reboot and the next crash into the SAME round start,
+        # and the reboot's pending respawn must die with the host too
+        self.equeue.clear_band(BAND_FAULT)
+        for p in self.processes:
+            kill = getattr(p, "kill", None)
+            if kill is not None:
+                kill()
+        self.log(f"{now} host crashed")
+
+    def reboot(self, now: SimTime) -> None:
+        """Host reboot: processes that neither exited nor are running
+        respawn as fresh instances, in BAND_FAULT so listeners exist
+        before any same-instant network arrival."""
+        from shadow_tpu.core.events import BAND_FAULT
+
+        self.down = False
+        self.counters.add("host_boots", 1)
+        self.log(f"{now} host rebooted")
+        for p in self.processes:
+            if p.exit_code is None and not p.running:
+                # a process the crash caught BEFORE its configured start
+                # (its spawn event died with the host) still honors its
+                # start_time; everything else restarts at boot
+                t = now if getattr(p, "spawned", True) \
+                    else max(now, p.opts.start_time)
+                self.schedule(t, p.spawn, band=BAND_FAULT)
 
     # -- sockets ----------------------------------------------------------
     def ephemeral_port(self) -> int:

@@ -20,6 +20,12 @@ class GossipNode:
     Peers are chosen as deterministic random host ids != self. Host names
     must be resolvable as ``node{i}`` (use quantity expansion with a host
     template named ``node``).
+
+    environment GOSSIP_REANNOUNCE_SEC=S (default 0 = off): an originator
+    re-announces its own transactions every S seconds — the minimal
+    churn-survival behavior (a flood cut off by a partition or a crashed
+    first hop restarts after the network heals; peers that already hold
+    the tx answer nothing, so a healthy network sees only the INVs).
     """
 
     def __init__(self, api, args, env):
@@ -29,7 +35,9 @@ class GossipNode:
         self.k = int(args[2]) if len(args) > 2 else 4
         self.originate = int(args[3]) if len(args) > 3 else 1
         self.interval = float(args[4]) if len(args) > 4 else 1.0
+        self.reannounce = float(env.get("GOSSIP_REANNOUNCE_SEC", 0))
         self.seen: set[bytes] = set()
+        self.own: list[bytes] = []  # txids this node originated
         self.received_tx = 0
         self.originated = 0
         self._c = None  # C gossip state (set in start when available)
@@ -58,10 +66,14 @@ class GossipNode:
         if self.originate > 0:
             delay = int((0.25 + 0.5 * float(rng.random())) * self.interval * NS_PER_SEC)
             self.api.after(delay, self._originate)
+            if self.reannounce > 0:
+                self.api.after(int(self.reannounce * NS_PER_SEC),
+                               self._reannounce)
 
     def _originate(self):
         self.originated += 1
         txid = f"{self.api.host_id}:{self.originated}".encode()
+        self.own.append(txid)
         if self._c is not None:
             self._c.originate(txid)
         else:
@@ -69,6 +81,11 @@ class GossipNode:
             self._announce(txid)
         if self.originated < self.originate:
             self.api.after(int(self.interval * NS_PER_SEC), self._originate)
+
+    def _reannounce(self):
+        for txid in self.own:
+            self._announce(txid)
+        self.api.after(int(self.reannounce * NS_PER_SEC), self._reannounce)
 
     def _announce(self, txid: bytes, exclude: int = -1):
         for p in self.peers:

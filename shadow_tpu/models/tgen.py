@@ -72,6 +72,16 @@ class TGenClient:
     size:  bytes per transfer ("1 MB" style units ok)
     count: transfers per peer
     mode:  "serial" (one at a time round-robin) | "parallel" (all at once)
+
+    environment TGEN_RETRIES=N (default 0): a transfer that dies with
+    ETIMEDOUT (crashed peer, unhealed partition — the transport's terminal
+    RTO path) reconnects up to N times before counting as failed, so churn
+    configs run to completion. Non-timeout errors never retry.
+
+    environment TGEN_IDLE_TIMEOUT_SEC=S (default 0 = off): arm the
+    transport idle timeout on each connection — a client that is purely
+    RECEIVING has no outstanding data, so only this detects a peer that
+    crashed mid-response (Python transport only; fault configs force it).
     """
 
     def __init__(self, api, args, env):
@@ -83,6 +93,10 @@ class TGenClient:
         self.mode = args[2] if len(args) > 2 else "serial"
         self.port = int(args[3]) if len(args) > 3 else 8080
         self.peers = args[4:]
+        self.retries = int(env.get("TGEN_RETRIES", 0))
+        self.idle_timeout_ns = int(
+            float(env.get("TGEN_IDLE_TIMEOUT_SEC", 0)) * NS_PER_SEC)
+        self.retried = 0
         self.completed = 0
         self.failed = 0
         self.total = self.count * len(self.peers)
@@ -103,7 +117,7 @@ class TGenClient:
             ]
             self._start_transfer(self._serial_queue.pop(0))
 
-    def _start_transfer(self, peer):
+    def _start_transfer(self, peer, attempt=0):
         t_start = self.api.now
         conn = self.api.connect(peer, self.port)
 
@@ -122,6 +136,12 @@ class TGenClient:
             self._next()
 
         def on_error(msg):
+            if "ETIMEDOUT" in msg and attempt < self.retries:
+                self.retried += 1
+                self.api.log(
+                    f"transfer-retry peer={peer} attempt={attempt + 1}: {msg}")
+                self._start_transfer(peer, attempt + 1)
+                return
             self.failed += 1
             self.api.log(f"transfer-failed peer={peer}: {msg}")
             self._next()
@@ -143,6 +163,10 @@ class TGenClient:
             conn.on_data = on_data
         conn.on_connected = on_connected
         conn.on_error = on_error
+        if self.idle_timeout_ns:
+            set_idle = getattr(conn, "set_idle_timeout", None)
+            if set_idle is not None:  # Python transport only (no C twin)
+                set_idle(self.idle_timeout_ns)
         conn.connect()
 
     def _next(self):

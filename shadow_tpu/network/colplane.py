@@ -155,6 +155,9 @@ class ColumnarPlane(DeviceRoutedPlane):
         self.bytes_sent = 0
         self.fault_filter = None
         self.fault_silent = False
+        #: a faults: config section exists (shadow_tpu/faults.py): hosts
+        #: may crash, links may cut; enables per-host blackhole accounting
+        self.faults_active = False
         self.emitters: list = []  # hosts with egress rows this round
         self.ack_hosts: list = []  # hosts owing coalesced barrier acks
         self._deferred: set = set()  # hosts with ingress backlog
@@ -450,6 +453,8 @@ class ColumnarPlane(DeviceRoutedPlane):
             lat = int(graph_lat[sn, dn])
             if lat >= INF_I64:
                 bh += 1
+                if self.faults_active:
+                    self.hosts[src]._n_blackholed += 1
                 continue
             if lat < mul:
                 mul = lat
@@ -529,6 +534,9 @@ class ColumnarPlane(DeviceRoutedPlane):
         keep_rows = rows
         if n_bh:
             self.units_blackholed += n_bh
+            if self.faults_active:
+                for s in src[~reach].tolist():
+                    self.hosts[s]._n_blackholed += 1
             keep = np.flatnonzero(reach)
             kl = keep.tolist()
             keep_rows = [rows[i] for i in kl]

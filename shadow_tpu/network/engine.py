@@ -92,6 +92,9 @@ class NetworkEngine(DeviceRoutedPlane):
         #: recovery must come from its own timers (SURVEY.md §5.3).
         self.fault_filter = None
         self.fault_silent = False
+        #: a faults: config section exists (shadow_tpu/faults.py): hosts
+        #: may crash, links may cut; enables per-host blackhole accounting
+        self.faults_active = False
         self.phase_wall: dict = {}  # per-phase timing lives in colplane
 
         self._deferred: set = set()  # hosts with ingress backlog
@@ -134,6 +137,11 @@ class NetworkEngine(DeviceRoutedPlane):
     def ingress_arrival(self, u: Unit, now: SimTime) -> None:
         """Down-link token bucket at the destination (runs on the dst host's
         thread via its arrival event, or single-threaded from round start)."""
+        h = self.hosts[u.dst]
+        if h.down:
+            # crashed host (faults.py): dead NIC — no charge, no delivery
+            h._n_teardown += 1
+            return
         if now < self.bootstrap_end:
             self.hosts[u.dst].deliver(u, now)
             return
@@ -181,6 +189,11 @@ class NetworkEngine(DeviceRoutedPlane):
         n_bh = n - int(reach.sum())
         if n_bh:
             self.units_blackholed += n_bh
+            if self.faults_active:
+                # per-host accounting (fault experiments): which sources
+                # lost traffic to cut links / no-route
+                for s in src[~reach].tolist():
+                    self.hosts[s]._n_blackholed += 1
             units = [u for u, ok in zip(units, reach) if ok]
             if not units:
                 return

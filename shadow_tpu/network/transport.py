@@ -142,6 +142,8 @@ class StreamSender:
         """The shared loss response (oracle notification OR 3rd dup ack):
         multiplicative decrease + retransmit + RTO reset."""
         self.loss_events += 1
+        if self.ep.host.faults_active:
+            self.ep.host.counters.add("stream_fast_retransmits", 1)
         self.ssthresh = max(self.inflight // 2, MIN_CWND)
         self.cwnd = max(self.cwnd // 2, MIN_CWND)
         self._emit_data(seq, nbytes, payload)
@@ -177,8 +179,19 @@ class StreamSender:
             # toward the retry limit (the backoff below still applies)
             self.retries += 1
         if self.retries > DATA_RETRIES:
-            self.ep._reset("data retransmission retries exhausted")
+            # terminal ETIMEDOUT: an established connection whose peer is
+            # unreachable (crashed host, unhealed partition) dies here,
+            # like TCP's retransmission timeout — the application sees
+            # connection death instead of a silent stall (faults.py)
+            host = self.ep.host
+            if host.faults_active:
+                host.counters.add("stream_timeouts", 1)
+            self.ep._reset(
+                "connection timed out (ETIMEDOUT): data retransmission "
+                "retries exhausted")
             return
+        if self.ep.host.faults_active:
+            self.ep.host.counters.add("stream_rto_retransmits", 1)
         # classic RTO response: collapse to slow start, back off, resend the
         # oldest unacked chunk (its ACK, cumulative, repairs everything else)
         self.ssthresh = max(self.inflight // 2, MIN_CWND)
@@ -349,6 +362,14 @@ class StreamEndpoint:
         self.syn_tries = 0
         self.fin_tries = 0
         self._ctl_timer: Optional[int] = None  # SYN/FIN retransmit timer
+        #: optional idle timeout (the app-level keepalive analog): a pure
+        #: RECEIVER has no outstanding data, so the RTO ladder can never
+        #: detect a dead peer (real TCP has the same blind spot without
+        #: keepalive). When armed, the timer rearms on every arrival and
+        #: its expiry surfaces ETIMEDOUT. Opt-in per endpoint
+        #: (set_idle_timeout); models wire it to an environment knob.
+        self.idle_timeout_ns: Optional[SimTime] = None
+        self._idle_timer: Optional[int] = None
         self.peer_fin = False  # peer closed while we still had data to send
         # deterministic per-path timeout: 2x RTT, floored
         rtt = (host.engine.latency_between(host.id, remote_host)
@@ -383,11 +404,42 @@ class StreamEndpoint:
         self.state = SYN_SENT
         self._send_syn()
 
+    def set_idle_timeout(self, timeout_ns: SimTime) -> None:
+        """Arm (or disarm with None/0) the idle timeout; see the field
+        docstring. Python transport only — the C twin does not carry it
+        (fault configs force the Python planes, where it matters)."""
+        self._cancel_idle()
+        self.idle_timeout_ns = timeout_ns if timeout_ns else None
+        if self.idle_timeout_ns is not None:
+            self._idle_timer = self.host.schedule_in(
+                self.idle_timeout_ns, self._idle_expired)
+
+    def _cancel_idle(self) -> None:
+        if self._idle_timer is not None:
+            self.host.cancel(self._idle_timer)
+            self._idle_timer = None
+
+    def _rearm_idle(self) -> None:
+        if self.idle_timeout_ns is not None:
+            self._cancel_idle()
+            self._idle_timer = self.host.schedule_in(
+                self.idle_timeout_ns, self._idle_expired)
+
+    def _idle_expired(self) -> None:
+        self._idle_timer = None
+        if self.state in (CLOSED, TIME_WAIT):
+            return
+        if self.host.faults_active:
+            self.host.counters.add("stream_timeouts", 1)
+        self._reset("connection timed out (ETIMEDOUT): idle timeout — no "
+                    "traffic from peer")
+
     # -- internals --------------------------------------------------------
     def _send_syn(self) -> None:
         self.syn_tries += 1
         if self.syn_tries > SYN_RETRIES:
-            self._reset("connection timed out (SYN retries exhausted)")
+            self._reset("connection timed out (ETIMEDOUT): SYN retries "
+                        "exhausted")
             return
         self.emit(U.SYN, wnd=self.receiver.window())
         self._ctl_timer = self.host.schedule_in(
@@ -435,6 +487,7 @@ class StreamEndpoint:
     def _drop(self) -> None:
         self._cancel_ctl()
         self.sender._cancel_rto()
+        self._cancel_idle()
         self.state = CLOSED
         self.host.drop_endpoint(self)
 
@@ -446,6 +499,7 @@ class StreamEndpoint:
         self.state = TIME_WAIT
         self._cancel_ctl()
         self.sender._cancel_rto()
+        self._cancel_idle()
         self.host.schedule_in(2 * self.rto_ns, self._drop)
         if was_open and self.on_close is not None:
             self.on_close(now)
@@ -474,6 +528,8 @@ class StreamEndpoint:
         """Field-level arrival dispatch shared by the per-unit plane
         (via handle) and the columnar plane's inbox loop. Control units:
         nbytes = cumulative ack, seq = advertised window."""
+        if self._idle_timer is not None:
+            self._rearm_idle()  # any arrival proves the peer is alive
         if k == U.SYN:
             # (server side) duplicate SYN: the SYNACK was lost — re-ack
             if self.state == ESTABLISHED:

@@ -14,14 +14,19 @@ initialization.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
 
 import jax  # noqa: E402
 
 try:
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 8)
-except RuntimeError:
-    # backends already initialized (platform pinned before pytest started);
-    # tests then run on whatever platform exists — still correct, just not
-    # the 8-device mesh fast path
+except (RuntimeError, AttributeError):
+    # RuntimeError: backends already initialized (platform pinned before
+    # pytest started). AttributeError: this jax predates
+    # jax_num_cpu_devices — the XLA_FLAGS device-count override above
+    # covers it as long as jax wasn't imported before this conftest.
+    # Either way tests run on whatever platform exists — still correct,
+    # just possibly without the 8-device mesh fast path.
     pass

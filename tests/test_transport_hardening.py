@@ -138,7 +138,8 @@ def test_loss_with_oracle_faster_than_rto_only():
     kept selectable): the engine's loss notification must recover a
     dropped DATA unit well before the silent-RTO path would. The default
     dupack mode's equivalents are the fast-retransmit tests below."""
-    ov = {"experimental.stream_loss_recovery": "oracle"}
+    ov = {"experimental.stream_loss_recovery": "oracle",
+          "experimental.loss_oracle": True}  # explicit deprecated-mode gate
     _, r_fast, _ = run_with_fault(U.DATA, count=3, silent=False,
                                   overrides=ov)
     _, r_slow, _ = run_with_fault(U.DATA, count=3, silent=True,

@@ -27,4 +27,22 @@ diff /tmp/ci-det-a.json /tmp/ci-det-b.json
 diff /tmp/ci-det-a.hashes /tmp/ci-det-b.hashes
 echo "determinism OK: $(python -c 'import json;print(json.load(open("/tmp/ci-det-a.json"))["events"])') events bit-identical"
 
+echo "== fault-injection smoke (gossip_churn: partition heal + degrade + host churn) =="
+python -m shadow_tpu examples/gossip_churn.yaml --quiet --json-summary \
+    --data-directory /tmp/ci-churn \
+    | python -c '
+import json, sys
+d = json.load(sys.stdin)
+c = d["counters"]
+trans = d["fault_transitions_applied"]
+crashes, boots = c.get("host_crashes", 0), c.get("host_boots", 0)
+bh, rto = d["units_blackholed"], c.get("stream_rto_retransmits", 0)
+assert d["process_errors"] == [], d["process_errors"]
+assert crashes > 0 and boots > 0, c
+assert bh > 0, "partition cut no traffic"
+assert rto > 0, "no transport recovery seen"
+print(f"fault smoke OK: {trans} transitions, {crashes} crashes/"
+      f"{boots} reboots, {bh} blackholed, {rto} RTO retransmits")
+'
+
 echo "== CI gate passed =="
