@@ -1,0 +1,418 @@
+"""Checkpoint/restore with byte-identical resume + the determinism sentinel.
+
+Shadow's contract is bit-deterministic conservative round-based DES; this
+module makes the *simulator itself* fail well. Any run can be snapshotted at
+a round boundary and resumed so that the continuation is byte-identical to
+the uninterrupted run, and any run can emit a canonical per-round state
+digest stream that turns "whole-run hash mismatch" debugging into a
+bisection (tools/bisect_divergence.py).
+
+Why whole-graph serialization works here: at a round *boundary* the entire
+simulation is quiescent Python state — host event heaps, transport endpoint
+machines, fluid bucket arrays, the columnar pending-arrival store,
+counter-based RNG generators, the fault-timeline cursor. The only
+non-snapshottable state is runtime plumbing (scheduler threads, the JAX
+device plane, the C engine, open pcap streams, real managed-process OS
+state), which is either rebuilt on restore (scheduler, device — both
+result-transparent by existing invariants) or refused up front with a clear
+error (managed processes, pcap).
+
+Before the state walk, ``engine.flush_all()`` materializes every in-flight
+loss-draw batch. Resolving draws early is result-identical by construction
+(flags are pure functions of unit identity and event order is canonicalized
+by per-unit keys), so a checkpointing run stays byte-identical to a
+non-checkpointing run — the property tests/test_checkpoint.py gates.
+
+Closures: event heaps and endpoint callbacks hold nested functions and
+lambdas (model code), which stdlib pickle refuses. ``_SimPickler`` reduces
+any non-importable function to (marshaled code object, module, defaults,
+closure cells); cells are reconstructed empty and filled via a state setter
+so shared cells keep their identity and recursive closures cannot loop the
+pickler. Marshal ties a checkpoint to the interpreter that wrote it, so the
+header records the (major, minor) Python version and loading refuses a
+mismatch — a stale checkpoint fails fast instead of resuming subtly wrong.
+
+SECURITY: a checkpoint is a pickle — loading one executes code. Treat
+checkpoint files like the simulation configs that produced them: trusted
+local artifacts, never untrusted input.
+
+The determinism sentinel (``general.state_digest_every``) reuses the same
+quiescent-boundary walk, but hashes only *plane-independent* observables
+(per-host clocks, uid/event/delivery counters, transport state machines,
+application timer multisets, RNG states, host log content; global unit and
+byte counters, token-bucket arrays, the effective latency/loss matrices,
+the fault cursor). BAND_NET heap entries and the columnar pending store are
+deliberately excluded — the two data planes represent in-flight arrivals
+differently — so one digest stream is comparable across all scheduler
+policies. A divergence in in-flight traffic still surfaces within a round
+or two through the delivery counters and endpoint state it must touch.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib
+import io
+import json
+import marshal
+import mmap
+import os
+import pickle
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import types
+from pathlib import Path
+
+import numpy as np
+
+FORMAT = "shadow_tpu-checkpoint"
+VERSION = 1
+#: config keys that may legitimately differ between the checkpointing run
+#: and the resuming invocation (run-location and snapshot policy, never
+#: simulation semantics)
+VOLATILE_CONFIG_KEYS = (
+    ("general", "data_directory"),
+    ("general", "checkpoint_every"),
+    ("general", "checkpoint_dir"),
+    ("general", "state_digest_every"),
+    ("general", "progress"),
+    ("general", "heartbeat_interval"),
+    ("general", "log_level"),
+)
+
+DIGEST_FILE = "state_digests.jsonl"
+
+
+class CheckpointError(ValueError):
+    """A checkpoint could not be written, read, or applied."""
+
+
+# -- closure-capable pickling -------------------------------------------------
+
+def _rebuild_function(code_bytes, module, name, defaults, kwdefaults,
+                      closure):
+    """Reconstruct a nested function/lambda from its marshaled code object.
+    Globals are the (re-imported) defining module's dict — all model and
+    simulator code is importable, which the save path verified."""
+    glb = importlib.import_module(module).__dict__ if module else {}
+    fn = types.FunctionType(marshal.loads(code_bytes), glb, name,
+                            defaults, closure)
+    if kwdefaults:
+        fn.__kwdefaults__ = kwdefaults
+    return fn
+
+
+def _make_cell():
+    return types.CellType()
+
+
+def _cell_set(cell, state):
+    if state:  # () = the cell was empty (declared but never bound)
+        cell.cell_contents = state[0]
+
+
+#: live runtime objects that must never appear in a checkpoint; hitting one
+#: means a snapshot-preparation bug, and the error should say WHAT leaked
+#: instead of pickle's opaque complaint
+_FORBIDDEN = (
+    (threading.Thread, "thread"),
+    (io.IOBase, "open file"),
+    (socket.socket, "socket"),
+    (mmap.mmap, "memory map"),
+    (subprocess.Popen, "subprocess"),
+)
+
+
+class _SimPickler(pickle.Pickler):
+    def reducer_override(self, obj):
+        if isinstance(obj, types.FunctionType):
+            qn = getattr(obj, "__qualname__", "")
+            if "<locals>" not in qn and "<lambda>" not in qn:
+                return NotImplemented  # importable: pickle by reference
+            mod = obj.__module__
+            if mod is None or mod not in sys.modules:
+                raise CheckpointError(
+                    f"cannot checkpoint closure {qn!r}: defining module "
+                    f"{mod!r} is not importable")
+            return (_rebuild_function,
+                    (marshal.dumps(obj.__code__), mod, obj.__name__,
+                     obj.__defaults__, obj.__kwdefaults__, obj.__closure__))
+        if isinstance(obj, types.CellType):
+            try:
+                state = (obj.cell_contents,)
+            except ValueError:
+                state = ()
+            # contents ride as post-creation state (not a constructor arg)
+            # so cells shared between closures dedupe through the memo and
+            # self-referential closures terminate
+            return (_make_cell, (), state, None, None, _cell_set)
+        for t, label in _FORBIDDEN:
+            if isinstance(obj, t):
+                raise CheckpointError(
+                    f"cannot checkpoint a live {label} ({obj!r}) — "
+                    f"snapshot preparation should have detached it")
+        return NotImplemented
+
+
+# -- config identity ----------------------------------------------------------
+
+def config_digest(cfg) -> str:
+    """Canonical digest of the simulation-semantic part of a config: a
+    resume under a *different* config would not be the same simulation, so
+    load refuses it. Keys in VOLATILE_CONFIG_KEYS are excluded."""
+    import dataclasses
+
+    doc = {
+        "general": dataclasses.asdict(cfg.general),
+        "network": cfg.network,
+        "experimental": dataclasses.asdict(cfg.experimental),
+        "hosts": [dataclasses.asdict(h) for h in cfg.hosts],
+        "faults": (dataclasses.asdict(cfg.faults)
+                   if cfg.faults is not None else None),
+    }
+    for section, key in VOLATILE_CONFIG_KEYS:
+        doc[section].pop(key, None)
+    # checkpointing forces the pure-Python planes (same coercion faults
+    # apply), so the flag's incoming value is not semantic either
+    doc["experimental"].pop("native_colcore", None)
+    blob = json.dumps(doc, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+# -- save / load --------------------------------------------------------------
+
+def checkpoint_path(ckpt_dir: Path, sim_time: int) -> Path:
+    return Path(ckpt_dir) / f"ckpt_t{sim_time:020d}.ckpt"
+
+
+def save_checkpoint(controller, now: int) -> Path:
+    """Serialize the complete simulation state at the round boundary
+    ``now``. Must be called from the controller's round loop (or after it),
+    when no scheduler worker is mid-round."""
+    validate_config_checkpointable(controller.cfg)  # direct-API callers get
+    #                                 the same clear refusal the CLI gets
+    eng = controller.engine
+    eng.flush_all()  # resolve in-flight draws: result-identical, device-free
+    if eng.outstanding:
+        raise CheckpointError(
+            "engine still holds outstanding draw batches after flush_all()")
+    ckpt_dir = Path(controller.ckpt_dir)
+    ckpt_dir.mkdir(parents=True, exist_ok=True)
+    path = checkpoint_path(ckpt_dir, now)
+    header = {
+        "format": FORMAT,
+        "version": VERSION,
+        "python": list(sys.version_info[:2]),
+        "sim_time_ns": now,
+        "rounds": controller.rounds,
+        "events": controller.events,
+        "config_digest": config_digest(controller.cfg),
+    }
+    tmp = path.with_suffix(".tmp")
+    try:
+        # stream the pickle straight into the temp file: a checkpoint at
+        # north-star scale is GBs, and a BytesIO staging copy would hold
+        # the whole thing in RAM twice on top of the live state
+        with open(tmp, "wb") as f:
+            f.write(json.dumps(header, sort_keys=True).encode() + b"\n")
+            _SimPickler(f, protocol=4).dump(
+                {"now": now, "controller": controller})
+    except BaseException:
+        tmp.unlink(missing_ok=True)
+        raise
+    os.replace(tmp, path)  # a torn write can never look like a checkpoint
+    return path
+
+
+def read_header(path) -> dict:
+    with open(path, "rb") as f:
+        line = f.readline()
+    try:
+        header = json.loads(line)
+    except ValueError as exc:
+        raise CheckpointError(f"{path}: not a shadow_tpu checkpoint") from exc
+    if header.get("format") != FORMAT:
+        raise CheckpointError(f"{path}: not a shadow_tpu checkpoint")
+    return header
+
+
+def load_checkpoint(path, cfg=None, mirror_log: bool = True):
+    """Restore a checkpoint; returns ``(controller, resume_at)``.
+
+    ``cfg`` is the current invocation's parsed config: its semantic digest
+    must match the checkpoint's (VOLATILE_CONFIG_KEYS excepted — so the
+    resume may redirect data_directory or change snapshot cadence), and its
+    volatile keys are applied to the restored controller.
+    """
+    header = read_header(path)
+    if header.get("version") != VERSION:
+        raise CheckpointError(
+            f"{path}: checkpoint version {header.get('version')} != "
+            f"supported {VERSION}")
+    if tuple(header.get("python", ())) != tuple(sys.version_info[:2]):
+        raise CheckpointError(
+            f"{path}: written by Python "
+            f"{'.'.join(map(str, header.get('python', ())))}, running "
+            f"{sys.version_info[0]}.{sys.version_info[1]} — marshaled "
+            f"closures are not portable across interpreter versions")
+    if cfg is not None:
+        want, got = header["config_digest"], config_digest(cfg)
+        if want != got:
+            raise CheckpointError(
+                f"{path}: config mismatch — the checkpoint was written "
+                f"under a different simulation config (digest {want[:12]} "
+                f"vs {got[:12]}). Resume with the original config; only "
+                f"data_directory / checkpoint / digest / logging keys may "
+                f"differ.")
+    with open(path, "rb") as f:
+        f.readline()
+        try:
+            # stream-unpickle from the positioned handle: no staging copy
+            # of a potentially multi-GB payload beside the object graph
+            obj = pickle.load(f)
+        except CheckpointError:
+            raise
+        except Exception as exc:
+            raise CheckpointError(
+                f"{path}: corrupt or unreadable checkpoint payload "
+                f"({type(exc).__name__}: {exc})") from exc
+    controller, now = obj["controller"], obj["now"]
+    if cfg is not None:
+        # apply the resume invocation's volatile keys — driven off
+        # VOLATILE_CONFIG_KEYS so exclusion (config_digest) and
+        # application can never drift apart
+        for section, key in VOLATILE_CONFIG_KEYS:
+            setattr(getattr(controller.cfg, section), key,
+                    getattr(getattr(cfg, section), key))
+    controller._reattach_runtime(mirror_log=mirror_log)
+    controller.log.info(
+        f"resumed from {path}: sim time {now} ns, round {controller.rounds}, "
+        f"{controller.events} events")
+    return controller, now
+
+
+# -- determinism sentinel -----------------------------------------------------
+
+def _feed(h, obj) -> None:
+    """Canonical byte encoding of the digest structure (type-tagged,
+    length-prefixed; dict keys sorted) — stable across runs, policies,
+    and platforms."""
+    if obj is None:
+        h.update(b"N")
+    elif isinstance(obj, bool):
+        h.update(b"T" if obj else b"F")
+    elif isinstance(obj, (int, np.integer)):
+        h.update(b"i%d;" % int(obj))
+    elif isinstance(obj, float):
+        h.update(b"f" + struct.pack("<d", obj))
+    elif isinstance(obj, str):
+        b = obj.encode()
+        h.update(b"s%d:" % len(b) + b)
+    elif isinstance(obj, (bytes, bytearray)):
+        h.update(b"b%d:" % len(obj) + bytes(obj))
+    elif isinstance(obj, (list, tuple)):
+        h.update(b"[%d;" % len(obj))
+        for x in obj:
+            _feed(h, x)
+    elif isinstance(obj, dict):
+        h.update(b"{%d;" % len(obj))
+        for k in sorted(obj):
+            _feed(h, k)
+            _feed(h, obj[k])
+    elif isinstance(obj, np.ndarray):
+        h.update(b"a" + str(obj.dtype).encode() + b"|"
+                 + str(obj.shape).encode() + b"|")
+        h.update(np.ascontiguousarray(obj).tobytes())
+    else:
+        raise CheckpointError(
+            f"state digest: unhashable field type {type(obj).__name__}")
+
+
+def _digest(obj) -> str:
+    h = hashlib.sha256()
+    _feed(h, obj)
+    return h.hexdigest()
+
+
+def state_digest(controller, sim_now: int):
+    """Returns ``(global_digest_hex, {host_name: digest_hex})`` over the
+    plane-independent state at the round boundary ``sim_now``.
+
+    Calls ``engine.flush_all()`` first so both data planes (and the lazy
+    draw coalescing inside each) sit at the same resolution frontier —
+    early resolution is result-identical, so a digesting run stays
+    byte-identical to a non-digesting one.
+    """
+    eng = controller.engine
+    eng.flush_all()
+    hosts = {}
+    for h in controller.hosts:
+        hosts[h.name] = _digest(h.state_fingerprint())
+    g = {
+        "t": sim_now,
+        "rounds": controller.rounds,
+        "events": controller.events,
+        "units_sent": eng.units_sent,
+        "units_dropped": eng.units_dropped,
+        "units_blackholed": eng.units_blackholed,
+        "bytes_sent": eng.bytes_sent,
+        "ev_key": eng._ev_key,
+        "tokens_down": eng.tokens_down,
+        # egress buckets: hash the canonical observable, not the raw
+        # (t_base, tokens, debt) triple — the vector path rebases every
+        # source each barrier while the scalar twin rebases lazily, an
+        # outcome-identical representation difference (fluid.py). Capped
+        # available-at-now is identical across planes: any divergence in
+        # actual bucket BEHAVIOR must show here or in the unit counters.
+        "bucket_avail": np.minimum(eng.buckets.available(sim_now),
+                                   eng.params.cap_up),
+        "last_refill": eng._last_refill,
+        # the effective latency/loss/rate matrices are deliberately NOT
+        # hashed: they are pure functions of the config (pinned by
+        # config_digest) and the applied-action cursor below, and at 10k+
+        # graph nodes re-hashing O(nodes^2) matrices every sample would
+        # dominate sentinel cost. A corrupted matrix without a moved
+        # cursor still surfaces within a round or two through the arrival
+        # times, unit counters, and endpoint state it must perturb.
+        "faults": ((controller.faults.idx, controller.faults.applied)
+                   if controller.faults is not None else None),
+        "hosts": hosts,
+    }
+    return _digest(g), hosts
+
+
+def emit_digest(controller, sim_now: int) -> None:
+    """Append one sentinel record to <data_dir>/state_digests.jsonl."""
+    g, hosts = state_digest(controller, sim_now)
+    controller.data_dir.mkdir(parents=True, exist_ok=True)
+    rec = {"round": controller.rounds, "t": sim_now, "digest": g,
+           "hosts": hosts}
+    with open(controller.data_dir / DIGEST_FILE, "a") as f:
+        f.write(json.dumps(rec, sort_keys=True) + "\n")
+
+
+def validate_config_checkpointable(cfg) -> None:
+    """THE checkpointability policy, single source of truth — pure config
+    inspection, so it can fail at build time before anything is
+    constructed. Refused: real managed-process guests (live OS process
+    state cannot be snapshotted) and pcap hosts (captures stream to disk
+    mid-run). See README 'Checkpoint & resume'."""
+    from shadow_tpu.host.process import PluginProcess
+
+    for hopts in cfg.hosts:
+        if hopts.pcap_enabled:
+            raise ValueError(
+                f"checkpoint_every is unsupported with pcap capture: host "
+                f"{hopts.name!r} has pcap_enabled (captures stream to disk "
+                f"mid-run); disable one of the two")
+        for popts in hopts.processes:
+            if not PluginProcess.is_plugin_path(popts.path):
+                raise ValueError(
+                    f"checkpoint_every is unsupported with managed native "
+                    f"processes: host {hopts.name!r} runs {popts.path!r} "
+                    f"(real OS process state cannot be snapshotted — see "
+                    f"README 'Checkpoint & resume'); use pyapp: workloads "
+                    f"or disable checkpointing")

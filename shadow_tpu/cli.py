@@ -35,6 +35,29 @@ def build_parser() -> argparse.ArgumentParser:
         help="override experimental.scheduler_policy",
     )
     p.add_argument(
+        "--checkpoint-every", metavar="SIMTIME",
+        help="write a full-state checkpoint every SIMTIME of simulated "
+        "time (general.checkpoint_every); resumed runs are byte-identical "
+        "to uninterrupted ones",
+    )
+    p.add_argument(
+        "--checkpoint-dir",
+        help="checkpoint directory (general.checkpoint_dir; default "
+        "<data-directory>/checkpoints)",
+    )
+    p.add_argument(
+        "--resume-from", metavar="CKPT",
+        help="resume from a checkpoint file written by --checkpoint-every "
+        "(the config must match the original run)",
+    )
+    p.add_argument(
+        "--state-digest-every", type=int, metavar="N",
+        help="determinism sentinel: emit a canonical state digest every N "
+        "rounds to <data-directory>/state_digests.jsonl "
+        "(general.state_digest_every); diff two streams with "
+        "tools/bisect_divergence.py",
+    )
+    p.add_argument(
         "--set",
         action="append",
         default=[],
@@ -63,6 +86,9 @@ def overrides_from_args(args: argparse.Namespace) -> dict:
         "log_level": "general.log_level",
         "data_directory": "general.data_directory",
         "scheduler_policy": "experimental.scheduler_policy",
+        "checkpoint_every": "general.checkpoint_every",
+        "checkpoint_dir": "general.checkpoint_dir",
+        "state_digest_every": "general.state_digest_every",
     }
     for attr, key in flag_map.items():
         val = getattr(args, attr)
@@ -114,10 +140,39 @@ def main(argv=None) -> int:
         ))
         return 0
 
-    controller = Controller(cfg, mirror_log=not args.quiet)
-    result = controller.run()
+    if args.resume_from:
+        from shadow_tpu.checkpoint import CheckpointError, load_checkpoint
+
+        try:
+            controller, resume_at = load_checkpoint(
+                args.resume_from, cfg, mirror_log=not args.quiet)
+        except FileNotFoundError:
+            print(f"shadow_tpu: checkpoint not found: {args.resume_from}",
+                  file=sys.stderr)
+            return 2
+        except CheckpointError as exc:
+            print(f"shadow_tpu: {exc}", file=sys.stderr)
+            return 2
+        result = controller.run(resume_at=resume_at)
+    else:
+        try:
+            controller = Controller(cfg, mirror_log=not args.quiet)
+        except ValueError as exc:
+            # build-time refusals (checkpoint-unsupported configs, unknown
+            # fault targets, missing executables) keep the clean one-line
+            # error contract instead of a traceback
+            print(f"shadow_tpu: {exc}", file=sys.stderr)
+            return 2
+        result = controller.run()
     if args.json_summary:
         print(json.dumps(result))
+    if result.get("exit_reason") == "interrupted":
+        # conventional signal exit status; the JSON summary above is still
+        # a valid (partial) artifact
+        import signal as _signal
+
+        sig = result.get("interrupt_signal", "SIGINT")
+        return 128 + int(getattr(_signal.Signals, sig, _signal.SIGINT))
     return 1 if result["process_errors"] else 0
 
 

@@ -67,6 +67,17 @@ class GeneralOptions:
     heartbeat_interval: Optional[SimTime] = None
     progress: bool = False
     model_unblocked_syscall_latency: bool = False
+    #: checkpoint/restore (shadow_tpu/checkpoint.py): snapshot the complete
+    #: simulation state every this much SIM time, at a round boundary.
+    #: None = off. Resumed runs are byte-identical to uninterrupted ones.
+    checkpoint_every: Optional[SimTime] = None
+    #: where checkpoints land; default <data_directory>/checkpoints
+    checkpoint_dir: Optional[str] = None
+    #: determinism sentinel: emit a canonical per-round state digest every
+    #: N rounds to <data_directory>/state_digests.jsonl (0 = off). Streams
+    #: are comparable across scheduler policies and data planes; diff two
+    #: with tools/bisect_divergence.py.
+    state_digest_every: int = 0
 
 
 @dataclass
@@ -109,6 +120,12 @@ class ExperimentalOptions:
     #: explicit opt-in gate for the deprecated oracle loss-recovery model:
     #: without it, ``stream_loss_recovery: oracle`` is a config error.
     loss_oracle: bool = False
+    #: guest watchdog (native/managed.py): wall-clock seconds a managed
+    #: process may hold its turn without making a syscall before it is
+    #: killed and converted to a host_down fault (0 = off). Catches the
+    #: spin-wait livelock README declares as a limitation, instead of
+    #: hanging the whole simulator.
+    guest_turn_timeout: float = 0.0
 
 
 @dataclass
@@ -323,6 +340,15 @@ def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
         _require(g.heartbeat_interval > 0, "general.heartbeat_interval must be > 0")
     g.progress = bool(gen.get("progress", False))
     g.model_unblocked_syscall_latency = bool(gen.get("model_unblocked_syscall_latency", False))
+    if gen.get("checkpoint_every") is not None:
+        g.checkpoint_every = parse_time(gen["checkpoint_every"])
+        _require(g.checkpoint_every > 0,
+                 "general.checkpoint_every must be > 0")
+    if gen.get("checkpoint_dir") is not None:
+        g.checkpoint_dir = str(gen["checkpoint_dir"])
+    g.state_digest_every = int(gen.get("state_digest_every", 0))
+    _require(g.state_digest_every >= 0,
+             "general.state_digest_every must be >= 0")
 
     if doc.get("network"):
         cfg.network = doc["network"]
@@ -363,6 +389,9 @@ def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
              "experimental.stream_loss_recovery must be dupack or oracle, "
              f"got {e.stream_loss_recovery!r}")
     e.loss_oracle = bool(exp.get("loss_oracle", False))
+    e.guest_turn_timeout = float(exp.get("guest_turn_timeout", 0.0))
+    _require(e.guest_turn_timeout >= 0,
+             "experimental.guest_turn_timeout must be >= 0")
     _require(
         e.stream_loss_recovery != "oracle" or e.loss_oracle,
         "experimental.stream_loss_recovery: oracle is DEPRECATED (the "

@@ -51,6 +51,12 @@ _GC_EVERY_ROUNDS = 5000
 class Controller:
     def __init__(self, cfg: ConfigOptions, mirror_log: bool = True) -> None:
         self.cfg = cfg
+        if cfg.general.checkpoint_every:
+            # fail at build, not at the first checkpoint boundary 40
+            # minutes in (shadow_tpu/checkpoint.py owns the policy)
+            from shadow_tpu.checkpoint import validate_config_checkpointable
+
+            validate_config_checkpointable(cfg)
         self.data_dir = Path(cfg.general.data_directory)
         self.log = SimLogger(cfg.general.log_level, self.data_dir / "shadow.log",
                              mirror_stderr=mirror_log)
@@ -119,6 +125,17 @@ class Controller:
             cfg.experimental.native_colcore = False
             self.log.info("faults configured: C engine disabled "
                           "(pure-Python planes carry fault semantics)")
+        #: checkpoint/restore + determinism sentinel (shadow_tpu/
+        #: checkpoint.py): both walk the Python-side structures, so like
+        #: faults they force the pure-Python planes (bit-identical to the
+        #: C engine by the test_colcore suite — disabling it cannot change
+        #: results, only wall time)
+        want_snapshots = bool(cfg.general.checkpoint_every) or \
+            cfg.general.state_digest_every > 0
+        if want_snapshots and cfg.experimental.native_colcore:
+            cfg.experimental.native_colcore = False
+            self.log.info("checkpoint/state-digest configured: C engine "
+                          "disabled (snapshots walk the Python planes)")
 
         params = NetParams.build(
             host_node=host_node,
@@ -209,8 +226,66 @@ class Controller:
         self.events = 0
         self.wall_seconds = 0.0
         self._events_wall = 0.0  # scheduler.run_round wall (phase timing)
+        # checkpoint/restore + determinism sentinel (shadow_tpu/checkpoint.py)
+        self.ckpt_every: SimTime = cfg.general.checkpoint_every or 0
+        self.ckpt_dir = (Path(cfg.general.checkpoint_dir)
+                         if cfg.general.checkpoint_dir
+                         else self.data_dir / "checkpoints")
+        self.digest_every = cfg.general.state_digest_every
+        #: set by the SIGINT/SIGTERM handler: the round loop finishes the
+        #: current round, writes a final checkpoint (when enabled), and
+        #: finalizes a valid partial summary instead of dying mid-round
+        self._interrupt = None
+        self._partial = False
         for w in cfg.warnings:
             self.log.warning(w)
+
+    # -- checkpoint/restore (shadow_tpu/checkpoint.py) --------------------
+    def __getstate__(self):
+        """Snapshot-time state: everything except runtime plumbing. The
+        scheduler (worker threads) and the C core are rebuilt by
+        _reattach_runtime on restore; both are result-transparent."""
+        d = self.__dict__.copy()
+        d["scheduler"] = None
+        d["_c_core"] = None
+        return d
+
+    def _reattach_runtime(self, mirror_log: bool = True) -> None:
+        """Rebuild the runtime-only pieces after a checkpoint restore:
+        output location, logger mirroring, scheduler threads, and the
+        device draw plane. Everything simulation-semantic came back
+        through the pickle."""
+        from shadow_tpu.utils.logging import LEVELS
+
+        cfg = self.cfg
+        self.data_dir = Path(cfg.general.data_directory)
+        self.log.path = self.data_dir / "shadow.log"
+        self.log.mirror = mirror_log
+        # log_level is a volatile config key: honor the resume invocation's
+        # value on the main log and on hosts without a per-host override
+        self.log.level = LEVELS[cfg.general.log_level]
+        for h, hopts in zip(self.hosts, cfg.hosts):
+            h.log_level = hopts.log_level or cfg.general.log_level
+        self.ckpt_every = cfg.general.checkpoint_every or 0
+        self.ckpt_dir = (Path(cfg.general.checkpoint_dir)
+                         if cfg.general.checkpoint_dir
+                         else self.data_dir / "checkpoints")
+        self.digest_every = cfg.general.state_digest_every
+        self.scheduler = make_scheduler(
+            cfg.experimental.scheduler_policy, self.hosts,
+            cfg.general.parallelism)
+        self.engine.reattach_device(cfg.experimental)
+        self._c_core = None
+
+    def _on_signal(self, signum, frame) -> None:
+        """SIGINT/SIGTERM: request a graceful stop at the next round
+        boundary. A second signal aborts immediately (the operator means
+        it)."""
+        import signal as _signal
+
+        if self._interrupt is not None:
+            raise KeyboardInterrupt
+        self._interrupt = _signal.Signals(signum).name
 
     # -- naming -----------------------------------------------------------
     def resolve(self, name_or_ip) -> int:
@@ -224,19 +299,56 @@ class Controller:
         return hid
 
     # -- main loop --------------------------------------------------------
-    def run(self) -> dict:
+    def run(self, resume_at: SimTime = None) -> dict:
+        """Drive the simulation to stop_time. ``resume_at`` (set by
+        checkpoint.load_checkpoint) re-enters the round loop at a saved
+        round boundary; all loop-carried state (engine, queues, fault
+        cursor, active set, counters) came back through the snapshot, so
+        the continuation is byte-identical to the uninterrupted run."""
         cfg = self.cfg
         stop = cfg.general.stop_time
         w = self.round_ns
+        now: SimTime = resume_at if resume_at is not None else 0
         self.log.info(
-            f"simulation starting: {len(self.hosts)} hosts, "
+            f"simulation {'resuming' if resume_at is not None else 'starting'}: "
+            f"{len(self.hosts)} hosts, "
             f"{self.graph.n_nodes} graph nodes, round width {format_time(w)}, "
             f"policy {cfg.experimental.scheduler_policy}, stop {format_time(stop)}"
         )
         hb_interval = cfg.general.heartbeat_interval
-        next_hb = hb_interval if hb_interval else T_NEVER
+        next_hb = ((now // hb_interval) + 1) * hb_interval \
+            if hb_interval else T_NEVER
         prog_step = max(stop // 100, 1)
-        next_prog = prog_step if cfg.general.progress else T_NEVER
+        next_prog = now + prog_step if cfg.general.progress else T_NEVER
+        ck_every = self.ckpt_every
+        dig = self.digest_every
+        _ckpt = None
+        if ck_every or dig:
+            from shadow_tpu import checkpoint as _ckpt
+        if dig and resume_at is None:
+            # fresh run: a stale sentinel stream from a previous run into
+            # this data_directory would concatenate and confuse
+            # tools/bisect_divergence.py (resumes keep appending — the
+            # continuation of one stream)
+            (self.data_dir / _ckpt.DIGEST_FILE).unlink(missing_ok=True)
+        next_ckpt = ((now // ck_every) + 1) * ck_every if ck_every \
+            else T_NEVER
+        # graceful shutdown: SIGINT/SIGTERM finish the current round, write
+        # a final checkpoint (when enabled), and produce a valid partial
+        # summary (main thread only — signals cannot be hooked elsewhere)
+        import signal as _signal
+        import threading as _threading
+
+        self._partial = False
+        self._interrupt = None  # a resumed final-checkpoint carries the
+        #                         old signal name; this run starts clean
+        installed = {}
+        if _threading.current_thread() is _threading.main_thread():
+            for s in (_signal.SIGINT, _signal.SIGTERM):
+                try:
+                    installed[s] = _signal.signal(s, self._on_signal)
+                except (ValueError, OSError):
+                    pass
         # the round loop allocates millions of short-lived objects (units,
         # arrival closures, heap entries); generational GC scanning them
         # costs ~40% of wall at 10k-host scale (measured, gossip config).
@@ -248,10 +360,55 @@ class Controller:
         _gc.disable()
         next_gc = _GC_EVERY_ROUNDS
         t0 = _walltime.perf_counter()
-        now: SimTime = 0
         dyn = cfg.experimental.use_dynamic_runahead
         faults = self.faults
+        try:
+            now = self._round_loop(now, stop, w, dyn, faults, next_hb,
+                                   hb_interval, next_prog, prog_step,
+                                   next_gc, next_ckpt, ck_every, dig,
+                                   _ckpt, t0)
+        finally:
+            for s, old in installed.items():
+                _signal.signal(s, old)
+        self._partial = self._interrupt is not None and now < stop
+        if self._partial:
+            self.log.warning(
+                f"{self._interrupt} received: stopped gracefully at round "
+                f"boundary {format_time(now)} ({self.rounds} rounds); "
+                f"summary is partial")
+            if ck_every:
+                path = _ckpt.save_checkpoint(self, now)
+                self.log.info(f"final checkpoint written: {path}")
+        if gc_was_enabled:
+            _gc.enable()
+        _gc.collect()
+        self.engine.flush_all()  # finalize counters for in-flight batches
+        if cfg.general.progress:
+            import sys as _sys
+
+            print(file=_sys.stderr)  # end the \r status line
+        self.wall_seconds = _walltime.perf_counter() - t0
+        self.scheduler.shutdown()
+        return self._finalize(min(now, stop))
+
+    def _round_loop(self, now, stop, w, dyn, faults, next_hb, hb_interval,
+                    next_prog, prog_step, next_gc, next_ckpt, ck_every,
+                    dig, _ckpt, t0) -> SimTime:
+        """The conservative round loop (split from run() so the signal
+        try/finally stays readable). Returns the final sim time."""
+        import gc as _gc
+
         while now < stop:
+            if self._interrupt is not None:
+                # graceful shutdown: the signal arrived during the last
+                # round; stop at this (consistent) round boundary
+                break
+            if now >= next_ckpt:
+                path = _ckpt.save_checkpoint(self, now)
+                self.log.info(
+                    f"checkpoint written: {path} "
+                    f"(sim {format_time(now)}, round {self.rounds})")
+                next_ckpt = ((now // ck_every) + 1) * ck_every
             if faults is not None:
                 # fault transitions apply at round starts: an action at
                 # time t takes effect at the first boundary >= t — the
@@ -281,6 +438,11 @@ class Controller:
             self.engine.end_of_round(now, round_end)
             self.rounds += 1
             self.events += executed
+            if dig and self.rounds % dig == 0:
+                # determinism sentinel: canonical state digest at this
+                # round boundary (flushes in-flight draws first — result-
+                # identical, so digesting runs stay byte-identical)
+                _ckpt.emit_digest(self, round_end)
             if round_end >= next_hb:
                 self._heartbeat(round_end, t0)
                 next_hb += hb_interval
@@ -323,17 +485,7 @@ class Controller:
                 now = max(round_end, nt)
             else:
                 now = round_end
-        if gc_was_enabled:
-            _gc.enable()
-        _gc.collect()
-        self.engine.flush_all()  # finalize counters for in-flight batches
-        if cfg.general.progress:
-            import sys as _sys
-
-            print(file=_sys.stderr)  # end the \r status line
-        self.wall_seconds = _walltime.perf_counter() - t0
-        self.scheduler.shutdown()
-        return self._finalize(min(now, stop))
+        return now
 
     def _progress(self, sim_now: SimTime, stop: SimTime, t0: float) -> None:
         """Terminal status line (reference: the status bar, SURVEY.md §2)."""
@@ -396,6 +548,12 @@ class Controller:
             "sim_seconds": sim_sec,
             "wall_seconds": self.wall_seconds,
             "sim_sec_per_wall_sec": rate,
+            # graceful-shutdown contract: an interrupted run still emits a
+            # VALID summary, marked partial, instead of dying mid-round
+            "exit_reason": "interrupted" if self._partial else "completed",
+            "partial": self._partial,
+            **({"interrupt_signal": self._interrupt}
+               if self._partial else {}),
             # linux ru_maxrss is KiB; the process-wide high-water mark, so
             # it is only per-run when each run owns its process (bench.py's
             # subprocess rows rely on this)

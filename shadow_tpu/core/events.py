@@ -117,5 +117,17 @@ class EventQueue:
             self._cancelled.discard(seq)
             self._live.discard(seq)
 
+    def live_times(self, exclude_band: Optional[int] = None) -> list:
+        """Sorted (time, band) of every pending non-cancelled event,
+        optionally excluding one band — determinism-sentinel fodder
+        (shadow_tpu/checkpoint.py): the multiset of pending timers is
+        plane-independent once BAND_NET is excluded (the per-unit plane
+        queues in-flight arrivals in the heap; the columnar plane holds
+        them in its pending store)."""
+        out = [(e[0], e[1]) for e in self._heap
+               if e[3] not in self._cancelled and e[1] != exclude_band]
+        out.sort()
+        return out
+
     def __len__(self) -> int:
         return len(self._heap) - len(self._cancelled)

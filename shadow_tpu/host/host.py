@@ -10,6 +10,7 @@ interaction happens only through the network engine at round boundaries.
 
 from __future__ import annotations
 
+import hashlib
 from typing import Callable, Optional
 
 from shadow_tpu.core.events import BAND_APP, EventQueue
@@ -75,6 +76,10 @@ class Host:
         self._conns: dict[tuple[int, int, int], StreamEndpoint] = {}
         self._next_ephemeral = EPHEMERAL_BASE
         self._log_lines: list[str] = []
+        #: running hash over the log lines (determinism sentinel): updated
+        #: per append so a digest sample costs O(new bytes), not a re-hash
+        #: of the whole history every sampled round
+        self._log_sha = hashlib.sha256()
         self._ack_eps: dict = {}  # endpoints owing a coalesced barrier ack
         self.pcap = None  # PcapWriter when hosts.<name>.pcap_enabled
         self.log_level = "info"  # per-host override (hosts.<name>.log_level)
@@ -363,6 +368,58 @@ class Host:
             return
         ep.handle(u, now)
 
+    # -- determinism sentinel (shadow_tpu/checkpoint.py) ------------------
+    def state_fingerprint(self) -> dict:
+        """Plane-independent observable state for the per-round state
+        digest. Everything listed is identical across the per-unit and
+        columnar planes (and every scheduler policy) at a round boundary;
+        BAND_NET heap entries are deliberately excluded — the planes
+        represent in-flight arrivals differently (host heap vs pending
+        store), and their effects surface through the counters and
+        endpoint machines below."""
+        from shadow_tpu.core.events import BAND_NET
+
+        conns = []
+        for key in sorted(self._conns):
+            ep = self._conns[key]
+            fp = getattr(ep, "fingerprint", None)
+            conns.append((list(key),
+                          fp() if fp is not None else type(ep).__name__))
+        return {
+            "now": self._now,
+            "uid": self._uid_counter,
+            "down": self.down,
+            "emitted": self._n_emitted,
+            "delivered": self._n_delivered,
+            "dgrams": self._n_dgrams,
+            "dgrams_recv": self._n_dgrams_recv,
+            "events": self._n_events,
+            "teardown": self._n_teardown,
+            "blackholed": self._n_blackholed,
+            "counters": dict(self.counters.c),
+            "rng": self.rng.bit_generator.state,
+            "timers": self.equeue.live_times(exclude_band=BAND_NET),
+            "conns": conns,
+            "listeners": sorted(self._listeners),
+            "udp": sorted(self._udp),
+            "ephemeral": self._next_ephemeral,
+            "log_lines": len(self._log_lines),
+            "log_sha": (self._log_sha.hexdigest()
+                        if self._log_lines else ""),
+        }
+
+    # -- checkpoint/restore (shadow_tpu/checkpoint.py) --------------------
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        del d["_log_sha"]  # hashlib objects cannot pickle; rebuilt below
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._log_sha = hashlib.sha256()
+        for ln in self._log_lines:
+            self._log_sha.update(ln.encode() + b"\n")
+
     # -- fault lifecycle (shadow_tpu/faults.py) ---------------------------
     def crash(self, now: SimTime) -> None:
         """Host crash: instant power loss at a round start. Sockets and
@@ -481,6 +538,7 @@ class Host:
     def log(self, msg: str, level: str = "info") -> None:
         if LOG_LEVELS.index(level) <= LOG_LEVELS.index(self.log_level):
             self._log_lines.append(msg)
+            self._log_sha.update(msg.encode() + b"\n")
 
     def flush_logs(self, data_dir) -> None:
         if not self._log_lines:

@@ -161,6 +161,11 @@ def _zeroed_sets(sets, nbytes: int):
     return [bytearray(nbytes) if s is not None else None for s in sets]
 
 
+#: _read_req sentinel: the guest held its turn past the watchdog deadline
+#: (experimental.guest_turn_timeout) without making a syscall. Shaped like
+#: a (nr, args) tuple so handshake sites treat it as a plain failure.
+_TIMEDOUT = (-2, ())
+
 _BLOCK = object()  # service() sentinel: no reply yet, process parked
 _DETACH = object()  # service() sentinel: reply 0, then stop reading this
                     # thread's channel forever (it announced its exit)
@@ -516,6 +521,11 @@ class ManagedProcess(ProcessLifecycle):
         self._ring_offered: set[int] = set()
         gen = host.controller.cfg.general
         self._syscall_latency = 1000 if gen.model_unblocked_syscall_latency else 0
+        #: guest watchdog (experimental.guest_turn_timeout): wall seconds a
+        #: turn may last without a syscall before the guest is killed and
+        #: the host downed (spin-wait livelock containment; 0 = off)
+        self._turn_timeout = float(
+            host.controller.cfg.experimental.guest_turn_timeout or 0.0)
         # reference: max_unapplied_cpu_latency — modeled syscall latency
         # accumulates and is applied to the clock in batches of this size
         # (fewer, coarser clock bumps; 0 = apply each immediately)
@@ -869,7 +879,7 @@ class ManagedProcess(ProcessLifecycle):
             try:
                 chunk = th.sock.recv(56 - len(buf))
             except socket.timeout:
-                return None
+                return _TIMEDOUT
             except OSError:
                 return None
             if not chunk:
@@ -937,8 +947,16 @@ class ManagedProcess(ProcessLifecycle):
         """Service one thread's syscalls until it blocks in sim time, yields
         the turn, or the process exits."""
         self._cur = th
+        if self._turn_timeout:
+            # every read below is a turn-wait (the guest is never blocked
+            # on US between our reply and its next request), so one socket
+            # timeout covers the whole pump
+            th.sock.settimeout(self._turn_timeout)
         while True:
             req = self._read_req(th)
+            if req is _TIMEDOUT:
+                self._watchdog_fire(th)
+                return
             if req is None:
                 if th.slot == 0:
                     self._exited()  # main channel EOF == process death
@@ -1025,6 +1043,36 @@ class ManagedProcess(ProcessLifecycle):
                 self._exited()
                 return
             self.host.counters.add("syscalls", 1)
+
+    def _watchdog_fire(self, th: GuestThread) -> None:
+        """The guest held its turn past experimental.guest_turn_timeout
+        wall seconds without making a syscall — a userspace spin-wait
+        livelock (the README's declared turn-taking limitation). Kill the
+        guest and convert the stall into the same host_down teardown the
+        fault injector uses, so the simulation keeps its round loop (and
+        its determinism for every OTHER host) instead of hanging forever.
+        A stalled guest stalls every run, so the conversion is observed
+        reproducibly; only the wall instant of detection varies."""
+        host = self.host
+        msg = (f"guest watchdog: {host.name}/{self.name} held its turn for "
+               f"more than {self._turn_timeout:g}s wall without a syscall "
+               f"(spin-wait livelock?) — killing the guest and downing the "
+               f"host (host_down)")
+        host.controller.log.error(msg)
+        host.log(msg, level="error")
+        host.counters.add("guest_watchdog_kills", 1)
+        self._signal_hint = -9  # killed by the watchdog
+        self._kill_now()
+        self._exited()
+        # the host is going down: reap sibling MANAGED guests first —
+        # Host.crash only kills processes exposing .kill (pyapp plugins);
+        # a sibling's live OS process must not outlive its 'down' host
+        for p in host.processes:
+            if p is not self:
+                reap = getattr(p, "reap", None)
+                if reap is not None:
+                    reap()
+        host.crash(host.now)
 
     def _resume(self, th: GuestThread, ret: int) -> None:
         """A continuation fired for a parked thread: queue its turn grant,

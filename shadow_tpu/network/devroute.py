@@ -119,6 +119,31 @@ class DeviceRoutedPlane:
         if t is not None and t.is_alive():
             t.join()
 
+    # -- checkpoint/restore (shadow_tpu/checkpoint.py) ----------------------
+    def __getstate__(self):
+        """Drop the runtime-only device plumbing from snapshots: the JAX
+        device plane, the mesh plane, the init thread, and the C engine
+        are all re-creatable (and result-transparent — routing is pure
+        wall-clock policy, enforced by test_bitmatch / test_multichip /
+        test_colcore)."""
+        d = self.__dict__.copy()
+        for k in ("device", "mesh_plane", "_bg_thread", "_c"):
+            d.pop(k, None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.device = None
+        self.mesh_plane = None
+        self._c = None
+
+    def reattach_device(self, tpu_options) -> None:
+        """Restore-time twin of __init__'s device hookup: re-runs attach,
+        calibration, and floor state from scratch. Calibration state is
+        not carried across a resume — the adaptive floor cannot change
+        results, only wall time."""
+        self._init_device_routing(self.backend, tpu_options, self.params)
+
     # -- adaptive floor -----------------------------------------------------
     def _floor_cooldown_tick(self) -> None:
         """Called on barriers that did NOT use the device: a backed-off

@@ -591,6 +591,18 @@ class StreamEndpoint:
         re-dispatched by endpoint four-tuple (both planes route here)."""
         self.sender._on_oracle_loss(seq, nbytes, payload)
 
+    def fingerprint(self) -> tuple:
+        """Observable protocol state for the determinism sentinel
+        (shadow_tpu/checkpoint.py): the full connection state machine —
+        identical across data planes and scheduler policies at a round
+        boundary, and the first place a divergence in traffic shows up."""
+        s, r = self.sender, self.receiver
+        return (self.state, self.initiator, self.syn_tries, self.fin_tries,
+                self.peer_fin, s.snd_nxt, s.snd_una, s.cwnd, s.ssthresh,
+                s.adv_wnd, s.buffered, s.retries, s.rto_backoff, s.dup_acks,
+                s.loss_events, s.bytes_acked, r.rcv_nxt, r.ooo_bytes,
+                r.bytes_received, r.last_wnd)
+
 
 class DatagramSocket:
     """UDP-like socket with fragmentation/reassembly."""

@@ -1,0 +1,397 @@
+"""Checkpoint/restore, graceful shutdown, and the determinism sentinel
+(shadow_tpu/checkpoint.py).
+
+The load-bearing property: a run resumed from ANY checkpoint produces an
+output tree (and summary) identical to the uninterrupted run — across every
+scheduler policy and with the C engine on or off in the baseline (the
+checkpointing run itself always forces the Python planes, which are pinned
+bit-identical to the C engine by test_colcore). On top of the same state
+walk, the per-round digest stream must be identical across policies and
+data planes, and tools/bisect_divergence.py must name the exact first
+divergent round of a perturbed run.
+"""
+
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+import yaml
+
+from shadow_tpu import checkpoint as ckpt
+from shadow_tpu.config import parse_config
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.core.time import NS_PER_SEC
+
+ROOT = Path(__file__).resolve().parents[1]
+
+BASE = """
+general:
+  stop_time: 60s
+  seed: 3
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "50 Mbit" host_bandwidth_down "50 Mbit" ]
+        node [ id 1 host_bandwidth_up "20 Mbit" host_bandwidth_down "20 Mbit" ]
+        edge [ source 0 target 1 latency "25 ms" packet_loss 0.01 ]
+        edge [ source 0 target 0 latency "5 ms" ]
+        edge [ source 1 target 1 latency "5 ms" ]
+      ]
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+      - path: pyapp:shadow_tpu.models.tgen:TGenServer
+        args: ["8080"]
+  client:
+    network_node_id: 1
+    processes:
+      - path: pyapp:shadow_tpu.models.tgen:TGenClient
+        args: ["8 MB", "2", serial, "8080", server]
+        start_time: 1s
+"""
+
+#: partition 2s..5s: a checkpoint taken with cadence 3s lands mid-partition,
+#: so the resume must replay the heal (link_up) identically
+FAULTS = """
+events:
+  - {time: 2s, kind: link_down, src_nodes: [0], dst_nodes: [1], duration: 3s}
+"""
+
+VOLATILE = ("wall_seconds", "sim_sec_per_wall_sec", "phase_wall",
+            "max_rss_mb")
+
+
+def _strip(summary):
+    for k in VOLATILE:
+        summary.pop(k, None)
+    return summary
+
+
+def _tree(data_dir) -> dict:
+    out = {}
+    hosts_dir = Path(data_dir) / "hosts"
+    if hosts_dir.is_dir():
+        for root, _, files in os.walk(hosts_dir):
+            for f in sorted(files):
+                p = os.path.join(root, f)
+                rel = os.path.relpath(p, data_dir)
+                out[rel] = hashlib.sha256(open(p, "rb").read()).hexdigest()
+    assert out, f"no host output under {data_dir}"
+    return out
+
+
+def _cfg(tmp_path, tag, doc=BASE, faults=None, **overrides):
+    d = yaml.safe_load(doc)
+    if faults:
+        d["faults"] = yaml.safe_load(faults)
+    ov = {"general.data_directory": str(tmp_path / tag)}
+    ov.update(overrides)
+    return parse_config(d, ov)
+
+
+def _run(tmp_path, tag, doc=BASE, faults=None, **overrides):
+    cfg = _cfg(tmp_path, tag, doc, faults, **overrides)
+    summary = Controller(cfg, mirror_log=False).run()
+    return _strip(summary), _tree(tmp_path / tag)
+
+
+def _checkpoints(tmp_path, tag):
+    paths = sorted((tmp_path / tag / "checkpoints").glob("*.ckpt"))
+    assert paths, "no checkpoints written"
+    return paths
+
+
+def _resume(tmp_path, tag, path, doc=BASE, faults=None, **overrides):
+    cfg = _cfg(tmp_path, tag, doc, faults, **overrides)
+    ctl, resume_at = ckpt.load_checkpoint(path, cfg, mirror_log=False)
+    summary = ctl.run(resume_at=resume_at)
+    return _strip(summary), _tree(tmp_path / tag)
+
+
+# -- resume equivalence ------------------------------------------------------
+
+def test_resume_matches_uninterrupted_smoke(tmp_path):
+    """tpu_batch: tree + summary of (checkpoint run, resume-from-first-
+    checkpoint run) both equal the uninterrupted run — checkpointing is
+    transparent AND resume is byte-identical."""
+    ov = {"experimental.scheduler_policy": "tpu_batch"}
+    full_s, full_t = _run(tmp_path, "full", **ov)
+    src_s, src_t = _run(tmp_path, "src",
+                        **{"general.checkpoint_every": "5s", **ov})
+    assert src_s == full_s  # checkpointing run itself is unperturbed
+    assert src_t == full_t
+    res_s, res_t = _resume(tmp_path, "res", _checkpoints(tmp_path, "src")[0],
+                           **ov)
+    assert res_s == full_s
+    assert res_t == full_t
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("policy", ["thread_per_core", "thread_per_host",
+                                    "tpu_batch"])
+@pytest.mark.parametrize("colcore", [True, False])
+def test_resume_equivalence_matrix(tmp_path, policy, colcore):
+    """The full guarantee: for every scheduler policy, with the baseline's
+    C engine on and off, a resume from EVERY checkpoint reproduces the
+    uninterrupted output tree hash exactly. (The checkpointing run forces
+    the Python planes; the C engine is pinned bit-identical to them by
+    test_colcore, so the baseline's colcore setting cannot matter — this
+    asserts it end to end.)"""
+    ov = {"experimental.scheduler_policy": policy}
+    full_s, full_t = _run(tmp_path, "full",
+                          **{"experimental.native_colcore": colcore, **ov})
+    _run(tmp_path, "src", **{"general.checkpoint_every": "10s", **ov})
+    paths = _checkpoints(tmp_path, "src")
+    for i, p in enumerate(paths):
+        res_s, res_t = _resume(tmp_path, f"res{i}", p, **ov)
+        assert res_t == full_t, f"tree mismatch resuming {p.name}"
+        assert res_s == full_s, f"summary mismatch resuming {p.name}"
+
+
+def test_resume_under_active_fault_timeline(tmp_path):
+    """A checkpoint taken mid-partition: the resumed run must replay the
+    heal (link_up) and every later transition identically."""
+    full_s, full_t = _run(tmp_path, "full", faults=FAULTS)
+    assert full_s["fault_transitions_applied"] == 2
+    assert full_s["units_blackholed"] > 0
+    _run(tmp_path, "src", faults=FAULTS,
+         **{"general.checkpoint_every": "3s"})
+    paths = _checkpoints(tmp_path, "src")
+    mid = [p for p in paths
+           if 2 * NS_PER_SEC <= ckpt.read_header(p)["sim_time_ns"]
+           < 5 * NS_PER_SEC]
+    assert mid, "no checkpoint landed inside the partition window"
+    res_s, res_t = _resume(tmp_path, "res", mid[0], faults=FAULTS)
+    assert res_t == full_t
+    assert res_s == full_s
+
+
+def test_resume_rejects_config_mismatch(tmp_path):
+    _run(tmp_path, "src", **{"general.checkpoint_every": "5s"})
+    path = _checkpoints(tmp_path, "src")[0]
+    other = _cfg(tmp_path, "res", **{"general.seed": 99})
+    with pytest.raises(ckpt.CheckpointError, match="config mismatch"):
+        ckpt.load_checkpoint(path, other, mirror_log=False)
+    # volatile keys (data_directory, cadence) may differ: loads fine
+    ok = _cfg(tmp_path, "res2", **{"general.checkpoint_every": "30s"})
+    ctl, t = ckpt.load_checkpoint(path, ok, mirror_log=False)
+    assert t == ckpt.read_header(path)["sim_time_ns"]
+
+
+def test_load_rejects_garbage_and_wrong_python(tmp_path):
+    junk = tmp_path / "junk.ckpt"
+    junk.write_bytes(b"not a checkpoint\n")
+    with pytest.raises(ckpt.CheckpointError, match="not a shadow_tpu"):
+        ckpt.load_checkpoint(junk)
+    bad = tmp_path / "badpy.ckpt"
+    header = {"format": ckpt.FORMAT, "version": ckpt.VERSION,
+              "python": [2, 7], "config_digest": "x", "sim_time_ns": 0}
+    bad.write_bytes(json.dumps(header).encode() + b"\n")
+    with pytest.raises(ckpt.CheckpointError, match="Python"):
+        ckpt.load_checkpoint(bad)
+    trunc = tmp_path / "trunc.ckpt"
+    header["python"] = list(sys.version_info[:2])
+    trunc.write_bytes(json.dumps(header).encode() + b"\n" + b"\x80\x04K")
+    with pytest.raises(ckpt.CheckpointError, match="corrupt"):
+        ckpt.load_checkpoint(trunc)
+
+
+def test_checkpoint_rejects_managed_processes_and_pcap(tmp_path):
+    d = yaml.safe_load(BASE)
+    d["hosts"]["server"]["processes"][0]["path"] = "/bin/sh"
+    cfg = parse_config(d, {
+        "general.data_directory": str(tmp_path / "mg"),
+        "general.checkpoint_every": "1s"})
+    with pytest.raises(ValueError, match="managed native processes"):
+        Controller(cfg, mirror_log=False)
+    cfg = _cfg(tmp_path, "pc", **{"general.checkpoint_every": "1s",
+                                  "hosts.server.pcap_enabled": True})
+    with pytest.raises(ValueError, match="pcap"):
+        Controller(cfg, mirror_log=False)
+
+
+# -- graceful shutdown -------------------------------------------------------
+
+def test_sigint_finishes_round_writes_summary_and_final_checkpoint(tmp_path):
+    """SIGINT mid-run: the loop finishes the current round, writes a final
+    checkpoint, and finalizes a VALID summary with exit_reason=interrupted
+    and partial=true; resuming the final checkpoint completes the run with
+    the uninterrupted run's exact output tree."""
+    _, full_t = _run(tmp_path, "full")
+    cfg = _cfg(tmp_path, "int", **{"general.checkpoint_every": "5s"})
+    ctl = Controller(cfg, mirror_log=False)
+    # deliver a real SIGINT from inside the simulation (deterministic
+    # instant, real handler path — we are the main thread)
+    ctl.hosts[0].schedule(3 * NS_PER_SEC,
+                          lambda: os.kill(os.getpid(), signal.SIGINT))
+    summary = ctl.run()
+    assert summary["exit_reason"] == "interrupted"
+    assert summary["partial"] is True
+    assert summary["interrupt_signal"] == "SIGINT"
+    assert 0 < summary["sim_seconds"] < 60
+    assert summary["rounds"] > 0 and summary["counters"]
+    final = _checkpoints(tmp_path, "int")[-1]
+    assert ckpt.read_header(final)["sim_time_ns"] >= 3 * NS_PER_SEC
+    _, res_t = _resume(tmp_path, "res", final)
+    assert res_t == full_t
+
+
+# -- determinism sentinel ----------------------------------------------------
+
+def test_digest_stream_identical_across_policies(tmp_path):
+    """The sentinel gate: one config, three scheduler policies (spanning
+    both data planes), byte-identical digest streams."""
+    streams = {}
+    for pol in ("thread_per_core", "thread_per_host", "tpu_batch"):
+        _run(tmp_path, f"dg-{pol}",
+             **{"experimental.scheduler_policy": pol,
+                "general.state_digest_every": 50})
+        streams[pol] = (tmp_path / f"dg-{pol}"
+                        / ckpt.DIGEST_FILE).read_bytes()
+    ref = streams["thread_per_core"]
+    assert ref.count(b"\n") >= 3, "too few sentinel records to mean much"
+    for pol, s in streams.items():
+        assert s == ref, f"digest stream diverges under {pol}"
+
+
+def test_digest_emission_is_transparent(tmp_path):
+    """Digesting flushes in-flight draw batches early — result-identical
+    by construction; assert the output tree does not move."""
+    _, plain_t = _run(tmp_path, "plain")
+    _, dg_t = _run(tmp_path, "dg", **{"general.state_digest_every": 25})
+    assert dg_t == plain_t
+
+
+def test_digest_stream_truncated_on_rerun(tmp_path):
+    """Re-running into the same data_directory must not concatenate
+    sentinel streams (duplicate rounds would confuse the bisect tool)."""
+    ov = {"general.state_digest_every": 50}
+    _run(tmp_path, "rr", **ov)
+    once = (tmp_path / "rr" / ckpt.DIGEST_FILE).read_bytes()
+    _run(tmp_path, "rr", **ov)  # same tag -> same data_directory
+    again = (tmp_path / "rr" / ckpt.DIGEST_FILE).read_bytes()
+    assert again == once
+
+
+def _bisect(*paths):
+    r = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "bisect_divergence.py"),
+         *map(str, paths)],
+        capture_output=True, text=True, timeout=60)
+    return r.returncode, r.stdout
+
+
+def test_bisect_divergence_names_round_and_host(tmp_path):
+    recs = [{"round": r, "t": r * 10, "digest": f"d{r}",
+             "hosts": {"alice": f"a{r}", "bob": f"b{r}"}}
+            for r in range(5, 55, 5)]
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    a.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    recs2 = json.loads(json.dumps(recs))  # deep copy
+    for r in recs2:
+        if r["round"] >= 35:  # diverges at round 35, host bob only
+            r["digest"] += "X"
+            r["hosts"]["bob"] += "X"
+    b.write_text("\n".join(json.dumps(r) for r in recs2) + "\n")
+    rc, out = _bisect(a, b)
+    assert rc == 1
+    assert "FIRST DIVERGENT ROUND: 35" in out
+    assert "last matching round: 30" in out
+    assert "bob" in out and "alice" not in out
+    rc, out = _bisect(a, a)
+    assert rc == 0 and "identical" in out
+
+
+def test_bisect_on_real_seed_perturbation(tmp_path):
+    """Two real runs differing only in seed: the tool's answer must equal
+    the first record where the streams actually differ."""
+    for tag, seed in (("p3", 3), ("p4", 4)):
+        _run(tmp_path, tag, **{"general.seed": seed,
+                               "general.state_digest_every": 20})
+    fa = tmp_path / "p3" / ckpt.DIGEST_FILE
+    fb = tmp_path / "p4" / ckpt.DIGEST_FILE
+    ra = [json.loads(l) for l in open(fa)]
+    rb = [json.loads(l) for l in open(fb)]
+    first = next((x["round"] for x, y in zip(ra, rb)
+                  if x["digest"] != y["digest"]), None)
+    assert first is not None, "different seeds produced identical streams?"
+    rc, out = _bisect(fa, fb)
+    assert rc == 1
+    assert f"FIRST DIVERGENT ROUND: {first}" in out
+
+
+# -- guest watchdog (native/managed.py) --------------------------------------
+
+def test_watchdog_converts_held_turn_to_host_down(tmp_path):
+    """A managed guest that holds its turn past guest_turn_timeout without
+    a syscall (userspace spin livelock) is killed and the host downed,
+    with a diagnostic log line — instead of hanging the simulator. Driven
+    with a stand-in guest (a socketpair that never speaks + a real child
+    process), so it runs without the native shim."""
+    import socket as socklib
+
+    from shadow_tpu.config.schema import ProcessOptions
+    from shadow_tpu.native.managed import GuestThread, ManagedProcess
+
+    cfg = _cfg(tmp_path, "wd",
+               **{"experimental.guest_turn_timeout": 0.2})
+    ctl = Controller(cfg, mirror_log=False)
+    host = ctl.hosts[0]
+
+    def stub(path):
+        p = ManagedProcess(host, ProcessOptions(path=path), 0)
+        p.proc = subprocess.Popen(["sleep", "30"])
+        p.threads = {0: GuestThread(0, None)}
+        p.running = True
+        host.processes.append(p)
+        return p
+
+    proc = stub("/bin/spinner")
+    assert proc._turn_timeout == pytest.approx(0.2)
+    sibling = stub("/bin/sibling")  # second managed guest on the host
+    worker, guest = socklib.socketpair(socklib.AF_UNIX, socklib.SOCK_STREAM)
+    proc.sock = worker
+    proc.threads[0].sock = worker
+    try:
+        proc._pump(proc.threads[0])  # guest never speaks -> watchdog
+    finally:
+        guest.close()
+    assert proc.running is False
+    assert proc.exit_code == -9
+    assert proc.proc.poll() is not None  # really killed + reaped
+    # the sibling's live OS process must not outlive its 'down' host
+    assert sibling.running is False
+    assert sibling.proc.poll() is not None
+    assert host.down is True
+    assert host.counters.get("guest_watchdog_kills") == 1
+    assert host.counters.get("host_crashes") == 1
+    assert any("guest watchdog" in ln for ln in host._log_lines)
+
+
+# -- schema --------------------------------------------------------------
+
+def test_schema_validates_new_keys(tmp_path):
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        _cfg(tmp_path, "s1", **{"general.checkpoint_every": 0})
+    with pytest.raises(ValueError, match="state_digest_every"):
+        _cfg(tmp_path, "s2", **{"general.state_digest_every": -1})
+    with pytest.raises(ValueError, match="guest_turn_timeout"):
+        _cfg(tmp_path, "s3", **{"experimental.guest_turn_timeout": -1})
+    cfg = _cfg(tmp_path, "s4", **{"general.checkpoint_every": "250 ms",
+                                  "general.checkpoint_dir": "/tmp/x",
+                                  "general.state_digest_every": 7,
+                                  "experimental.guest_turn_timeout": 1.5})
+    assert cfg.general.checkpoint_every == 250_000_000
+    assert cfg.general.checkpoint_dir == "/tmp/x"
+    assert cfg.general.state_digest_every == 7
+    assert cfg.experimental.guest_turn_timeout == 1.5

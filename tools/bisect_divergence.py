@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Diff two determinism-sentinel digest streams and name the first
+divergent round (and the hosts that diverged there).
+
+The sentinel (``general.state_digest_every`` / ``--state-digest-every N``)
+writes one JSON record per sampled round boundary to
+``<data_dir>/state_digests.jsonl``:
+
+    {"round": R, "t": SIM_NS, "digest": GLOBAL_SHA, "hosts": {name: SHA}}
+
+Two runs of the same config MUST produce identical streams regardless of
+scheduler policy or data plane. When a whole-run output hash mismatches,
+run both configs again with the sentinel enabled and point this tool at
+the two streams: instead of "the trees differ", you get "the first
+divergence is at round 1840 on hosts client3, relay7" — a bisection
+target instead of a haystack.
+
+Usage:
+    python tools/bisect_divergence.py A/state_digests.jsonl B/state_digests.jsonl
+
+Exit status: 0 = streams identical, 1 = divergence found (details on
+stdout), 2 = usage / unreadable input.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _die(msg: str):
+    print(f"bisect_divergence: {msg}", file=sys.stderr)
+    raise SystemExit(2)
+
+
+def load_stream(path: str) -> list[dict]:
+    recs = []
+    try:
+        with open(path) as f:
+            for i, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError as exc:
+                    _die(f"{path}:{i}: bad JSON ({exc})")
+                if "round" not in rec or "digest" not in rec:
+                    _die(f"{path}:{i}: not a sentinel record (need "
+                         f"'round' and 'digest' keys)")
+                recs.append(rec)
+    except OSError as exc:
+        _die(f"cannot read {path}: {exc}")
+    if not recs:
+        _die(f"{path}: empty digest stream")
+    return recs
+
+
+def divergent_hosts(a: dict, b: dict) -> list[str]:
+    ha, hb = a.get("hosts") or {}, b.get("hosts") or {}
+    names = sorted(set(ha) | set(hb))
+    return [n for n in names if ha.get(n) != hb.get(n)]
+
+
+def compare(recs_a: list[dict], recs_b: list[dict]):
+    """Returns None if identical, else a dict describing the first
+    divergence."""
+    by_round_b = {r["round"]: r for r in recs_b}
+    last_match = None
+    for ra in recs_a:
+        rb = by_round_b.get(ra["round"])
+        if rb is None:
+            return {"kind": "missing", "round": ra["round"], "t": ra.get("t"),
+                    "last_match": last_match}
+        if ra["digest"] != rb["digest"]:
+            hosts = divergent_hosts(ra, rb)
+            return {"kind": "digest", "round": ra["round"], "t": ra.get("t"),
+                    "hosts": hosts, "last_match": last_match}
+        last_match = ra["round"]
+    extra = [r["round"] for r in recs_b if r["round"] > recs_a[-1]["round"]]
+    if len(recs_b) != len(recs_a) and not extra:
+        # same round range but different sampling — config mismatch
+        return {"kind": "sampling", "round": None, "t": None,
+                "last_match": last_match}
+    if extra:
+        return {"kind": "extra", "round": extra[0], "t": None,
+                "last_match": last_match}
+    return None
+
+
+def main(argv) -> int:
+    if len(argv) != 2:
+        print(__doc__, file=sys.stderr)
+        return 2
+    recs_a, recs_b = load_stream(argv[0]), load_stream(argv[1])
+    d = compare(recs_a, recs_b)
+    if d is None:
+        print(f"identical: {len(recs_a)} sentinel records agree "
+              f"(through round {recs_a[-1]['round']})")
+        return 0
+    if d["kind"] == "digest":
+        hosts = d["hosts"]
+        where = (f"hosts: {', '.join(hosts)}" if hosts
+                 else "global engine state only (no per-host divergence)")
+        print(f"FIRST DIVERGENT ROUND: {d['round']} (sim t={d['t']} ns)")
+        print(f"  last matching round: {d['last_match']}")
+        print(f"  divergent {where}")
+    elif d["kind"] == "missing":
+        print(f"DIVERGED: stream B has no record for round {d['round']} "
+              f"(last matching round: {d['last_match']}) — run B ended "
+              f"early or sampled differently")
+    elif d["kind"] == "extra":
+        print(f"DIVERGED: stream B continues past stream A (first extra "
+              f"round {d['round']}; last matching round: {d['last_match']}) "
+              f"— run A ended early")
+    else:
+        print("DIVERGED: streams sample different rounds — were both runs "
+              "given the same state_digest_every?")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

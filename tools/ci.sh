@@ -27,6 +27,23 @@ diff /tmp/ci-det-a.json /tmp/ci-det-b.json
 diff /tmp/ci-det-a.hashes /tmp/ci-det-b.hashes
 echo "determinism OK: $(python -c 'import json;print(json.load(open("/tmp/ci-det-a.json"))["events"])') events bit-identical"
 
+echo "== checkpoint/resume smoke (tgen_100host: snapshot mid-run, resume, tree-hash equality) =="
+rm -rf /tmp/ci-ckpt-full /tmp/ci-ckpt-src /tmp/ci-ckpt-resume
+python -m shadow_tpu examples/tgen_100host.yaml --quiet \
+    --data-directory /tmp/ci-ckpt-full
+python -m shadow_tpu examples/tgen_100host.yaml --quiet \
+    --data-directory /tmp/ci-ckpt-src --checkpoint-every 5s
+ck=$(ls /tmp/ci-ckpt-src/checkpoints/ckpt_*.ckpt | head -1)
+echo "resuming from $ck"
+python -m shadow_tpu examples/tgen_100host.yaml --quiet \
+    --data-directory /tmp/ci-ckpt-resume --resume-from "$ck"
+(cd /tmp/ci-ckpt-full && find hosts -type f | sort | xargs sha256sum) \
+    > /tmp/ci-ckpt-full.hashes
+(cd /tmp/ci-ckpt-resume && find hosts -type f | sort | xargs sha256sum) \
+    > /tmp/ci-ckpt-resume.hashes
+diff /tmp/ci-ckpt-full.hashes /tmp/ci-ckpt-resume.hashes
+echo "checkpoint/resume OK: resumed output tree bit-identical ($(wc -l < /tmp/ci-ckpt-full.hashes) files)"
+
 echo "== fault-injection smoke (gossip_churn: partition heal + degrade + host churn) =="
 python -m shadow_tpu examples/gossip_churn.yaml --quiet --json-summary \
     --data-directory /tmp/ci-churn \
