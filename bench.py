@@ -334,7 +334,27 @@ def real_binary_bench(n_servers: int = 3, n_clients: int = 12,
     return out
 
 
-def ablation(path: str, tag: str, base: dict, full: dict) -> dict:
+def _device_verdict(tag: str, row: dict, device_x: float) -> bool:
+    """Round-5 Weak #5: a sub-1.0 device factor or a device that never
+    serviced a window must be LOUD, not buried in a JSON blob. Stamps
+    and returns the ``device_engaged`` flag for the row the factor was
+    actually measured on."""
+    engaged = row.get("device_windows_dispatched", 0) > 0
+    row["device_engaged"] = engaged
+    if not engaged:
+        log(f"WARNING {tag}: device_engaged=false — the tpu_batch run "
+            f"serviced ZERO fused windows on the device; the numpy/C twin "
+            f"carried the whole run (this is NOT a TPU result)")
+    verdict = "WIN" if device_x > 1.0 else (
+        "WASH" if device_x >= 0.99 else "LOSS")
+    log(f"device is a net {verdict} on {tag}: device_x={device_x} "
+        f"(windows={row.get('device_windows_dispatched', 0)}, "
+        f"spec_hits={row.get('device', {}).get('spec_hits', 0)})")
+    return engaged
+
+
+def ablation(path: str, tag: str, base: dict, full: dict,
+             reps: int = 1, full_rates: list = None) -> dict:
     """Per-config headline decomposition (VERDICT r4 item #1): two extra
     rows isolate what each ingredient of the tpu_batch policy buys —
 
@@ -345,28 +365,68 @@ def ablation(path: str, tag: str, base: dict, full: dict) -> dict:
       total = architecture (columnar-python / per-unit-python)
             x c_engine     (columnar-C / columnar-python)
             x device       (full tpu_batch / columnar-C)
-    All four rows are asserted result-identical; only wall time moves."""
-    c_cpu = run_config(path, "tpu_batch", f"{tag}-ccpu",
-                       {"experimental.tpu_device_floor": -1})
-    py_cpu = run_config(path, "tpu_batch", f"{tag}-pycpu",
-                        {"experimental.tpu_device_floor": -1,
-                         "experimental.native_colcore": False})
+    All rows are asserted result-identical; only wall time moves.
+
+    ``reps`` > 1 measures the ablation rows with the same interleaved
+    median-of-N discipline as the headline (shared-machine noise drifts
+    on the scale of one run; a single-run device_x is noise-dominated
+    exactly where the factor matters). ``full_rates`` carries the
+    headline row's raw rates so the published factors' provenance is
+    recomputable."""
+    cs, ps, fs = [], [], []
+    for i in range(reps):
+        cs.append(run_config(path, "tpu_batch", f"{tag}-ccpu",
+                             {"experimental.tpu_device_floor": -1}))
+        # device_x's two sides must share the SAME noise window: a fresh
+        # full-path rep rides next to each device-off rep (the headline
+        # full rows were measured minutes earlier against the
+        # thread_per_core baseline — machine drift between those windows
+        # lands straight in the factor otherwise)
+        if reps > 1:
+            fs.append(run_config(path, "tpu_batch", f"{tag}-devx"))
+        ps.append(run_config(path, "tpu_batch", f"{tag}-pycpu",
+                             {"experimental.tpu_device_floor": -1,
+                              "experimental.native_colcore": False}))
+
+    def med(rs):
+        return sorted(rs, key=lambda r: r["sim_sec_per_wall_sec"])[
+            len(rs) // 2]
+
+    c_cpu, py_cpu = med(cs), med(ps)
+    full_dev = med(fs) if fs else full
     for k in ("events", "units_sent", "units_dropped", "bytes_sent"):
         assert c_cpu[k] == full[k] and py_cpu[k] == full[k], (tag, k)
+        assert full_dev[k] == full[k], (tag, k)
 
     def x(a, b):
         return round(a["sim_sec_per_wall_sec"] / b["sim_sec_per_wall_sec"], 3)
 
-    return {
+    out = {
         "tpu_columnar_python_cpu": py_cpu,
         "tpu_columnar_c_cpu": c_cpu,
         "factors": {
             "architecture_x": x(py_cpu, base),
             "c_engine_x": x(c_cpu, py_cpu),
-            "device_x": x(full, c_cpu),
+            "device_x": x(full_dev, c_cpu),
             "total_x": x(full, base),
         },
     }
+    if reps > 1:
+        out["ablation_raw_rates"] = {
+            "tpu_columnar_c_cpu": [
+                round(r["sim_sec_per_wall_sec"], 3) for r in cs],
+            "tpu_batch_devx": [
+                round(r["sim_sec_per_wall_sec"], 3) for r in fs],
+            "tpu_columnar_python_cpu": [
+                round(r["sim_sec_per_wall_sec"], 3) for r in ps],
+            "tpu_batch_headline": full_rates or [],
+            "aggregation": f"median-of-{reps}, interleaved; device_x = "
+                           f"median(tpu_batch_devx)/median(c_cpu), "
+                           f"same-window pairs",
+        }
+    out["device_engaged"] = _device_verdict(
+        tag, full_dev if fs else full, out["factors"]["device_x"])
+    return out
 
 
 def real_curl_1k(n_servers: int = 50, n_clients: int = 200,
@@ -834,9 +894,14 @@ def main() -> None:
     for k in ("events", "units_sent", "units_dropped", "bytes_sent"):
         assert base[k] == tpu[k], f"policy divergence on {k}"
 
-    # headline-config ablation (VERDICT r4 item #1): decompose the ratio
-    detail["tgen_1k"].update(ablation(args.config, "tgen_1k", base, tpu))
+    # headline-config ablation (VERDICT r4 item #1): decompose the ratio.
+    # The ablation rows run median-of-3 interleaved like the headline
+    # (round-5 Weak #5: a single-run device_x is noise where it matters).
+    detail["tgen_1k"].update(ablation(args.config, "tgen_1k", base, tpu,
+                                      reps=N, full_rates=rates(
+                                          runs["tpu_batch"])))
     headline["factors"] = detail["tgen_1k"]["factors"]
+    headline["device_engaged"] = detail["tgen_1k"]["device_engaged"]
     log(f"tgen_1k factors: {headline['factors']}")
 
     if args.all:

@@ -43,6 +43,14 @@
 #include <stdint.h>
 #include <string.h>
 
+/* Python < 3.12 spells the member API via structmember.h (T_INT/READONLY);
+ * 3.12 moved the canonical names into Python.h. Compile against both. */
+#ifndef Py_T_INT
+#include <structmember.h>
+#define Py_T_INT T_INT
+#define Py_READONLY READONLY
+#endif
+
 static int64_t tm_sect[12];
 static int64_t tm_cnt[12];
 #ifdef COLCORE_TIMERS
@@ -337,7 +345,50 @@ typedef struct {
   /* scratch buffers reused across barriers */
   struct BRow *brow;
   int brow_cap;
+  /* speculative forward windows (fused multi-round device windows): the
+   * plane dispatches PREFIX-MIN threefry draws for FUTURE uids under
+   * each host's recent npkts classes; the barrier's inline-draw loop
+   * consults the installed table — uid-range + exact npkts match, and
+   * dropped == (min_draw < thresh) for ANY thresh, so one speculated row
+   * serves every destination. A stale or wrong guess falls back to the
+   * inline threefry twin and can never change results. */
+  struct SpecHost *spec;
+  int spec_on;
+  int64_t spec_hits, spec_draws; /* drained by Core_spec_stats */
+  int32_t *spec_dq; /* demand queue: host ids awaiting a window */
+  int spec_dq_n, spec_dq_cap;
 } CoreObject;
+
+/* per-host speculative window + npkts class tracker. Two generations:
+ * the live window [u0, u0+n) plus a staged continuation [nu0, nu0+nn)
+ * prefetched when consumption passes 3/4 of the live one, so a steady
+ * flow never sees a speculation gap while a wave is in flight. */
+typedef struct SpecHost {
+  uint64_t u0;          /* first speculated uid (live window) */
+  int32_t n;            /* speculated draws per class */
+  int32_t npk_a, npk_b; /* the INSTALLED window's npkts classes (bound to
+                         * min_a/min_b at install; immutable until then) */
+  uint32_t *min_a, *min_b; /* per-uid prefix-min 24-bit draws */
+  uint64_t nu0; /* staged continuation window */
+  int32_t nn;
+  int32_t nnpk_a, nnpk_b;
+  uint32_t *nmin_a, *nmin_b;
+  uint8_t ready;    /* live mins consultable */
+  uint8_t nready;   /* staged mins present */
+  uint8_t inflight; /* demanded; a wave is being drawn */
+  int32_t tnpk_a, tnpk_b; /* class TRACKER: two most-recent npkts (kept
+                           * apart from the window labels — a transient
+                           * third class must not invalidate good mins) */
+  int32_t run;      /* live-draw momentum (halved on a class change) */
+  int32_t want;     /* next window size (doubles on productive exhaust) */
+} SpecHost;
+
+#define SPEC_MIN_RUN 16  /* live draws before a host earns speculation */
+#define SPEC_WANT0 128   /* first window size (units per class) */
+#define SPEC_WANT_MAX 1024
+/* classes cheaper than this many packet draws stay inline: a speculative
+ * hit saves ~npk packet draws, and the consult itself is not free */
+#define SPEC_MIN_NPK 4
 
 /* one barrier row during assembly (all fields packed; `payload` is an
  * owned ref the barrier releases — or hands to the store — when done) */
@@ -1131,6 +1182,250 @@ static void depart_closed_form(CoreObject *c, BRow *br, int n,
   }
 }
 
+/* ---- speculative forward windows -------------------------------------- */
+static void spec_enqueue(CoreObject *c, int32_t hid) {
+  SpecHost *s = &c->spec[hid];
+  if (s->inflight) return;
+  if (c->spec_dq_n == c->spec_dq_cap) {
+    int ncap = c->spec_dq_cap ? c->spec_dq_cap * 2 : 256;
+    int32_t *nq = realloc(c->spec_dq, sizeof(int32_t) * (size_t)ncap);
+    if (!nq) return; /* no memory: simply don't speculate */
+    c->spec_dq = nq;
+    c->spec_dq_cap = ncap;
+  }
+  s->inflight = 1;
+  c->spec_dq[c->spec_dq_n++] = hid;
+}
+
+/* One live unit at the inline-draw point: track its npkts class, consult
+ * the host's speculative window (dropped == min_draw < thresh), and file
+ * demand when the host has earned a (larger) window. Returns the drop
+ * flag (0/1) on a verified hit, -1 on a miss (caller draws inline). */
+static inline int spec_consult(CoreObject *c, BRow *b) {
+  SpecHost *s = &c->spec[b->src];
+  if (s->tnpk_a == b->npk) {
+    s->run++;
+  } else if (s->tnpk_b == b->npk) {
+    /* keep A = most recent: swap so two alternating classes (full data
+     * units one way, single-packet acks the other) both stay tracked */
+    int32_t tn = s->tnpk_a;
+    s->tnpk_a = s->tnpk_b;
+    s->tnpk_b = tn;
+    s->run++;
+  } else {
+    s->tnpk_b = s->tnpk_a;
+    s->tnpk_a = b->npk;
+    s->run >>= 1; /* momentum survives an occasional odd-sized unit */
+  }
+  if (s->ready && b->uid >= s->u0 + (uint64_t)s->n && s->nready) {
+    /* live window exhausted with a staged continuation: promote it */
+    free(s->min_a);
+    free(s->min_b);
+    s->u0 = s->nu0;
+    s->n = s->nn;
+    s->min_a = s->nmin_a;
+    s->min_b = s->nmin_b;
+    /* the tracker classes may have drifted since the stage was demanded;
+     * consult below matches against the STAGED classes */
+    s->npk_a = s->nnpk_a;
+    s->npk_b = s->nnpk_b;
+    s->nmin_a = s->nmin_b = NULL;
+    s->nready = 0;
+    if (s->want < SPEC_WANT_MAX) s->want *= 2;
+  }
+  if (s->ready) {
+    uint64_t off = b->uid - s->u0;
+    if (off < (uint64_t)s->n) {
+      uint32_t *mins = (b->npk == s->npk_a) ? s->min_a
+                       : (b->npk == s->npk_b) ? s->min_b
+                                              : NULL;
+      if (!s->nready && !s->inflight && s->run >= SPEC_MIN_RUN
+          && off >= (uint64_t)(s->n - (s->n >> 2)))
+        spec_enqueue(c, b->src); /* 3/4 consumed: prefetch continuation */
+      if (mins) return mins[off] < b->th;
+    } else if (b->uid >= s->u0 + (uint64_t)s->n) {
+      /* window exhausted with nothing staged: it produced hits, so
+       * double the next one */
+      free(s->min_a);
+      free(s->min_b);
+      s->min_a = s->min_b = NULL;
+      s->ready = 0;
+      if (s->want < SPEC_WANT_MAX) s->want *= 2;
+      if (s->run >= SPEC_MIN_RUN) spec_enqueue(c, b->src);
+    }
+  } else if (!s->inflight && s->run >= SPEC_MIN_RUN
+             && (s->tnpk_a >= SPEC_MIN_NPK
+                 || s->tnpk_b >= SPEC_MIN_NPK)) {
+    if (!s->want) s->want = SPEC_WANT0;
+    spec_enqueue(c, b->src);
+  }
+  return -1;
+}
+
+static PyObject *Core_spec_demand(CoreObject *c, PyObject *args) {
+  int min_hosts = 1;
+  if (!PyArg_ParseTuple(args, "|i", &min_hosts)) return NULL;
+  if (!c->spec) {
+    /* first call from the plane turns speculation on (the plane only
+     * calls once a device has published) */
+    c->spec = calloc((size_t)c->H, sizeof(SpecHost));
+    if (!c->spec) return PyErr_NoMemory();
+    c->spec_on = 1;
+    Py_RETURN_NONE;
+  }
+  /* demand coalescing: waves amortize a fixed dispatch cost, so hold the
+   * queue until a worthwhile cohort forms (the plane forces min_hosts=1
+   * on a coarse age cadence so stragglers still get windows) */
+  if (c->spec_dq_n < min_hosts) Py_RETURN_NONE;
+  int n = c->spec_dq_n;
+  npy_intp dims[1] = {n};
+  PyObject *hosts = PyArray_SimpleNew(1, dims, NPY_INT32);
+  PyObject *u0 = PyArray_SimpleNew(1, dims, NPY_UINT64);
+  PyObject *cnt = PyArray_SimpleNew(1, dims, NPY_INT32);
+  PyObject *npka = PyArray_SimpleNew(1, dims, NPY_INT32);
+  PyObject *npkb = PyArray_SimpleNew(1, dims, NPY_INT32);
+  if (!hosts || !u0 || !cnt || !npka || !npkb) {
+    Py_XDECREF(hosts); Py_XDECREF(u0); Py_XDECREF(cnt); Py_XDECREF(npka);
+    Py_XDECREF(npkb);
+    return NULL;
+  }
+  int32_t *ph = PyArray_DATA((PyArrayObject *)hosts);
+  uint64_t *pu = PyArray_DATA((PyArrayObject *)u0);
+  int32_t *pn = PyArray_DATA((PyArrayObject *)cnt);
+  int32_t *pna = PyArray_DATA((PyArrayObject *)npka);
+  int32_t *pnb = PyArray_DATA((PyArrayObject *)npkb);
+  int out_n = 0;
+  for (int i = 0; i < n; i++) {
+    int32_t hid = c->spec_dq[i];
+    SpecHost *s = &c->spec[hid];
+    if (s->tnpk_a < SPEC_MIN_NPK && s->tnpk_b < SPEC_MIN_NPK) {
+      /* classes drifted cheap since enqueue: a wave row would be
+       * filtered plane-side and the host's inflight flag would stick —
+       * release it here instead so it can re-demand later */
+      s->inflight = 0;
+      continue;
+    }
+    if (s->ready) {
+      /* prefetch: the staged window continues the live one seamlessly */
+      pu[out_n] = s->u0 + (uint64_t)s->n;
+    } else {
+      int64_t ctr;
+      if (attr_i64(c->hs[hid].host, S_uid_counter, &ctr) < 0) {
+        Py_DECREF(hosts); Py_DECREF(u0); Py_DECREF(cnt); Py_DECREF(npka);
+        Py_DECREF(npkb);
+        return NULL;
+      }
+      /* the window starts at the host's NEXT uid: only future units */
+      pu[out_n] = ((uint64_t)hid << 40) | (uint64_t)ctr;
+    }
+    ph[out_n] = hid;
+    pn[out_n] = s->want;
+    pna[out_n] = s->tnpk_a;
+    pnb[out_n] = s->tnpk_b;
+    out_n++;
+  }
+  c->spec_dq_n = 0;
+  if (out_n == 0) {
+    Py_DECREF(hosts); Py_DECREF(u0); Py_DECREF(cnt); Py_DECREF(npka);
+    Py_DECREF(npkb);
+    Py_RETURN_NONE;
+  }
+  if (out_n < n) {
+    /* shrink to the kept cohort (cheap-class hosts were released) */
+    PyArray_Dims nd = {.ptr = (npy_intp[]){out_n}, .len = 1};
+    PyObject *tmp;
+#define SHRINK(arr) \
+    tmp = PyArray_Resize((PyArrayObject *)(arr), &nd, 0, NPY_CORDER); \
+    if (!tmp) { \
+      Py_DECREF(hosts); Py_DECREF(u0); Py_DECREF(cnt); Py_DECREF(npka); \
+      Py_DECREF(npkb); \
+      return NULL; \
+    } \
+    Py_DECREF(tmp);
+    SHRINK(hosts) SHRINK(u0) SHRINK(cnt) SHRINK(npka) SHRINK(npkb)
+#undef SHRINK
+  }
+  return Py_BuildValue("(NNNNN)", hosts, u0, cnt, npka, npkb);
+}
+
+static PyObject *Core_spec_install(CoreObject *c, PyObject *args) {
+  PyObject *hosts, *u0, *cnt, *npka, *npkb, *offa, *offb, *mins;
+  if (!PyArg_ParseTuple(args, "OOOOOOOO", &hosts, &u0, &cnt, &npka, &npkb,
+                        &offa, &offb, &mins))
+    return NULL;
+  if (!c->spec) Py_RETURN_NONE;
+#define DATA(o) PyArray_DATA((PyArrayObject *)(o))
+  int n = (int)PyArray_SIZE((PyArrayObject *)hosts);
+  int32_t *ph = DATA(hosts);
+  uint64_t *pu = DATA(u0);
+  int32_t *pn = DATA(cnt);
+  int32_t *pna = DATA(npka);
+  int32_t *pnb = DATA(npkb);
+  int64_t *poa = DATA(offa);
+  int64_t *pob = DATA(offb);
+  uint32_t *pm = DATA(mins);
+  int64_t mlen = (int64_t)PyArray_SIZE((PyArrayObject *)mins);
+#undef DATA
+  for (int i = 0; i < n; i++) {
+    int32_t hid = ph[i];
+    if (hid < 0 || hid >= c->H) continue;
+    SpecHost *s = &c->spec[hid];
+    s->inflight = 0;
+    /* the class tracker may have moved on while the wave was in flight;
+     * install anyway — consult verifies uid range + npkts per unit, so a
+     * stale class simply never hits */
+    int64_t ni = pn[i];
+    if (ni <= 0) continue;
+    uint32_t *ma = NULL, *mb = NULL;
+    size_t nbytes = sizeof(uint32_t) * (size_t)ni;
+    if (poa[i] >= 0 && poa[i] + ni <= mlen) {
+      ma = malloc(nbytes);
+      if (ma) memcpy(ma, pm + poa[i], nbytes);
+    }
+    if (pob[i] >= 0 && pob[i] + ni <= mlen) {
+      mb = malloc(nbytes);
+      if (mb) memcpy(mb, pm + pob[i], nbytes);
+    }
+    if (!ma && !mb) continue;
+    if (s->ready && pu[i] == s->u0 + (uint64_t)s->n) {
+      /* continuation of a still-live window: stage it */
+      free(s->nmin_a);
+      free(s->nmin_b);
+      s->nu0 = pu[i];
+      s->nn = (int32_t)ni;
+      s->nnpk_a = pna[i];
+      s->nnpk_b = pnb[i];
+      s->nmin_a = ma;
+      s->nmin_b = mb;
+      s->nready = 1;
+    } else {
+      free(s->min_a);
+      free(s->min_b);
+      free(s->nmin_a);
+      free(s->nmin_b);
+      s->nmin_a = s->nmin_b = NULL;
+      s->nready = 0;
+      s->u0 = pu[i];
+      s->n = (int32_t)ni;
+      s->npk_a = pna[i];
+      s->npk_b = pnb[i];
+      s->min_a = ma;
+      s->min_b = mb;
+      s->ready = 1;
+    }
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject *Core_spec_stats(CoreObject *c, PyObject *noarg) {
+  (void)noarg;
+  PyObject *r = Py_BuildValue("(LL)", (long long)c->spec_hits,
+                              (long long)c->spec_draws);
+  c->spec_hits = 0;
+  c->spec_draws = 0;
+  return r;
+}
+
 static PyObject *Core_barrier(CoreObject *c, PyObject *args) {
   long long rs_ll, re_ll;
   if (!PyArg_ParseTuple(args, "LL", &rs_ll, &re_ll)) return NULL;
@@ -1365,11 +1660,25 @@ static PyObject *Core_barrier(CoreObject *c, PyObject *args) {
     }
   }
 
-  /* inline loss draws (threefry) + store */
+  /* inline loss draws (threefry) + store; with speculation on, a live
+   * unit first consults its host's speculative window (verified (npk,
+   * th) class + uid range — bit-identical by construction) and only
+   * draws inline on a miss */
   if (any_live) {
     for (int i = 0; i < keep; i++) {
       BRow *b = &c->brow[i];
-      b->drop = (uint8_t)unit_dropped(c->seed, b->uid, b->npk, b->th);
+      if (!b->th) {
+        b->drop = 0;
+        continue;
+      }
+      int sv = c->spec_on ? spec_consult(c, b) : -1;
+      if (sv >= 0) {
+        b->drop = (uint8_t)sv;
+        c->spec_hits++;
+      } else {
+        b->drop = (uint8_t)unit_dropped(c->seed, b->uid, b->npk, b->th);
+        c->spec_draws += c->spec_on;
+      }
     }
   }
   if (store_build(c, c->brow, keep, any_live, round_end) < 0) goto done;
@@ -1745,6 +2054,16 @@ static void Core_dealloc(CoreObject *c) {
     free(c->hs);
   }
   free(c->brow);
+  if (c->spec) {
+    for (int64_t i = 0; i < c->H; i++) {
+      free(c->spec[i].min_a);
+      free(c->spec[i].min_b);
+      free(c->spec[i].nmin_a);
+      free(c->spec[i].nmin_b);
+    }
+    free(c->spec);
+  }
+  free(c->spec_dq);
   Py_XDECREF(c->hosts);
   Py_XDECREF(c->pending);
   Py_XDECREF(c->deferred);
@@ -2008,6 +2327,15 @@ static PyMethodDef Core_methods[] = {
      "bind the controller's active-host-id set"},
     {"gossip_register", (PyCFunction)Core_gossip_register, METH_VARARGS,
      "(hid, port, peers) -> GossipState; registers the C dgram handler"},
+    {"spec_demand", (PyCFunction)Core_spec_demand, METH_VARARGS,
+     "(min_hosts=1) -> drain speculative-window demand once the queued "
+     "cohort reaches min_hosts: (hosts, u0, n, npk_a, npk_b) arrays, or "
+     "None; the first call enables speculation"},
+    {"spec_install", (PyCFunction)Core_spec_install, METH_VARARGS,
+     "(hosts, u0, n, npk_a, npk_b, off_a, off_b, mins) -> install one "
+     "wave's prefix-min draws into the consult table"},
+    {"spec_stats", (PyCFunction)Core_spec_stats, METH_NOARGS,
+     "drain (speculative hits, inline draws since speculation enabled)"},
     {"fold_counters", (PyCFunction)Core_fold_counters, METH_NOARGS,
      "flush outstanding per-host counter deltas into host attributes"},
     {"make_endpoint", (PyCFunction)Core_make_endpoint, METH_VARARGS,

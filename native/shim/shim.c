@@ -49,6 +49,11 @@
 #include <sys/syscall.h>
 #include <time.h>
 #include <ucontext.h>
+
+/* pre-5.9 glibc headers lack the close_range number; it is ABI-stable */
+#ifndef SYS_close_range
+#define SYS_close_range 436
+#endif
 #include <unistd.h>
 
 #define SHIM_IPC_FD 995          /* worker dup2()s the socketpair here   */
@@ -532,7 +537,7 @@ static void sigsys_handler(int signo, siginfo_t *info, void *vctx) {
     raw3(SYS_exit, (long)g[REG_RDI], 0, 0);
   }
   if (info->si_syscall == SYS_rt_sigprocmask) {
-  sigprocmask:
+  sigprocmask:;
     /* Emulated SHIM-SIDE by editing the signal frame's uc_sigmask (the
      * mask sigreturn restores) — never with a real syscall, which would
      * re-trap forever. Crucially SIGSYS/SIGSEGV are ALWAYS left unblocked:

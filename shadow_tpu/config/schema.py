@@ -103,6 +103,14 @@ class ExperimentalOptions:
     # tpu_batch knobs (ours):
     tpu_max_batch: int = 65536  # max units per device draw dispatch
     tpu_device_floor: int = 0  # min batch to engage device; 0=calibrate, -1=off
+    #: fused multi-round device windows (network/devroute.py): how many
+    #: rounds of loss-draw batches may fuse into ONE device dispatch.
+    #: "auto" (stored as 0) sizes windows from live break-even telemetry
+    #: and enables speculative forward windows under the C engine; K >= 1
+    #: closes the deferred window after K rounds (K=1 = legacy per-round
+    #: dispatch). Routing is pure wall-clock policy: results are
+    #: bit-identical for every K (tests/test_device_windows.py).
+    device_window_rounds: int = 0
     tpu_mesh_shards: int = 0  # 0 = all local devices
     #: tpu_mesh: min due-window units for the collective program; smaller
     #: windows take the bit-identical numpy twin
@@ -381,6 +389,13 @@ def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
              "experimental.unit_mtus must be in [1, 64]")
     e.tpu_max_batch = int(exp.get("tpu_max_batch", 65536))
     e.tpu_device_floor = int(exp.get("tpu_device_floor", 0))
+    dwr = exp.get("device_window_rounds", "auto")
+    if str(dwr).lower() == "auto":
+        e.device_window_rounds = 0  # internal sentinel for auto
+    else:
+        e.device_window_rounds = int(dwr)
+        _require(e.device_window_rounds >= 1,
+                 "experimental.device_window_rounds must be >= 1 or 'auto'")
     e.tpu_mesh_shards = int(exp.get("tpu_mesh_shards", 0))
     e.tpu_mesh_floor = int(exp.get("tpu_mesh_floor", 2048))
     e.native_colcore = bool(exp.get("native_colcore", True))

@@ -174,8 +174,12 @@ def make_scheduler(policy: str, hosts: Sequence, parallelism: int):
             )
         return ThreadPerHostScheduler(hosts)
     if policy in ("tpu_batch", "tpu_mesh"):
-        # host events run serially on the main thread; the data plane is on
-        # the device. (Event execution overlap with device steps comes from
-        # dispatch asynchrony, not Python threads.)
+        # host events run serially on the main thread; the data plane is
+        # on the device. Event execution overlaps device work through
+        # dispatch asynchrony, not Python threads: the columnar plane
+        # dispatches ONE fused program per multi-round window (two
+        # in-flight windows, deferred readbacks at causal deadlines —
+        # network/devroute.py), so the device computes window N while
+        # this thread runs the events and barriers of window N+1.
         return SerialScheduler(hosts)
     raise ValueError(f"unknown scheduler policy {policy!r}")

@@ -74,7 +74,7 @@ from shadow_tpu.network.fluid import (
     clamped_refill,
     loss_flags,
 )
-from shadow_tpu.network.devroute import DeviceRoutedPlane
+from shadow_tpu.network.devroute import WINDOW_SLOTS, DeviceRoutedPlane
 from shadow_tpu.network.graph import INF_I64, NetworkGraph
 from shadow_tpu.network.unit import KIND_LOSS
 
@@ -176,9 +176,14 @@ class ColumnarPlane(DeviceRoutedPlane):
         self.oracle_loss = (getattr(tpu_options, "stream_loss_recovery",
                                     "dupack") == "oracle")
         #: per-phase wall-clock breakdown (VERDICT r2 item #7); merged into
-        #: the run summary by the controller
+        #: the run summary by the controller. window_* phases attribute the
+        #: fused multi-round device windows: host-side array build vs
+        #: async dispatch vs realized readback stalls (a pipelined window
+        #: shows build+dispatch but ~zero readback).
         self.phase_wall = {"barrier": 0.0, "draw_flush": 0.0,
-                           "extract": 0.0, "ingress_deferred": 0.0}
+                           "extract": 0.0, "ingress_deferred": 0.0,
+                           "window_build": 0.0, "window_dispatch": 0.0,
+                           "window_readback": 0.0}
         for h in hosts:
             h.colplane = self
         self._init_device_routing(backend, tpu_options, params)
@@ -292,7 +297,16 @@ class ColumnarPlane(DeviceRoutedPlane):
             tokens[host.id] = toks
 
     def end_of_round(self, round_start: SimTime, round_end: SimTime) -> None:
-        """The round barrier: resolve all rows emitted this round."""
+        """The round barrier: resolve all rows emitted this round, then
+        advance the fused device-window state machine (dispatch a closed
+        window, install ready speculative tables, pull new speculation
+        demand). Windows open and close ONLY at round boundaries, so
+        checkpoint.py's round-boundary snapshots stay valid."""
+        self._barrier_round(round_start, round_end)
+        self._window_tick(round_end)
+
+    def _barrier_round(self, round_start: SimTime,
+                       round_end: SimTime) -> None:
         t0 = _walltime.perf_counter()
         acks = self.ack_hosts
         if acks:
@@ -594,10 +608,6 @@ class ColumnarPlane(DeviceRoutedPlane):
                           arrival, uid.astype(np.int64), npk,
                           thresh.astype(np.int64))))
             return
-        use_device = (self.device is not None and live
-                      and n >= self.device_floor)
-        if not use_device:
-            self._floor_cooldown_tick()
         if not live and forced is None:
             # nothing can drop: skip draws entirely, straight to the store
             self._store_resolved(keep_rows, src_l, arrival_l, keys_l, None,
@@ -607,16 +617,14 @@ class ColumnarPlane(DeviceRoutedPlane):
         uid_hi = (uid >> np.uint64(32)).astype(np.uint32)
         npk = np.minimum(np.maximum(1, -(-size // MTU)),
                          HARD_MAX_PKTS).astype(np.uint32)
-        if not use_device:
-            # lazy numpy batch: flags are a pure function of unit identity,
-            # so defer to the causal deadline and coalesce across rounds
-            deadline = max(round_end, int(arrival.min()))
-            self.outstanding.append(_Outstanding(
-                keep_rows, src_l, arrival_l, keys_l, uid_lo, uid_hi, npk,
-                thresh, forced, round_end, deadline, None))
-            return
-        self._device_chunks(keep_rows, src_l, arrival, arrival_l, keys_l,
-                            uid_lo, uid_hi, npk, thresh, forced, round_end)
+        # lazy batch: flags are a pure function of unit identity, so defer
+        # to the causal deadline and coalesce across rounds — into ONE
+        # numpy call or ONE fused device window, whichever the window
+        # state machine (_window_tick / flush_due) routes this window to
+        deadline = max(round_end, int(arrival.min()))
+        self.outstanding.append(_Outstanding(
+            keep_rows, src_l, arrival_l, keys_l, uid_lo, uid_hi, npk,
+            thresh, forced, round_end, deadline, None))
 
     def _queue_mesh_batch(self, r, round_end: SimTime) -> None:
         """C-barrier mesh hand-off: append the lazy collective batch
@@ -634,32 +642,289 @@ class ColumnarPlane(DeviceRoutedPlane):
                       thresh.astype(np.int64))))
 
     def _dispatch_device_batch(self, r, round_end: SimTime) -> None:
-        """A C barrier handed back a big live batch for the device draw
-        plane: route it through the same chunk loop as the vector path."""
+        """A C barrier handed back a big live batch: it joins the open
+        device window as a lazy batch (the window state machine owns all
+        device dispatch — one fused program per window, two-slot async
+        pipeline — instead of the retired one-dispatch-per-barrier loop)."""
         keep_rows, src_l, arrival, keys_l, uid_lo, uid_hi, npk, thresh = r
-        self._device_chunks(keep_rows, src_l, arrival, arrival.tolist(),
-                            keys_l, uid_lo, uid_hi, npk, thresh, None,
-                            round_end)
+        deadline = max(round_end, int(arrival.min()))
+        self.outstanding.append(_Outstanding(
+            keep_rows, src_l, arrival.tolist(), keys_l, uid_lo, uid_hi,
+            npk, thresh, None, round_end, deadline, None))
 
-    def _device_chunks(self, keep_rows, src_l, arrival, arrival_l, keys_l,
-                       uid_lo, uid_hi, npk, thresh, forced,
-                       round_end: SimTime) -> None:
-        """THE device dispatch loop (single implementation — the Python
-        vector barrier and the C barrier hand-off both route here, so the
-        deadline formula and _Outstanding shape cannot drift apart)."""
-        n = len(keep_rows)
+    # -- fused multi-round device windows -----------------------------------
+    def _window_tick(self, round_end: SimTime) -> None:
+        """Advance the window state machine at this round boundary.
+
+        experimental.device_window_rounds = K:
+          K >= 1  close the deferred window every K barriers and dispatch
+                  it when it clears the floor (K=1 reproduces the legacy
+                  per-round dispatch cadence, through the same machinery);
+          auto    dispatch as soon as the open window clears the live
+                  break-even estimate (hysteresis in devroute) — smaller
+                  windows fall through to the host twin at flush time.
+
+        Routing is pure wall-clock policy: every path yields bit-identical
+        flags (tests/test_device_windows.py), only dispatch count moves."""
+        dev = self.device
+        if dev is None:
+            return
+        if (self._c is not None and not self._spec_checked
+                and self.window_rounds == 0):
+            # speculation is an auto-mode feature (documented in
+            # MIGRATION.md/README): a fixed K asks for the deterministic
+            # deferred-window discipline only
+            self._spec_enable()
+        if self._spec_on:
+            self._spec_tick()
+        if not self.outstanding:  # the common C-plane round: all inline
+            self._win_open_rounds = 0
+            return
+        lazy = [b for b in self.outstanding if b.handle is None]
+        if not lazy:
+            self._win_open_rounds = 0
+            return
+        self._win_open_rounds += 1
+        units = sum(len(b.keys) for b in lazy)
+        k = self.window_rounds
+        if k > 0:
+            if self._win_open_rounds >= k:
+                self._note_window_units(units)
+                if (units >= self.device_floor
+                        and self._win_inflight < WINDOW_SLOTS):
+                    self._dispatch_window(lazy, units)
+                else:
+                    # below floor (or both slots busy): the window stays
+                    # lazy and resolves on the host twin at flush
+                    self._floor_cooldown_tick()
+                    self._win_open_rounds = 0
+        elif (self._win_inflight < WINDOW_SLOTS
+              and not self._probe_clamped
+              and units >= self.window_gate_units(self._win_engaged)):
+            self._dispatch_window(lazy, units)
+
+    def _dispatch_window(self, lazy, units: int) -> None:
+        """ONE fused device dispatch for the whole window: every lazy
+        batch's draw arrays concatenate into one program (chunked only at
+        tpu_max_batch); each batch keeps a slice view of the shared handle
+        and reads it — for free, once the shared readback landed — at its
+        own causal deadline. Readback is deferred exactly as before; only
+        the dispatch count changes (one per window, not one per barrier)."""
+        t0 = _walltime.perf_counter()
         mb = self.max_batch
-        for i in range(0, n, mb):
-            j = min(n, i + mb)
-            sl = slice(i, j)
-            handle = self.device.dispatch(uid_lo[sl], uid_hi[sl], npk[sl],
-                                          thresh[sl])
-            deadline = max(round_end, int(arrival[sl].min()))
-            self.outstanding.append(_Outstanding(
-                keep_rows[i:j], src_l[i:j], arrival_l[i:j], keys_l[i:j],
-                None, None, None, None,
-                None if forced is None else forced[i:j],
-                round_end, deadline, handle))
+        groups: list = []
+        cur: list = []
+        cur_n = 0
+        for b in lazy:
+            n = len(b.keys)
+            if cur and cur_n + n > mb:
+                groups.append((cur, cur_n))
+                cur, cur_n = [], 0
+            cur.append(b)
+            cur_n += n
+        groups.append((cur, cur_n))
+        t1 = _walltime.perf_counter()
+        for batches, n_g in groups:
+            self._win_inflight += 1
+            if len(batches) == 1 and n_g > mb:
+                # one oversized batch: chunk it like the retired per-batch
+                # loop did, behind a concatenating handle
+                b = batches[0]
+                handles = [
+                    self.device.dispatch(b.uid_lo[i:i + mb],
+                                         b.uid_hi[i:i + mb],
+                                         b.npk[i:i + mb],
+                                         b.thresh[i:i + mb])
+                    for i in range(0, n_g, mb)]
+                b.handle = _ConcatHandle(self, handles)
+                continue
+            if len(batches) == 1:
+                b = batches[0]
+                lo, hi, npk, th = b.uid_lo, b.uid_hi, b.npk, b.thresh
+            else:
+                lo = np.concatenate([b.uid_lo for b in batches])
+                hi = np.concatenate([b.uid_hi for b in batches])
+                npk = np.concatenate([b.npk for b in batches])
+                th = np.concatenate([b.thresh for b in batches])
+            wh = _WindowHandle(self, self.device.dispatch(lo, hi, npk, th))
+            off = 0
+            for b in batches:
+                n = len(b.keys)
+                b.handle = _WindowSlice(wh, off, n)
+                off += n
+        self._win_open_rounds = 0
+        t2 = _walltime.perf_counter()
+        self.phase_wall["window_build"] += t1 - t0
+        self.phase_wall["window_dispatch"] += t2 - t1
+        self._note_window_units(units)
+        self._record_window(units, t2 - t0)
+
+    def _window_done(self) -> None:
+        """A dispatched window's last deferred readback was consumed: its
+        pipeline slot frees for the next window."""
+        if self._win_inflight > 0:
+            self._win_inflight -= 1
+
+    def _stall_sample(self, dt: float) -> None:
+        """A window readback stalled for dt seconds: fold it into the
+        break-even EMA (a stalling window costs host wall exactly like
+        dispatch does) and the phase attribution."""
+        self.phase_wall["window_readback"] += dt
+        if dt > 2e-5:
+            self._win_cost_ema += 0.25 * dt
+
+    # -- speculative forward windows (C plane) -------------------------------
+    def _spec_enable(self) -> None:
+        """One-time probe: speculative windows need the C engine's class
+        tracker + consult table (spec_demand/spec_install). Older engines
+        without the API simply never speculate."""
+        self._spec_checked = True
+        self._spec_on = (hasattr(self._c, "spec_demand")
+                         and self.fault_filter is None)
+
+    def _spec_tick(self) -> None:
+        """Drive the speculative pipeline once per round: install every
+        speculative wave whose device readback has landed (is_ready —
+        never a stall), then, on a coarse cadence so single-host demand
+        coalesces into fused waves, pull per-host demand from the C class
+        tracker and dispatch it as one program. A wave speculates the
+        PREFIX-MIN threefry draw for a contiguous range of FUTURE uids
+        under each host's recent npkts classes — threshold-independent
+        (dropped == min_draw < thresh), so one row serves every
+        destination. The C consult verifies uid range + npkts exactly; a
+        wrong guess costs device cycles, never correctness."""
+        pend = self._spec_pending
+        if pend:
+            keep = []
+            for wave in pend:
+                if wave[0].is_ready():
+                    self._install_spec(wave)
+                else:
+                    keep.append(wave)
+            self._spec_pending = keep
+        self._spec_round += 1
+        if (self._spec_round & 15
+                or len(self._spec_pending) >= WINDOW_SLOTS):
+            return  # demand keeps queueing C-side between drains
+        if (self._spec_round & 255 == 0 and self.dev_windows >= 4
+                and self._spec_round >= 1024):
+            # live economics (the same telemetry-over-faith rule as the
+            # deferred-window break-even): fold the C consult counters and
+            # compare realized spend — wave build + dispatch wall plus a
+            # compute-contention share for the speculated rows themselves
+            # (XLA worker threads take cores the host loop would use) —
+            # against realized savings (verified hits x the inline C draw
+            # cost, ~0.22us for a full-quantum unit on this class of
+            # host). A losing speculation stops demanding new waves;
+            # installed windows keep serving their remaining hits for
+            # free. On an accelerator-backed device the contention term
+            # is ~zero and the clamp never fires.
+            hits, draws = self._c.spec_stats()
+            self.spec_hits += hits
+            self.spec_draws += draws
+            spend = self._spec_spend + 2.5e-8 * self._spec_units
+            if spend > self.spec_hits * 2.2e-7:
+                self._spec_on = False
+                self._spec_clamped = True
+                return
+        # demand coalescing: a wave's fixed dispatch cost wants a sizable
+        # host cohort; the coarse age cadence (every 256 rounds) flushes
+        # stragglers so every demanding host gets a window within ~one
+        # round-trip of simulated time
+        min_hosts = 1 if self._spec_round & 255 == 0 else 160
+        d = self._c.spec_demand(min_hosts)
+        if d is not None:
+            self._dispatch_spec(d)
+
+    #: classes cheaper than this many packet draws are not worth a wave
+    #: row (the inline threefry twin beats the speculation overhead);
+    #: must match SPEC_MIN_NPK in native/colcore/colcore.c
+    SPEC_MIN_NPK = 4
+
+    def _dispatch_spec(self, d) -> None:
+        """Build and dispatch one speculative wave: for each demanded host
+        a contiguous future-uid range min-drawn under up to two npkts
+        classes, packed with vectorized range arithmetic. Waves chunk at
+        the ONE pinned program shape (DeviceDrawPlane.SPEC_BUCKET), whole
+        hosts per chunk (a host's classes must install together), so no
+        wave ever compiles a new shape mid-run."""
+        hosts, u0, n, npk_a, npk_b = d
+        n64 = n.astype(np.int64)
+        rows = (n64 * ((npk_a >= self.SPEC_MIN_NPK).astype(np.int64)
+                       + (npk_b >= self.SPEC_MIN_NPK).astype(np.int64)))
+        bucket = self.device.SPEC_BUCKET
+        lo_idx = 0
+        idx = np.flatnonzero(rows > 0)
+        while lo_idx < idx.size:
+            acc, take = 0, []
+            while lo_idx < idx.size and \
+                    acc + int(rows[idx[lo_idx]]) <= bucket:
+                acc += int(rows[idx[lo_idx]])
+                take.append(idx[lo_idx])
+                lo_idx += 1
+            if not take:  # single host larger than the bucket: skip it
+                lo_idx += 1
+                continue
+            g = np.asarray(take)
+            self._dispatch_spec_group(
+                hosts[g], u0[g], n[g], npk_a[g], npk_b[g])
+
+    def _dispatch_spec_group(self, hosts, u0, n, npk_a, npk_b) -> None:
+        t0 = _walltime.perf_counter()
+        n64 = n.astype(np.int64)
+        parts_lo: list = []
+        parts_hi: list = []
+        parts_npk: list = []
+        off_a = np.full(len(hosts), -1, dtype=np.int64)
+        off_b = np.full(len(hosts), -1, dtype=np.int64)
+        off = 0
+        for npk_c, offs in ((npk_a, off_a), (npk_b, off_b)):
+            use = np.flatnonzero(npk_c >= self.SPEC_MIN_NPK)
+            if use.size == 0:
+                continue
+            ns = n64[use]
+            total = int(ns.sum())
+            starts = np.cumsum(ns) - ns
+            uid = (np.repeat(u0[use], ns)
+                   + (np.arange(total, dtype=np.int64)
+                      - np.repeat(starts, ns)).astype(np.uint64))
+            parts_lo.append((uid & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+            parts_hi.append((uid >> np.uint64(32)).astype(np.uint32))
+            parts_npk.append(
+                np.repeat(npk_c[use].astype(np.uint32), ns))
+            offs[use] = off + starts
+            off += total
+        if off == 0:
+            return
+        t1 = _walltime.perf_counter()
+        dh = self.device.dispatch_min(
+            np.concatenate(parts_lo), np.concatenate(parts_hi),
+            np.concatenate(parts_npk),
+            min_bucket=self.device.SPEC_BUCKET)
+        self._spec_pending.append(
+            (dh, (hosts, u0, n, npk_a, npk_b), off_a, off_b))
+        self.dev_windows += 1
+        self.dev_window_units += off
+        self._spec_units += off
+        t2 = _walltime.perf_counter()
+        self.phase_wall["window_build"] += t1 - t0
+        self.phase_wall["window_dispatch"] += t2 - t1
+        # the economics clamp compares speculation's OWN spend against its
+        # hits; deferred-window walls must not be billed to it
+        self._spec_spend += t2 - t0
+
+    def _install_spec(self, wave) -> None:
+        """A speculative wave's min-draws landed: hand them to the C
+        consult table in one call (per-host slices by unit offset)."""
+        t0 = _walltime.perf_counter()
+        dh, d, off_a, off_b = wave
+        mins = dh.read()
+        hosts, u0, n, npk_a, npk_b = d
+        self._c.spec_install(hosts, u0, n, npk_a, npk_b, off_a, off_b,
+                             np.ascontiguousarray(mins))
+        dt = _walltime.perf_counter() - t0
+        self.phase_wall["window_build"] += dt
+        self._spec_spend += dt
 
     # result consumption ----------------------------------------------------
     def flush_due(self, limit: SimTime) -> None:
@@ -676,12 +941,41 @@ class ColumnarPlane(DeviceRoutedPlane):
         t0 = _walltime.perf_counter()
         if self.mesh_plane is not None:
             self._mesh_materialize()
+        if self.device is not None:
+            # a deadline closes the open window here (even a fixed-K
+            # window — causality outranks K): route the WHOLE accumulated
+            # window (due and not-yet-due batches — early resolution is
+            # result-identical) through ONE fused device dispatch when it
+            # clears the gate, with hysteresis in auto mode so a window
+            # size hovering at break-even does not flap; smaller windows
+            # fall through to the coalesced host twin
+            lazy_all = [b for b in self.outstanding if b.handle is None]
+            if lazy_all:
+                units = sum(len(b.keys) for b in lazy_all)
+                self._note_window_units(units)
+                # both pipeline slots busy -> the host twin resolves this
+                # window (the documented two-slot bound: never queue
+                # unbounded device memory behind unread handles)
+                slot_free = self._win_inflight < WINDOW_SLOTS
+                if self.window_rounds > 0:
+                    engage = slot_free and units >= self.device_floor
+                else:
+                    engage = (slot_free and not self._probe_clamped
+                              and units >= self.window_gate_units(
+                                  self._win_engaged))
+                self._win_engaged = engage
+                if engage:
+                    self._dispatch_window(lazy_all, units)
+                else:
+                    self._floor_cooldown_tick()
         take = [b for b in self.outstanding
                 if b.handle is None or b.deadline < limit]
         self.outstanding = deque(
             b for b in self.outstanding
             if not (b.handle is None or b.deadline < limit))
         lazy = [b for b in take if b.handle is None]
+        if lazy:
+            self._win_open_rounds = 0  # flush truncated the open window
         it = None
         if lazy:
             if len(lazy) == 1:
@@ -723,6 +1017,17 @@ class ColumnarPlane(DeviceRoutedPlane):
 
     def flush_all(self) -> None:
         self.flush_due(T_NEVER + 1)
+        if (self._spec_on or self._spec_clamped) and self._c is not None:
+            # drain the C consult counters (hits served from speculative
+            # windows vs inline draws) into the run telemetry — also
+            # after an economics clamp, since installed windows keep
+            # serving hits post-clamp; in-flight speculative waves are
+            # just dropped — they are a cache of a pure function, never
+            # simulation state
+            hits, draws = self._c.spec_stats()
+            self.spec_hits += hits
+            self.spec_draws += draws
+            self._spec_pending = []
         if self._c is not None:
             self._c.fold_counters()
         if self.mesh_plane is not None:
@@ -791,6 +1096,68 @@ class ColumnarPlane(DeviceRoutedPlane):
         if out:
             out.sort(key=_row_tk)
             self.pending.append(StoreBatch(out))
+
+
+class _WindowHandle:
+    """One fused window dispatch shared by its batches: the device result
+    is read once (the only point that can stall — attributed to
+    window_readback) and every batch slices it for free at its own causal
+    deadline. Frees its pipeline slot when the last slice is consumed."""
+
+    __slots__ = ("plane", "flags", "_dh", "_left")
+
+    def __init__(self, plane, dh, n_slices: int = 0) -> None:
+        self.plane = plane
+        self.flags = None
+        self._dh = dh
+        self._left = n_slices
+
+    def read_full(self) -> np.ndarray:
+        if self.flags is None:
+            t0 = _walltime.perf_counter()
+            self.flags = self._dh.read()
+            self.plane._stall_sample(_walltime.perf_counter() - t0)
+        return self.flags
+
+    def slice_consumed(self) -> None:
+        self._left -= 1
+        if self._left == 0:
+            self.plane._window_done()
+
+
+class _WindowSlice:
+    """One batch's view over its window's shared flags."""
+
+    __slots__ = ("wh", "off", "n")
+
+    def __init__(self, wh: _WindowHandle, off: int, n: int) -> None:
+        self.wh = wh
+        self.off = off
+        self.n = n
+        wh._left += 1
+
+    def read(self) -> np.ndarray:
+        flags = self.wh.read_full()[self.off:self.off + self.n]
+        self.wh.slice_consumed()
+        return flags
+
+
+class _ConcatHandle:
+    """An oversized single batch dispatched as several chunks (legacy
+    tpu_max_batch split), read back as one flag array."""
+
+    __slots__ = ("plane", "handles")
+
+    def __init__(self, plane, handles) -> None:
+        self.plane = plane
+        self.handles = handles
+
+    def read(self) -> np.ndarray:
+        t0 = _walltime.perf_counter()
+        flags = np.concatenate([h.read() for h in self.handles])
+        self.plane._stall_sample(_walltime.perf_counter() - t0)
+        self.plane._window_done()
+        return flags
 
 
 class _MeshLazy:

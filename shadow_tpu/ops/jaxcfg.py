@@ -29,8 +29,11 @@ def configure() -> None:
         try:
             jax.config.update("jax_platforms", "cpu")
             jax.config.update("jax_num_cpu_devices", int(force_cpu))
-        except RuntimeError:
-            pass  # backends already initialized; run on what exists
+        except (RuntimeError, AttributeError):
+            # RuntimeError: backends already initialized (run on what
+            # exists). AttributeError: this jax predates
+            # jax_num_cpu_devices — the XLA_FLAGS path covers it.
+            pass
 
     cache = os.environ.get(
         "SHADOW_TPU_JAX_CACHE",
@@ -39,6 +42,10 @@ def configure() -> None:
     try:
         os.makedirs(cache, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        # cache even fast compiles: a simulation CLI pays per-process
+        # compile cost on every invocation, and the window kernels
+        # compile in ~0.1-0.3 s each — below the old 0.5 s threshold, so
+        # they were rebuilt every process
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
     except Exception:  # cache is an optimization; never fail the sim for it
         pass

@@ -56,6 +56,30 @@ def _bucket(n: int, cap: int) -> int:
 
 
 @functools.partial(jax.jit, static_argnames=("seed", "width"))
+def _min_draw_kernel(packed, seed, width=MAX_PKTS):
+    """packed: (3, P) uint32 rows [uid_lo, uid_hi, npkts]; returns (P,)
+    uint32: the MINIMUM 24-bit draw over each unit's first npkts packet
+    lanes (0xFFFFFFFF for npkts == 0, which no threshold can undercut).
+    This is the threshold-independent sufficient statistic behind the
+    speculative forward windows: ``dropped == (min_draw < thresh)`` for
+    ANY thresh, so one speculated row serves every destination a host
+    later picks — same integer math as _draw_kernel/fluid.loss_flags."""
+    from shadow_tpu.ops.prng import threefry2x32
+
+    uid_lo, uid_hi, npkts = packed
+    p = uid_lo.shape[0]
+    pkt = jnp.arange(width, dtype=jnp.uint32)[None, :]
+    c0 = jnp.broadcast_to(uid_lo[:, None], (p, width))
+    c1 = uid_hi[:, None] | (pkt << jnp.uint32(PKT_SHIFT))
+    k0 = jnp.uint32(seed & 0xFFFFFFFF)
+    k1 = jnp.uint32((seed >> 32) & 0xFFFFFFFF)
+    draws, _ = threefry2x32(k0, k1, c0, c1, xp=jnp)
+    draws = (draws >> jnp.uint32(8)).astype(jnp.uint32)
+    sentinel = jnp.uint32(0xFFFFFFFF)
+    return jnp.min(jnp.where(pkt < npkts[:, None], draws, sentinel), axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("seed", "width"))
 def _draw_kernel(packed, seed, width=MAX_PKTS):
     """packed: (4, P) uint32 rows [uid_lo, uid_hi, npkts, thresh]; returns
     (P,) bool dropped flags. Mirrors fluid.loss_flags exactly: a unit drops
@@ -90,6 +114,31 @@ class DrawHandle:
     def read(self) -> np.ndarray:
         packed = np.asarray(self._arr)
         return np.unpackbits(packed, bitorder="little")[: self._n].astype(bool)
+
+    def is_ready(self) -> bool:
+        """True when the device result has landed host-side — read() will
+        not stall. Backends without the poll hint report ready (read()
+        then blocks, which is the pre-window behavior)."""
+        poll = getattr(self._arr, "is_ready", None)
+        return True if poll is None else bool(poll())
+
+
+class MinDrawHandle:
+    """An in-flight speculative min-draw batch: read() yields (n,) uint32
+    prefix-min draws (see _min_draw_kernel)."""
+
+    __slots__ = ("_arr", "_n")
+
+    def __init__(self, arr, n: int) -> None:
+        self._arr = arr
+        self._n = n
+
+    def read(self) -> np.ndarray:
+        return np.asarray(self._arr)[: self._n]
+
+    def is_ready(self) -> bool:
+        poll = getattr(self._arr, "is_ready", None)
+        return True if poll is None else bool(poll())
 
 
 class DeviceDrawPlane:
@@ -146,6 +195,67 @@ class DeviceDrawPlane:
         except AttributeError:  # some backends lack the hint; read() suffices
             pass
         return DrawHandle(out, n)
+
+    #: every speculative wave pads to this one bucket so exactly ONE
+    #: min-draw program shape ever compiles (warmed at attach_cached);
+    #: callers chunk bigger waves at this size
+    SPEC_BUCKET = 16384
+
+    def dispatch_min(self, uid_lo: np.ndarray, uid_hi: np.ndarray,
+                     npkts: np.ndarray,
+                     min_bucket: int = 0) -> MinDrawHandle:
+        """Launch one speculative min-draw batch (threshold-independent;
+        see _min_draw_kernel) with the async device->host copy started.
+        ``min_bucket`` pins the padded shape (shape stability = no
+        mid-run compiles; padded rows carry npkts 0 and can never hit)."""
+        n = uid_lo.shape[0]
+        p = max(_bucket(n, self.max_batch), min_bucket)
+        if self._sharding is not None:
+            q = 8 * self._n_shards
+            p = -(-max(p, q) // q) * q
+        packed = np.zeros((3, p), dtype=np.uint32)
+        packed[0, :n] = uid_lo
+        packed[1, :n] = uid_hi
+        packed[2, :n] = npkts
+        dev_in = (jax.device_put(packed, self._sharding)
+                  if self._sharding is not None else jnp.asarray(packed))
+        out = _min_draw_kernel(dev_in, seed=self.seed, width=self.max_pkts)
+        try:
+            out.copy_to_host_async()
+        except AttributeError:
+            pass
+        return MinDrawHandle(out, n)
+
+    _cache: dict = {}  # (seed, max_batch, n_shards, max_pkts) -> entry
+
+    @classmethod
+    def attach_cached(cls, seed: int, max_batch: int, n_shards: int,
+                      max_pkts: int):
+        """Process-wide attach cache: (plane, dev_s, np_per_unit) for this
+        parameter tuple, building + calibrating on first use. A simulation
+        binary runs many short Controllers (benchmarks, tests, resumed
+        checkpoints); paying the attach + compile + calibrate cost once
+        per process instead of once per run is what lets the device come
+        online BEFORE the round loop ends on fast configs — round 5's
+        device_x < 1.0 was largely a device that published after the loop
+        finished. Pure wall-clock policy: the plane is stateless, so
+        sharing it across runs cannot change results."""
+        key = (int(seed), int(max_batch), int(n_shards), int(max_pkts))
+        hit = cls._cache.get(key)
+        if hit is None:
+            plane = cls(seed, max_batch, n_shards=n_shards,
+                        max_pkts=max_pkts)
+            dev_s, np_per_unit = plane.calibrate()
+            # warm the speculative min-draw program at its one pinned
+            # shape so no window wave ever compiles inside a measured
+            # round loop
+            b = cls.SPEC_BUCKET
+            z = np.zeros(b, dtype=np.uint32)
+            plane.dispatch_min(z, z, z, min_bucket=b).read()
+            if len(cls._cache) >= 4:  # a handful of configs per process
+                cls._cache.pop(next(iter(cls._cache)))
+            hit = cls._cache[key] = (plane, dev_s, np_per_unit)
+        return hit
 
     def calibrate(self, n_probe: int = 4096) -> tuple[float, float]:
         """Measure (device seconds per dispatch+readback at n_probe, numpy

@@ -57,6 +57,22 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.4.38 exports shard_map at top level
+    _shard_map_impl = jax.shard_map
+except AttributeError:  # older jax: the experimental location
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+
+def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """shard_map across jax versions: the replication-check kwarg was
+    renamed check_rep -> check_vma; forward to whichever this jax has."""
+    try:
+        return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_vma=check_vma)
+    except TypeError:
+        return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_rep=check_vma)
+
 from shadow_tpu.core.time import NS_PER_SEC
 from shadow_tpu.network.fluid import MAX_PKTS, MIN_CAP, MTU, PKT_SHIFT, NetParams
 from shadow_tpu.ops.jaxcfg import configure
@@ -318,7 +334,7 @@ class MeshDataPlane:
         self.debt = shard_state(np.zeros(h, dtype=np.int64))
 
         self._step = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 partial(_round_step, n, int(params.seed), int(max_pkts)),
                 mesh=self.mesh,
                 in_specs=((P(AXIS), P(AXIS), P(AXIS)),
@@ -346,7 +362,7 @@ class MeshDataPlane:
         f = self._scan_cache.get(k)
         if f is None:
             f = jax.jit(
-                jax.shard_map(
+                _shard_map(
                     partial(_scan_rounds, self.n_shards, self._seed,
                             self._max_pkts),
                     mesh=self.mesh,
@@ -408,7 +424,7 @@ class MeshDataPlane:
         f = self._scan_cache.get(key)
         if f is None:
             f = jax.jit(
-                jax.shard_map(
+                _shard_map(
                     partial(_exchange_rounds, self.n_shards, self._seed,
                             self._max_pkts, w),
                     mesh=self.mesh,

@@ -66,8 +66,7 @@ events:
   - {time: 2s, kind: link_down, src_nodes: [0], dst_nodes: [1], duration: 3s}
 """
 
-VOLATILE = ("wall_seconds", "sim_sec_per_wall_sec", "phase_wall",
-            "max_rss_mb")
+from shadow_tpu.core.controller import VOLATILE_SUMMARY_KEYS as VOLATILE
 
 
 def _strip(summary):

@@ -28,8 +28,7 @@ subprocess.run(
     check=True, capture_output=True)
 from shadow_tpu.native import _colcore  # noqa: E402
 
-VOLATILE = ("wall_seconds", "sim_sec_per_wall_sec", "phase_wall",
-            "max_rss_mb")
+from shadow_tpu.core.controller import VOLATILE_SUMMARY_KEYS as VOLATILE
 
 
 def _run(tmp_path, cfg_path, colcore, overrides=None, policy="tpu_batch"):

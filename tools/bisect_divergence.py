@@ -17,6 +17,16 @@ target instead of a haystack.
 
 Usage:
     python tools/bisect_divergence.py A/state_digests.jsonl B/state_digests.jsonl
+    python tools/bisect_divergence.py --window-rounds K A.jsonl B.jsonl
+
+``--window-rounds K`` (for runs made with a fixed
+``experimental.device_window_rounds``) additionally names which fused
+device window contained the first divergent round — window W covers
+rounds [W*K+1, (W+1)*K] on the gapless grid. Real window boundaries can
+drift later than the grid (idle rounds, causal flushes, and busy
+pipeline slots all restart the K-count), so treat the annotation as the
+EARLIEST window that could have carried the round — the right place to
+START re-examining dispatches, not a proof of which one misbehaved.
 
 Exit status: 0 = streams identical, 1 = divergence found (details on
 stdout), 2 = usage / unreadable input.
@@ -88,7 +98,28 @@ def compare(recs_a: list[dict], recs_b: list[dict]):
     return None
 
 
+def window_of(round_no: int, window_rounds: int) -> tuple[int, int, int]:
+    """(window index, first round, last round) of the fused device window
+    containing ``round_no`` under a fixed device_window_rounds=K. Rounds
+    are 1-based in the sentinel stream; windows close every K barriers,
+    so window W spans rounds [W*K+1, (W+1)*K]."""
+    w = (round_no - 1) // window_rounds
+    return w, w * window_rounds + 1, (w + 1) * window_rounds
+
+
 def main(argv) -> int:
+    window_rounds = 0
+    if argv and argv[0] == "--window-rounds":
+        if len(argv) < 2:
+            print(__doc__, file=sys.stderr)
+            return 2
+        try:
+            window_rounds = int(argv[1])
+        except ValueError:
+            _die(f"--window-rounds expects an integer, got {argv[1]!r}")
+        if window_rounds < 1:
+            _die("--window-rounds must be >= 1 (the fixed K of the run)")
+        argv = argv[2:]
     if len(argv) != 2:
         print(__doc__, file=sys.stderr)
         return 2
@@ -105,6 +136,11 @@ def main(argv) -> int:
         print(f"FIRST DIVERGENT ROUND: {d['round']} (sim t={d['t']} ns)")
         print(f"  last matching round: {d['last_match']}")
         print(f"  divergent {where}")
+        if window_rounds:
+            w, lo, hi = window_of(d["round"], window_rounds)
+            print(f"  fused device window: #{w} (rounds {lo}..{hi} at "
+                  f"K={window_rounds}, gapless grid) is the earliest "
+                  f"window that could have carried the divergent round")
     elif d["kind"] == "missing":
         print(f"DIVERGED: stream B has no record for round {d['round']} "
               f"(last matching round: {d['last_match']}) — run B ended "

@@ -16,7 +16,7 @@ export JAX_PLATFORMS=cpu
 run() {
     python -m shadow_tpu examples/tgen_1k.yaml --quiet --json-summary \
         --data-directory "/tmp/ci-det-$1" \
-        | python -c 'import json,sys; d=json.load(sys.stdin); d.pop("wall_seconds"); d.pop("sim_sec_per_wall_sec"); d.pop("phase_wall", None); d.pop("max_rss_mb", None); print(json.dumps(d,sort_keys=True))' \
+        | python -c 'import json,sys; from shadow_tpu.core.controller import VOLATILE_SUMMARY_KEYS as V; d=json.load(sys.stdin); [d.pop(k, None) for k in V]; print(json.dumps(d,sort_keys=True))' \
         > "/tmp/ci-det-$1.json"
     (cd "/tmp/ci-det-$1" && find hosts -type f | sort | xargs sha256sum) \
         > "/tmp/ci-det-$1.hashes"
@@ -26,6 +26,31 @@ run b
 diff /tmp/ci-det-a.json /tmp/ci-det-b.json
 diff /tmp/ci-det-a.hashes /tmp/ci-det-b.hashes
 echo "determinism OK: $(python -c 'import json;print(json.load(open("/tmp/ci-det-a.json"))["events"])') events bit-identical"
+
+echo "== fused-window smoke (forced device, K=4 vs K=1 determinism + windows served) =="
+wrun() {
+    python -m shadow_tpu examples/tgen_1k.yaml --quiet --json-summary \
+        --data-directory "/tmp/ci-win-$1" \
+        --scheduler-policy tpu_batch \
+        --set experimental.tpu_device_floor=1 \
+        --set "experimental.device_window_rounds=$2" \
+        | python -c '
+import json, sys
+from shadow_tpu.core.controller import VOLATILE_SUMMARY_KEYS
+d = json.load(sys.stdin)
+assert d["device_windows_dispatched"] > 0, \
+    "forced device serviced zero fused windows"
+for k in VOLATILE_SUMMARY_KEYS:
+    d.pop(k, None)
+print(json.dumps(d, sort_keys=True))' > "/tmp/ci-win-$1.json"
+    (cd "/tmp/ci-win-$1" && find hosts -type f | sort | xargs sha256sum) \
+        > "/tmp/ci-win-$1.hashes"
+}
+wrun k1 1
+wrun k4 4
+diff /tmp/ci-win-k1.json /tmp/ci-win-k4.json
+diff /tmp/ci-win-k1.hashes /tmp/ci-win-k4.hashes
+echo "fused-window smoke OK: K=4 bit-identical to K=1 with windows served"
 
 echo "== checkpoint/resume smoke (tgen_100host: snapshot mid-run, resume, tree-hash equality) =="
 rm -rf /tmp/ci-ckpt-full /tmp/ci-ckpt-src /tmp/ci-ckpt-resume
