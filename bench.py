@@ -887,7 +887,44 @@ def main() -> None:
         "raw_rates": {p: rates(r) for p, r in runs.items()},
         "spread_rel": spread,
         "warmup_run_wall_s": warmup_runs,
+        # VERDICT r5 item #7: the warm-up leak itemized per policy — the
+        # first MEASURED rep's shortfall vs the median rep. With every
+        # device program shape pre-compiled at attach (DeviceDrawPlane.
+        # warm_shapes) plus the untimed full warm-up run, this should sit
+        # at machine noise; a recurring large positive value here means a
+        # one-time cost escaped the warm-up again.
+        "first_rep_excess_rel": {
+            pol: round(1 - rates(r)[0] / max(
+                sorted(rates(r))[len(r) // 2], 1e-9), 4)
+            for pol, r in runs.items()},
     }
+
+    # telemetry overhead on the headline config (telemetry PR acceptance:
+    # <= 5% wall at the default sampling cadence; published, not hidden).
+    # Two measures: phase_wall["telemetry"] is the directly-attributed
+    # in-band cost (exact, noise-free); the wall delta vs the headline
+    # median rides shared-machine noise and is published for honesty.
+    telr = run_config(args.config, "tpu_batch", "tpu-tel",
+                      {"telemetry": {}})
+    tel_wall = telr["phase_wall"].get("telemetry", 0.0)
+    detail["tgen_1k"]["telemetry_overhead"] = {
+        "telemetry_wall_seconds": round(tel_wall, 4),
+        "telemetry_pct_of_loop": round(
+            100 * tel_wall / telr["wall_seconds"], 2),
+        "wall_seconds_with_telemetry": round(telr["wall_seconds"], 3),
+        "wall_seconds_median_without": round(tpu["wall_seconds"], 3),
+        "wall_delta_pct_noisy": round(
+            100 * (telr["wall_seconds"] / tpu["wall_seconds"] - 1), 1),
+        "samples": telr.get("telemetry", {}).get("samples", 0),
+        "flows_recorded": telr.get("telemetry", {}).get(
+            "flows_recorded", 0),
+    }
+    to = detail["tgen_1k"]["telemetry_overhead"]
+    log(f"telemetry overhead on tgen_1k: "
+        f"{to['telemetry_pct_of_loop']}% of loop wall attributed "
+        f"({to['telemetry_wall_seconds']}s; noisy run-delta "
+        f"{to['wall_delta_pct_noisy']}%; {to['samples']} samples, "
+        f"{to['flows_recorded']} flows)")
 
     # results must be identical across policies — a benchmark that diverged
     # would be measuring two different simulations

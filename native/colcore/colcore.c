@@ -2466,6 +2466,11 @@ typedef struct CEp {
   int64_t tgen_pending; /* server: bytes left to push; client: received */
   int64_t tgen_want;    /* client: completion target */
   PyObject *tgen_cb;    /* server: on_request(want); client: cb(now, got) */
+  /* telemetry (shadow_tpu/telemetry/): sim time of the first delivered
+   * response byte in tgen client mode, -1 until one arrives — the exact
+   * twin of the Python model's first-on_data capture (the flow record's
+   * TTFB field reads it through the tgen_t_first getter) */
+  int64_t tgen_t_first;
 } CEp;
 
 static PyTypeObject CEp_Type; /* fwd */
@@ -2834,6 +2839,7 @@ static int cr_deliver(CEp *e, int64_t now, int64_t nbytes,
   if (e->xsink)
     return exit_feed((struct CExitStream *)e->xsink, now, nbytes);
   if (e->tgen_mode == 2) {
+    if (e->tgen_t_first < 0) e->tgen_t_first = now;
     e->tgen_pending += nbytes;
     if (e->tgen_pending >= e->tgen_want && e->tgen_cb &&
         e->tgen_cb != Py_None) {
@@ -3342,6 +3348,7 @@ static PyObject *CEp_tgen_client(CEp *e, PyObject *args) {
   e->tgen_mode = 2;
   e->tgen_want = want;
   e->tgen_pending = 0;
+  e->tgen_t_first = -1;
   Py_INCREF(cb);
   Py_XSETREF(e->tgen_cb, cb);
   Py_RETURN_NONE;
@@ -3396,6 +3403,20 @@ I64_GETSET(snd_una)
 I64_GETSET(snd_nxt)
 I64_GETSET(cwnd)
 I64_GETSET(rto_ns)
+/* telemetry samplers (shadow_tpu/telemetry/collector.py) read the same
+ * sender-state fields the Python twin exposes on StreamSender */
+I64_GETSET(ssthresh)
+I64_GETSET(rto_backoff)
+
+static PyObject *CEp_get_retries(CEp *e, void *u) {
+  (void)u;
+  return PyLong_FromLong(e->retries);
+}
+
+static PyObject *CEp_get_tgen_t_first(CEp *e, void *u) {
+  (void)u;
+  return PyLong_FromLongLong(e->tgen_t_first);
+}
 
 static PyObject *CEp_get_state(CEp *e, void *u) {
   (void)u;
@@ -3465,6 +3486,12 @@ static PyGetSetDef CEp_getset[] = {
     {"remote_host", (getter)CEp_get_remote_host, NULL, NULL, NULL},
     {"remote_port", (getter)CEp_get_remote_port, NULL, NULL, NULL},
     {"loss_events", (getter)CEp_get_loss_events, NULL, NULL, NULL},
+    {"ssthresh", (getter)CEp_get_ssthresh, (setter)CEp_set_ssthresh, NULL,
+     NULL},
+    {"rto_backoff", (getter)CEp_get_rto_backoff,
+     (setter)CEp_set_rto_backoff, NULL, NULL},
+    {"retries", (getter)CEp_get_retries, NULL, NULL, NULL},
+    {"tgen_t_first", (getter)CEp_get_tgen_t_first, NULL, NULL, NULL},
     {NULL, NULL, NULL, NULL, NULL}};
 
 static PyMethodDef CEp_methods[] = {
@@ -3521,6 +3548,7 @@ static CEp *cep_new(CoreObject *c, int hid, int lport, int rhost, int rport,
   e->ssthresh = 1LL << 62;
   e->adv_wnd = INIT_CWND_C;
   e->rto_backoff = 1;
+  e->tgen_t_first = -1;
   e->send_buffer = sbuf;
   e->recv_buffer = rbuf;
   e->last_wnd = rbuf;

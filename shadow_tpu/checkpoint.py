@@ -287,11 +287,56 @@ def load_checkpoint(path, cfg=None, mirror_log: bool = True):
         for section, key in VOLATILE_CONFIG_KEYS:
             setattr(getattr(controller.cfg, section), key,
                     getattr(getattr(cfg, section), key))
+        # the telemetry section is volatile too (result-transparent, not
+        # in the config digest) but is a whole subsystem, not a scalar:
+        # honor the resume invocation's section — enable, disable, or
+        # re-cadence — instead of silently keeping the pickled state
+        _apply_telemetry_resume(controller, cfg.telemetry, now)
     controller._reattach_runtime(mirror_log=mirror_log)
     controller.log.info(
         f"resumed from {path}: sim time {now} ns, round {controller.rounds}, "
         f"{controller.events} events")
     return controller, now
+
+
+def _apply_telemetry_resume(controller, want, now: int) -> None:
+    """Reconcile the restored controller's telemetry state with the
+    resume invocation's ``telemetry:`` section (the volatile-key rule,
+    section-shaped). Same section -> the pickled collector continues its
+    streams bit-exactly; absent -> telemetry is disabled; newly present
+    or re-cadenced -> a fresh/retimed collector starts sampling at the
+    next grid point after ``now``. Caveat (documented in MIGRATION.md):
+    flow records come from model code that captures the collector at
+    process spawn, so ENABLING telemetry on resume covers samplers and
+    fault annotations immediately but only processes spawned after the
+    resume point produce flow records."""
+    have = controller.telemetry
+    if want is None:
+        if have is not None:
+            controller.telemetry = None
+            for h in controller.hosts:
+                h.telemetry = None
+            if controller.faults is not None:
+                controller.faults.on_apply = None
+        controller.cfg.telemetry = None
+        return
+    from shadow_tpu.telemetry import TelemetryCollector
+
+    if have is None:
+        tel = TelemetryCollector(want)
+        tel.next_sample = ((now // tel.sample_every) + 1) * tel.sample_every
+        controller.telemetry = tel
+        for h in controller.hosts:
+            h.telemetry = tel
+        if controller.faults is not None:
+            controller.faults.on_apply = tel.record_fault
+    else:
+        if int(want.sample_every) != have.sample_every:
+            have.sample_every = int(want.sample_every)
+            have.next_sample = (
+                (now // have.sample_every) + 1) * have.sample_every
+        have.metrics_dir = want.metrics_dir
+    controller.cfg.telemetry = want
 
 
 # -- determinism sentinel -----------------------------------------------------
@@ -362,13 +407,11 @@ def state_digest(controller, sim_now: int):
         "ev_key": eng._ev_key,
         "tokens_down": eng.tokens_down,
         # egress buckets: hash the canonical observable, not the raw
-        # (t_base, tokens, debt) triple — the vector path rebases every
-        # source each barrier while the scalar twin rebases lazily, an
-        # outcome-identical representation difference (fluid.py). Capped
-        # available-at-now is identical across planes: any divergence in
-        # actual bucket BEHAVIOR must show here or in the unit counters.
-        "bucket_avail": np.minimum(eng.buckets.available(sim_now),
-                                   eng.params.cap_up),
+        # (t_base, tokens, debt) triple — capped available-at-now
+        # (fluid.TokenBuckets.levels, shared with the telemetry samplers)
+        # is identical across planes: any divergence in actual bucket
+        # BEHAVIOR must show here or in the unit counters.
+        "bucket_avail": eng.buckets.levels(sim_now),
         "last_refill": eng._last_refill,
         # the effective latency/loss/rate matrices are deliberately NOT
         # hashed: they are pure functions of the config (pinned by

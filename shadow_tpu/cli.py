@@ -51,6 +51,18 @@ def build_parser() -> argparse.ArgumentParser:
         "(the config must match the original run)",
     )
     p.add_argument(
+        "--sample-every", metavar="SIMTIME",
+        help="enable the telemetry subsystem and snapshot per-host/per-NIC "
+        "state every SIMTIME of simulated time (telemetry.sample_every); "
+        "metrics.jsonl + flows.jsonl land in the metrics directory and are "
+        "byte-identical across scheduler policies and data planes",
+    )
+    p.add_argument(
+        "--metrics-dir",
+        help="enable telemetry and write metrics.jsonl/flows.jsonl here "
+        "(telemetry.metrics_dir; default <data-directory>)",
+    )
+    p.add_argument(
         "--state-digest-every", type=int, metavar="N",
         help="determinism sentinel: emit a canonical state digest every N "
         "rounds to <data-directory>/state_digests.jsonl "
@@ -89,6 +101,8 @@ def overrides_from_args(args: argparse.Namespace) -> dict:
         "checkpoint_every": "general.checkpoint_every",
         "checkpoint_dir": "general.checkpoint_dir",
         "state_digest_every": "general.state_digest_every",
+        "sample_every": "telemetry.sample_every",
+        "metrics_dir": "telemetry.metrics_dir",
     }
     for attr, key in flag_map.items():
         val = getattr(args, attr)
@@ -135,6 +149,8 @@ def main(argv=None) -> int:
                 "hosts": [dataclasses.asdict(h) for h in cfg.hosts],
                 **({"faults": dataclasses.asdict(cfg.faults)}
                    if cfg.faults is not None else {}),
+                **({"telemetry": dataclasses.asdict(cfg.telemetry)}
+                   if cfg.telemetry is not None else {}),
             },
             indent=2, default=enc,
         ))

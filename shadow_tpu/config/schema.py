@@ -170,12 +170,34 @@ class FaultsOptions:
 
 
 @dataclass
+class TelemetryOptions:
+    """The ``telemetry:`` section (shadow_tpu/telemetry/): sim-time
+    samplers + flow records + streaming percentiles, exported as
+    append-only ``metrics.jsonl`` / ``flows.jsonl``. Presence of the
+    section enables collection; sampling cadence is simulated time, so
+    the streams are byte-identical across scheduler policies, data
+    planes, and the Python/C twins. Telemetry is result-transparent
+    (never simulation state), so it is NOT part of the checkpoint config
+    digest — a resume may change it like other volatile keys."""
+
+    #: snapshot per-host/per-NIC state every this much SIM time, at the
+    #: first round boundary past each grid point (the 10s default keeps
+    #: telemetry within its <=5% wall budget on the tgen_1k bench row —
+    #: BENCH_DETAIL telemetry_overhead; dense series want an explicit
+    #: sample_every)
+    sample_every: SimTime = 10_000_000_000  # 10s
+    #: where metrics.jsonl/flows.jsonl land; None = data_directory
+    metrics_dir: Optional[str] = None
+
+
+@dataclass
 class ConfigOptions:
     general: GeneralOptions = field(default_factory=GeneralOptions)
     network: dict = field(default_factory=lambda: {"graph": {"type": "1_gbit_switch"}})
     experimental: ExperimentalOptions = field(default_factory=ExperimentalOptions)
     hosts: list[HostOptions] = field(default_factory=list)
     faults: Optional[FaultsOptions] = None
+    telemetry: Optional[TelemetryOptions] = None
     #: accepted-but-unimplemented options the user actually set; the
     #: controller logs each (silently ignoring a knob is a correctness trap)
     warnings: list[str] = field(default_factory=list)
@@ -310,6 +332,25 @@ def _parse_faults(doc: dict) -> FaultsOptions:
     return f
 
 
+def _parse_telemetry(doc) -> TelemetryOptions:
+    """``telemetry:`` — a bare key (None) enables with defaults, which is
+    what the CLI's --sample-every/--metrics-dir overrides rely on."""
+    t = TelemetryOptions()
+    if doc is None:
+        return t
+    _require(isinstance(doc, dict), "telemetry must be a mapping")
+    for k in doc:
+        _require(k in ("sample_every", "metrics_dir"),
+                 f"unknown telemetry key {k!r} (want sample_every/"
+                 f"metrics_dir)")
+    if doc.get("sample_every") is not None:
+        t.sample_every = parse_time(doc["sample_every"])
+        _require(t.sample_every > 0, "telemetry.sample_every must be > 0")
+    if doc.get("metrics_dir") is not None:
+        t.metrics_dir = str(doc["metrics_dir"])
+    return t
+
+
 def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
     """Parse a loaded YAML document (plus dotted-key CLI overrides) into
     validated ConfigOptions.
@@ -323,7 +364,13 @@ def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
         parts = key.split(".")
         cur = doc
         for p in parts[:-1]:
-            cur = cur.setdefault(p, {})
+            nxt = cur.setdefault(p, {})
+            if nxt is None:
+                # a bare section key (`telemetry:` / `faults:` with no
+                # body) parses as None; a dotted override into it means
+                # "that section, with this key set"
+                nxt = cur[p] = {}
+            cur = nxt
             _require(isinstance(cur, dict), f"cannot override {key!r}")
         cur[parts[-1]] = val
 
@@ -415,6 +462,20 @@ def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
         "COMPONENTS.md component #13) — set experimental.loss_oracle: "
         "true to acknowledge and keep using it for A/B runs",
     )
+
+    if e.stream_loss_recovery == "oracle":
+        # deprecation warning even with the loss_oracle acknowledgement:
+        # the controller logs every entry here at build (satellite of the
+        # telemetry PR; retirement criterion in COMPONENTS.md #13)
+        cfg.warnings.append(
+            "experimental.loss_oracle: the oracle loss-recovery model is "
+            "DEPRECATED and scheduled for deletion — BENCH_DETAIL.json "
+            "already carries a full dupack-only round (the retire-by "
+            "criterion in COMPONENTS.md component #13); migrate A/B runs "
+            "to stream_loss_recovery: dupack")
+
+    if "telemetry" in doc:  # bare `telemetry:` enables with defaults
+        cfg.telemetry = _parse_telemetry(doc["telemetry"])
 
     if doc.get("faults") is not None:  # `faults:` left empty = absent
         cfg.faults = _parse_faults(doc["faults"])

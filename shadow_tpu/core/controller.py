@@ -230,6 +230,24 @@ class Controller:
                 f"({len(faults_cfg.events)} configured events, "
                 f"{len(faults_cfg.churn)} churn groups)")
 
+        #: deterministic simulation telemetry (shadow_tpu/telemetry/): a
+        #: telemetry: section builds the collector; hosts carry a direct
+        #: reference so flow records cost one attribute check when off.
+        #: Unlike faults/checkpoint, telemetry does NOT force the Python
+        #: planes — the samplers read only plane-independent observables
+        #: (shared numpy arrays, folded C counters, endpoint getters that
+        #: the C twin exposes), and the streams are asserted byte-identical
+        #: with the C engine on and off (tests/test_telemetry.py).
+        self.telemetry = None
+        if cfg.telemetry is not None:
+            from shadow_tpu.telemetry import TelemetryCollector
+
+            self.telemetry = TelemetryCollector(cfg.telemetry)
+            for h in self.hosts:
+                h.telemetry = self.telemetry
+            if self.faults is not None:
+                self.faults.on_apply = self.telemetry.record_fault
+
         self.counters = Counters()
         self.rounds = 0
         self.events = 0
@@ -340,6 +358,11 @@ class Controller:
             # tools/bisect_divergence.py (resumes keep appending — the
             # continuation of one stream)
             (self.data_dir / _ckpt.DIGEST_FILE).unlink(missing_ok=True)
+        tel = self.telemetry
+        if tel is not None and resume_at is None:
+            # same discipline for the telemetry streams: fresh runs
+            # truncate + write the meta record; resumes keep appending
+            tel.start_fresh(self)
         next_ckpt = ((now // ck_every) + 1) * ck_every if ck_every \
             else T_NEVER
         # graceful shutdown: SIGINT/SIGTERM finish the current round, write
@@ -375,7 +398,7 @@ class Controller:
             now = self._round_loop(now, stop, w, dyn, faults, next_hb,
                                    hb_interval, next_prog, prog_step,
                                    next_gc, next_ckpt, ck_every, dig,
-                                   _ckpt, t0)
+                                   _ckpt, tel, t0)
         finally:
             for s, old in installed.items():
                 _signal.signal(s, old)
@@ -402,7 +425,7 @@ class Controller:
 
     def _round_loop(self, now, stop, w, dyn, faults, next_hb, hb_interval,
                     next_prog, prog_step, next_gc, next_ckpt, ck_every,
-                    dig, _ckpt, t0) -> SimTime:
+                    dig, _ckpt, tel, t0) -> SimTime:
         """The conservative round loop (split from run() so the signal
         try/finally stays readable). Returns the final sim time."""
         import gc as _gc
@@ -413,6 +436,8 @@ class Controller:
                 # round; stop at this (consistent) round boundary
                 break
             if now >= next_ckpt:
+                if tel is not None:
+                    tel.sync(self)  # streams complete at the boundary
                 path = _ckpt.save_checkpoint(self, now)
                 self.log.info(
                     f"checkpoint written: {path} "
@@ -452,6 +477,14 @@ class Controller:
                 # round boundary (flushes in-flight draws first — result-
                 # identical, so digesting runs stay byte-identical)
                 _ckpt.emit_digest(self, round_end)
+            if tel is not None and (tel.dirty
+                                    or round_end >= tel.next_sample):
+                # telemetry: flush this round's flow closes + fault
+                # annotations; take a sample when the sim-time grid says
+                # so (the round grid is policy-independent, so the
+                # streams are too). One None check when off; idle rounds
+                # of a telemetry run skip the call entirely.
+                tel.on_round_end(self, round_end)
             if round_end >= next_hb:
                 self._heartbeat(round_end, t0)
                 next_hb += hb_interval
@@ -524,6 +557,10 @@ class Controller:
         )
 
     def _finalize(self, end_time: SimTime) -> dict:
+        if self.telemetry is not None:
+            # flush the final round's flow closes before processes are
+            # reaped (records already buffered; reaping adds none)
+            self.telemetry.finalize(self)
         errors = []
         for p in self.processes:
             err = p.check_final_state()
@@ -591,6 +628,8 @@ class Controller:
                 "events": round(self._events_wall, 4),
                 **{k: round(v, 4)
                    for k, v in self.engine.phase_wall.items()},
+                **({"telemetry": round(self.telemetry.wall, 4)}
+                   if self.telemetry is not None else {}),
             },
             # fused device windows (round-5 Weak #5): zero here on a
             # tpu_batch run means the device never serviced a window —
@@ -604,6 +643,12 @@ class Controller:
                if hasattr(self.engine, "device_summary") else {}),
             **({"fault_transitions_applied": self.faults.applied}
                if self.faults is not None else {}),
+            # flow-latency percentiles + sample counts (telemetry/):
+            # deterministic reductions of sim-time state — intentionally
+            # NOT in VOLATILE_SUMMARY_KEYS, so the determinism gates cover
+            # them too
+            **({"telemetry": self.telemetry.summary()}
+               if self.telemetry is not None else {}),
         }
 
 

@@ -129,5 +129,16 @@ class EventQueue:
         out.sort()
         return out
 
+    def live_count(self, exclude_band: Optional[int] = None) -> int:
+        """Count of pending non-cancelled events outside ``exclude_band``
+        — live_times without materializing the sorted list (the telemetry
+        sampler calls this once per host per sample)."""
+        cancelled = self._cancelled
+        n = 0
+        for e in self._heap:
+            if e[1] != exclude_band and e[3] not in cancelled:
+                n += 1
+        return n
+
     def __len__(self) -> int:
         return len(self._heap) - len(self._cancelled)

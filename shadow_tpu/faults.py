@@ -197,6 +197,11 @@ class FaultInjector:
                                 f"support pyapp processes only")
         self.idx = 0
         self.applied = 0
+        #: telemetry hook (telemetry/collector.py::record_fault): called
+        #: once per applied action with (now, rounds, action) so fault
+        #: windows are annotated in the metrics stream. Application order
+        #: is deterministic, so the annotations are too.
+        self.on_apply = None
         g = self.graph.n_nodes
         self._base_lat = self.graph.latency_ns.copy()
         self._base_rel = self.graph.reliability.copy()
@@ -248,6 +253,8 @@ class FaultInjector:
                         h.reboot(now)
             log.debug(f"fault at {format_time(now)}: {a.kind} "
                       f"(scheduled {format_time(a.t)})")
+            if self.on_apply is not None:
+                self.on_apply(now, self.ctl.rounds, a)
         if link_dirty:
             self._recompute(now)
 

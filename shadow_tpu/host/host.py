@@ -83,6 +83,13 @@ class Host:
         self._ack_eps: dict = {}  # endpoints owing a coalesced barrier ack
         self.pcap = None  # PcapWriter when hosts.<name>.pcap_enabled
         self.log_level = "info"  # per-host override (hosts.<name>.log_level)
+        #: telemetry (shadow_tpu/telemetry/): the run's collector when a
+        #: telemetry: section exists, else None (models check this ONCE at
+        #: start, so the off path costs nothing per event). Flow records
+        #: buffer host-locally (_flow_buf) and flush at round ends in
+        #: host-id order — canonical regardless of scheduler policy.
+        self.telemetry = None
+        self._flow_buf: list = []
 
     # -- time & events ----------------------------------------------------
     @property
@@ -252,6 +259,23 @@ class Host:
             self.counters.add("units_unroutable", 1)
             return
         ep.handle_fields(kind, nbytes, payload, seq, t)
+
+    def record_flow(self, kind: str, peer, t_open: SimTime,
+                    ttfb: Optional[SimTime], nbytes: int, status: str,
+                    retx: int = 0) -> None:
+        """One application-flow lifecycle record (telemetry/collector.py),
+        called at flow close from model code. ``ttfb`` is absolute sim
+        time of the first payload byte (None if none arrived); close time
+        is the host clock now. No-op when telemetry is off."""
+        tel = self.telemetry
+        if tel is None:
+            return
+        buf = self._flow_buf
+        if not buf:
+            tel.note_flow_host(self)
+        buf.append((kind, peer, t_open, self._now,
+                    (ttfb - t_open if ttfb is not None else None),
+                    nbytes, status, retx))
 
     def mark_ack(self, ep) -> None:
         """Queue a coalesced barrier ack for this endpoint (transport's

@@ -41,6 +41,11 @@ class GossipNode:
         self.received_tx = 0
         self.originated = 0
         self._c = None  # C gossip state (set in start when available)
+        #: telemetry (shadow_tpu/telemetry/): pending GETDATA send times
+        #: by txid — a flow record per INV->GETDATA->TX fetch closes when
+        #: the TX lands. None when telemetry is off (zero per-message
+        #: work; the C gossip twin then keeps the hot half).
+        self._pending: dict = None
 
     def start(self):
         self.sock = self.api.udp_socket(self.port)
@@ -61,7 +66,14 @@ class GossipNode:
         host = getattr(self.api, "_host", None)
         cp = getattr(host, "colplane", None)
         core = getattr(cp, "_c", None)
-        if core is not None and host.pcap is None:
+        tel = getattr(host, "telemetry", None)
+        if tel is not None:
+            # telemetry: fetch timing lives in the model, so message
+            # handling stays in Python — bit-identical to the C twin
+            # (test_colcore asserts the whole output tree matches), only
+            # wall time moves; the fetch records need the GETDATA instant
+            self._pending = {}
+        elif core is not None and host.pcap is None:
             self._c = core.gossip_register(host.id, self.port, self.peers)
         if self.originate > 0:
             delay = int((0.25 + 0.5 * float(rng.random())) * self.interval * NS_PER_SEC)
@@ -104,6 +116,12 @@ class GossipNode:
         src_host, src_port = src_addr
         if kind == INV:
             if txid not in self.seen:
+                pend = self._pending
+                if pend is not None and txid not in pend:
+                    # first GETDATA for this txid opens the fetch flow
+                    if len(pend) > 4096:  # bound memory like _partial
+                        pend.pop(next(iter(pend)))
+                    pend[txid] = now
                 self.sock.sendto(src_host, self.port, payload=GETDATA + txid, nbytes=64)
         elif kind == GETDATA:
             self.sock.sendto(src_host, self.port, payload=TX + txid, nbytes=TX_SIZE)
@@ -111,6 +129,15 @@ class GossipNode:
             if txid not in self.seen:
                 self.seen.add(txid)
                 self.received_tx += 1
+                pend = self._pending
+                if pend is not None:
+                    t_open = pend.pop(txid, None)
+                    if t_open is not None:
+                        # datagram fetch: the TX is the first (and last)
+                        # payload byte, so TTFB == completion latency
+                        self.api._host.record_flow(
+                            "gossip_fetch", src_host, t_open, now,
+                            nbytes, "ok")
                 self._announce(txid, exclude=src_host)
 
     def stop(self):

@@ -411,7 +411,12 @@ class TorClient:
         self.attempted += 1
         t0 = api.now
         circ = 1
-        state = {"stage": 0}  # hops established so far (guard = 1)
+        # stage: hops established so far (guard = 1); bd: telescoping-done
+        # instant — the tor fetch's TTFB analog for the telemetry flow
+        # record (observable identically under the Python closures and the
+        # C tor sink: both fire on_ctrl for every control cell)
+        state = {"stage": 0, "bd": None}
+        tel = getattr(getattr(api, "_host", None), "telemetry", None)
 
         ep = api.connect(hops[0], self.relay_port)
 
@@ -434,6 +439,11 @@ class TorClient:
                         f"elapsed_ms={elapsed // 1_000_000}")
             else:
                 self.failed += 1
+            if tel is not None:
+                api._host.record_flow(
+                    "tor_fetch", self.server, t0, state["bd"], got,
+                    "ok" if got >= self.size else "error",
+                    retx=int(ep.sender.loss_events))
             conn.ep.close()
             self._finish()
 
@@ -442,6 +452,7 @@ class TorClient:
                 state["stage"] += 1
                 if state["stage"] == 3:  # telescoping done; BEGIN follows
                     self.build_times.append(api.now - t0)
+                    state["bd"] = api.now
                 advance()
             elif ctype == END:
                 finish_fetch(got)
@@ -476,6 +487,11 @@ class TorClient:
         def on_error(msg):
             self.failed += 1
             api.log(f"circuit-failed hops={hops}: {msg}")
+            if tel is not None:
+                api._host.record_flow(
+                    "tor_fetch", self.server, t0, state["bd"], 0,
+                    "timeout" if "ETIMEDOUT" in msg else "error",
+                    retx=int(ep.sender.loss_events))
             self._finish()
 
         ep.on_connected = on_connected

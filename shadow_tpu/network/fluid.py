@@ -162,6 +162,15 @@ class TokenBuckets:
         p = self.params
         return self.tokens + bytes_over(p.rate_up, t_now - self.t_base) - self.debt
 
+    def levels(self, t_now: SimTime) -> np.ndarray:
+        """Capped available-at-now — THE canonical plane-independent
+        bucket observable (the vector path rebases every source each
+        barrier while the scalar twin rebases lazily, an outcome-identical
+        representation difference; capping removes it). Shared by the
+        determinism sentinel (checkpoint.state_digest) and the telemetry
+        samplers (telemetry/collector.py)."""
+        return np.minimum(self.available(t_now), self.params.cap_up)
+
     def rebase(self, t_now: SimTime) -> None:
         """Clamp saturated buckets to capacity at t_now (lazy, exact for any
         source that still has committed departures pending — see module doc)."""

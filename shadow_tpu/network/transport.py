@@ -37,6 +37,15 @@ real protocol rather than an oracle-dependent sketch:
 Congestion control is standard slow-start + AIMD (RFC 5681 shaped) in
 integer bytes. Datagram sockets fragment payloads into units and reassemble
 at the receiver; losing any fragment loses the datagram (IP semantics).
+
+Telemetry contract (shadow_tpu/telemetry/): the sampler aggregates, per
+host connection, ``sender.{snd_nxt, snd_una, cwnd, ssthresh, loss_events,
+retries, rto_backoff, buffered}`` and models read
+``sender.loss_events`` / ``receiver.bytes_received`` at flow close. Every
+field in that set is exposed IDENTICALLY by the C endpoint twin
+(native/colcore ``CEp`` getters) — extending the sampled set means adding
+the matching C getter, or the telemetry streams stop being byte-identical
+across the Python/C twins (tests/test_telemetry.py enforces this).
 """
 
 from __future__ import annotations

@@ -1,0 +1,8 @@
+"""Deterministic simulation telemetry (see collector.py for the design)."""
+
+from shadow_tpu.telemetry.collector import (  # noqa: F401
+    FLOWS_FILE,
+    METRICS_FILE,
+    TelemetryCollector,
+)
+from shadow_tpu.telemetry.histogram import LogHistogram  # noqa: F401

@@ -1,0 +1,435 @@
+"""Deterministic simulation telemetry (shadow_tpu/telemetry/).
+
+The load-bearing properties:
+- ``metrics.jsonl`` + ``flows.jsonl`` are BYTE-IDENTICAL across scheduler
+  policies (both data planes) and with the C engine on or off — telemetry
+  is a correctness gate, not just observability;
+- a fault window (link_degrade) is visible both in the per-link sample
+  series and in the flow-latency percentiles vs a no-fault twin;
+- checkpoint/resume carries open-flow and histogram state: a resumed
+  run's streams continue bit-exactly and its summary percentiles equal
+  the uninterrupted run's;
+- telemetry off costs nothing and writes nothing.
+"""
+
+import glob
+import json
+from pathlib import Path
+
+import yaml
+
+from shadow_tpu.config import parse_config
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.telemetry import FLOWS_FILE, METRICS_FILE
+from shadow_tpu.telemetry.histogram import (
+    LogHistogram,
+    bucket_index,
+    bucket_lower_bound,
+)
+
+GRAPH = """
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "20 Mbit" host_bandwidth_down "20 Mbit" ]
+        node [ id 1 host_bandwidth_up "10 Mbit" host_bandwidth_down "5 Mbit" ]
+        edge [ source 0 target 1 latency "25 ms" packet_loss 0.02 ]
+        edge [ source 0 target 0 latency "5 ms" packet_loss 0.01 ]
+        edge [ source 1 target 1 latency "5 ms" ]
+      ]
+"""
+
+TGEN = f"""
+general:
+  stop_time: 20s
+  seed: 7
+network:
+  graph:
+    type: gml
+    inline: |{GRAPH}
+telemetry:
+  sample_every: 2s
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+      - path: pyapp:shadow_tpu.models.tgen:TGenServer
+        args: ["8080"]
+  client:
+    network_node_id: 1
+    quantity: 3
+    processes:
+      - path: pyapp:shadow_tpu.models.tgen:TGenClient
+        args: ["300 kB", "2", serial, "8080", server]
+        start_time: 500 ms
+"""
+
+GOSSIP_CHURN = f"""
+general:
+  stop_time: 20s
+  seed: 11
+network:
+  graph:
+    type: gml
+    inline: |{GRAPH}
+telemetry:
+  sample_every: 3s
+faults:
+  events:
+    - {{time: 5s, kind: link_down, src_nodes: [0], dst_nodes: [1], duration: 4s}}
+  churn:
+    - {{hosts: ["edge*"], mean_uptime: 7s, mean_downtime: 2s}}
+hosts:
+  node:
+    network_node_id: 0
+    quantity: 10
+    processes:
+      - path: pyapp:shadow_tpu.models.gossip:GossipNode
+        args: ["7000", "16", "4", "2", "0.5"]
+        environment: {{GOSSIP_REANNOUNCE_SEC: "4"}}
+  edge:
+    network_node_id: 1
+    quantity: 6
+    processes:
+      - path: pyapp:shadow_tpu.models.gossip:GossipNode
+        args: ["7000", "16", "4", "1", "0.7"]
+        environment: {{GOSSIP_REANNOUNCE_SEC: "4"}}
+"""
+
+
+def _run(doc, tag, tmp_path, **overrides):
+    over = {"general.data_directory": str(tmp_path / tag)}
+    over.update(overrides)
+    cfg = parse_config(yaml.safe_load(doc) if isinstance(doc, str) else doc,
+                       over)
+    ctl = Controller(cfg, mirror_log=False)
+    res = ctl.run()
+    return ctl, res, tmp_path / tag
+
+
+def _records(path: Path) -> list:
+    return [json.loads(ln) for ln in path.read_text().splitlines()]
+
+
+def _streams(d: Path) -> tuple[bytes, bytes]:
+    return (d / METRICS_FILE).read_bytes(), (d / FLOWS_FILE).read_bytes()
+
+
+# -- cross-plane / cross-policy byte identity -----------------------------
+
+def test_tgen_streams_identical_across_planes_and_c_twin(tmp_path):
+    """The tentpole gate: one tgen config, both data planes, the C engine
+    on AND off — all four runs produce byte-identical telemetry streams
+    (the C twin records flow TTFB/retransmits through its own paths)."""
+    runs = {
+        "tpc": {"experimental.scheduler_policy": "thread_per_core"},
+        "tph": {"experimental.scheduler_policy": "thread_per_host"},
+        "tpu": {"experimental.scheduler_policy": "tpu_batch"},
+        "tpu-py": {"experimental.scheduler_policy": "tpu_batch",
+                   "experimental.native_colcore": False},
+    }
+    streams = {}
+    summaries = {}
+    for tag, ov in runs.items():
+        _, res, d = _run(TGEN, tag, tmp_path, **ov)
+        streams[tag] = _streams(d)
+        summaries[tag] = res["telemetry"]
+    ref = streams["tpc"]
+    for tag, s in streams.items():
+        assert s[0] == ref[0], f"metrics.jsonl diverges under {tag}"
+        assert s[1] == ref[1], f"flows.jsonl diverges under {tag}"
+        assert summaries[tag] == summaries["tpc"], tag
+    # the streams carry real content
+    flows = [json.loads(ln) for ln in ref[1].splitlines()]
+    assert len(flows) == 6  # 3 clients x 2 serial fetches
+    for f in flows:
+        assert f["status"] == "ok" and f["bytes"] == 300_000
+        assert f["ttfb_ns"] is not None and 0 < f["ttfb_ns"] <= f["latency_ns"]
+    samples = [json.loads(ln) for ln in ref[0].splitlines()
+               if json.loads(ln).get("kind") == "sample"]
+    assert len(samples) >= 2
+    # per-flow-class percentiles land in the summary
+    t = summaries["tpc"]["flows"]["tgen_fetch"]
+    assert t["ok"] == 6 and t["p50_ms"] > 0
+    assert t["p50_ms"] <= t["p90_ms"] <= t["p99_ms"] <= t["p99_9_ms"]
+
+
+def test_gossip_churn_streams_identical_across_policies(tmp_path):
+    """Fault-config twin of the gate (gossip + partition + host churn):
+    the metrics stream carries the fault timeline and still bit-matches
+    across policies."""
+    streams = {}
+    for pol in ("thread_per_core", "thread_per_host", "tpu_batch"):
+        _, res, d = _run(GOSSIP_CHURN, f"g-{pol}", tmp_path,
+                         **{"experimental.scheduler_policy": pol})
+        streams[pol] = _streams(d)
+    ref = streams["thread_per_core"]
+    for pol, s in streams.items():
+        assert s == ref, f"telemetry streams diverge under {pol}"
+    faults = [json.loads(ln) for ln in ref[0].splitlines()
+              if json.loads(ln).get("kind") == "fault"]
+    assert any(f["action"] == "link_down" for f in faults)
+    assert any(f["action"] == "host_down" for f in faults)
+    flows = [json.loads(ln) for ln in ref[1].splitlines()]
+    assert flows and all(f["flow"] == "gossip_fetch" for f in flows)
+
+
+def test_tor_fetch_flows_identical_across_c_twin(tmp_path):
+    """Tor circuit fetches produce flow records (TTFB = telescoping done)
+    that bit-match across the Python closures and the C tor sink."""
+    from test_tor import TOR_CFG
+
+    doc = yaml.safe_load(TOR_CFG)
+    doc["telemetry"] = {"sample_every": "5s"}
+    streams = {}
+    for tag, ov in (
+            ("tpc", {"experimental.scheduler_policy": "thread_per_core"}),
+            ("tpu", {"experimental.scheduler_policy": "tpu_batch"}),
+            ("tpu-py", {"experimental.scheduler_policy": "tpu_batch",
+                        "experimental.native_colcore": False})):
+        _, _, d = _run(json.loads(json.dumps(doc)), f"tor-{tag}",
+                       tmp_path, **ov)
+        streams[tag] = _streams(d)
+    assert streams["tpc"] == streams["tpu"] == streams["tpu-py"]
+    flows = [json.loads(ln) for ln in streams["tpc"][1].splitlines()]
+    tor = [f for f in flows if f["flow"] == "tor_fetch"]
+    assert len(tor) == 8  # 4 clients x 2 circuits
+    for f in tor:
+        assert f["status"] == "ok"
+        assert f["ttfb_ns"] and f["ttfb_ns"] < f["latency_ns"]
+
+
+# -- fault visibility ------------------------------------------------------
+
+DEGRADE_DOC = """
+general:
+  stop_time: 40s
+  seed: 7
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "20 Mbit" host_bandwidth_down "20 Mbit" ]
+        node [ id 1 host_bandwidth_up "10 Mbit" host_bandwidth_down "5 Mbit" ]
+        edge [ source 0 target 1 latency "25 ms" ]
+        edge [ source 0 target 0 latency "5 ms" ]
+        edge [ source 1 target 1 latency "5 ms" ]
+      ]
+telemetry:
+  sample_every: 1s
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+      - path: pyapp:shadow_tpu.models.tgen:TGenServer
+        args: ["8080"]
+  client:
+    network_node_id: 1
+    quantity: 3
+    processes:
+      - path: pyapp:shadow_tpu.models.tgen:TGenClient
+        args: ["200 kB", "6", serial, "8080", server]
+        start_time: 500 ms
+"""
+
+DEGRADE_FAULT = """
+events:
+  - {time: 3s, kind: link_degrade, src_nodes: [0], dst_nodes: [1],
+     latency_factor: 4, loss_add: 0.02, duration: 8s}
+"""
+
+
+def test_link_degrade_window_visible_in_series_and_p99(tmp_path):
+    """A degrade window must be OBSERVABLE: annotated in the metrics
+    stream, visible in the per-link sample series (retransmit pressure on
+    the degraded paths — the baseline graph is loss-free, so every
+    retransmit the samplers see is the fault's), and it must move the
+    flow p99 vs a no-fault twin of the same config."""
+    doc = yaml.safe_load(DEGRADE_DOC)
+    base_doc = json.loads(json.dumps(doc))
+    doc["faults"] = yaml.safe_load(DEGRADE_FAULT)
+    _, res_f, d_f = _run(doc, "deg", tmp_path)
+    _, res_n, d_n = _run(base_doc, "nofault", tmp_path)
+
+    faults = [r for r in _records(d_f / METRICS_FILE)
+              if r["kind"] == "fault"]
+    kinds = [f["action"] for f in faults]
+    assert kinds == ["link_degrade", "degrade_end"], kinds
+    assert faults[0]["loss_add"] == 0.02
+    t0, t1 = faults[0]["t"], faults[1]["t"]
+
+    def retx_seen(path, lo, hi):
+        """Max live-connection retransmit count any sample in [lo, hi)
+        observed."""
+        return max((max(r["hosts"]["retx"])
+                    for r in _records(path)
+                    if r["kind"] == "sample" and lo <= r["t"] < hi),
+                   default=0)
+
+    # inside the window the loss shows up as retransmit pressure in the
+    # per-link series; the loss-free twin never shows any, and neither
+    # does the fault run before the window opens
+    assert retx_seen(d_f / METRICS_FILE, t0, t1) > 0
+    assert retx_seen(d_f / METRICS_FILE, 0, t0) == 0
+    assert retx_seen(d_n / METRICS_FILE, 0, 1 << 62) == 0
+
+    # and in the percentiles: the degraded run's p99 is strictly worse
+    p_f = res_f["telemetry"]["flows"]["tgen_fetch"]
+    p_n = res_n["telemetry"]["flows"]["tgen_fetch"]
+    assert p_f["p99_ms"] > p_n["p99_ms"], (p_f, p_n)
+
+
+# -- checkpoint/resume stream identity ------------------------------------
+
+def test_checkpoint_resume_continues_streams_bit_exactly(tmp_path):
+    """Histogram + open-flow state rides the checkpoint: a checkpointing
+    run's streams equal the plain run's, a resumed run reproduces the
+    exact post-resume suffix, and its summary percentiles match."""
+    doc = yaml.safe_load(TGEN)
+    doc["general"]["stop_time"] = "40s"
+    doc["hosts"]["client"]["quantity"] = 1
+    doc["hosts"]["client"]["processes"][0]["args"][0:2] = ["600 kB", "6"]
+    _, res_full, d_full = _run(doc, "full", tmp_path)
+    _, res_src, d_src = _run(doc, "src", tmp_path,
+                             **{"general.checkpoint_every": "10s"})
+    assert _streams(d_full) == _streams(d_src), \
+        "checkpointing must be stream-transparent"
+
+    from shadow_tpu.checkpoint import load_checkpoint
+
+    ck = sorted(glob.glob(str(d_src / "checkpoints" / "ckpt_*.ckpt")))[0]
+    hdr = json.loads(open(ck, "rb").readline())
+    cfg = parse_config(doc, {"general.data_directory":
+                             str(tmp_path / "res")})
+    ctl, at = load_checkpoint(ck, cfg, mirror_log=False)
+    res_res = ctl.run(resume_at=at)
+    assert res_res["telemetry"] == res_full["telemetry"]
+
+    def suffix(path):
+        out = []
+        for ln in path.read_text().splitlines(keepends=True):
+            rec = json.loads(ln)
+            if rec.get("kind") != "meta" and rec.get("round", 0) > hdr["rounds"]:
+                out.append(ln)
+        return "".join(out)
+
+    for name in (METRICS_FILE, FLOWS_FILE):
+        assert suffix(d_full / name) == (tmp_path / "res" / name).read_text(), \
+            f"resumed {name} is not the exact stream suffix"
+    # the test only means something if flows closed on BOTH sides of the
+    # checkpoint (histogram state carried + new records appended)
+    flow_rounds = [r["round"] for r in _records(d_full / FLOWS_FILE)]
+    assert min(flow_rounds) <= hdr["rounds"] < max(flow_rounds), flow_rounds
+
+
+def test_resume_honors_the_resume_invocations_telemetry_section(tmp_path):
+    """telemetry: is a volatile config section — a resume may disable or
+    newly enable it (the checkpoint digest excludes it)."""
+    from shadow_tpu.checkpoint import load_checkpoint
+
+    doc = yaml.safe_load(TGEN)
+    doc["general"]["stop_time"] = "40s"
+    doc["hosts"]["client"]["quantity"] = 1
+    doc["hosts"]["client"]["processes"][0]["args"][0:2] = ["600 kB", "6"]
+    _run(doc, "src", tmp_path, **{"general.checkpoint_every": "10s"})
+    ck = sorted(glob.glob(str(tmp_path / "src" / "checkpoints"
+                              / "ckpt_*.ckpt")))[0]
+
+    # resume WITHOUT the telemetry section: collection must stop
+    off_doc = json.loads(json.dumps(doc))
+    del off_doc["telemetry"]
+    cfg = parse_config(off_doc, {"general.data_directory":
+                                 str(tmp_path / "res-off")})
+    ctl, at = load_checkpoint(ck, cfg, mirror_log=False)
+    assert ctl.telemetry is None
+    res = ctl.run(resume_at=at)
+    assert "telemetry" not in res
+    assert not (tmp_path / "res-off" / METRICS_FILE).exists()
+
+    # checkpoint written WITHOUT telemetry, resumed WITH it: samplers run
+    no_tel = json.loads(json.dumps(off_doc))
+    _run(no_tel, "src2", tmp_path, **{"general.checkpoint_every": "10s"})
+    ck2 = sorted(glob.glob(str(tmp_path / "src2" / "checkpoints"
+                               / "ckpt_*.ckpt")))[0]
+    cfg2 = parse_config(doc, {"general.data_directory":
+                              str(tmp_path / "res-on")})
+    ctl2, at2 = load_checkpoint(ck2, cfg2, mirror_log=False)
+    assert ctl2.telemetry is not None
+    res2 = ctl2.run(resume_at=at2)
+    assert res2["telemetry"]["samples"] > 0
+    samples = [r for r in _records(tmp_path / "res-on" / METRICS_FILE)
+               if r.get("kind") == "sample"]
+    assert samples and all(s["t"] > at2 for s in samples)
+
+
+def test_cli_override_into_bare_telemetry_section(tmp_path):
+    """A bare `telemetry:` key in the YAML plus a --sample-every style
+    dotted override must compose, not error."""
+    doc = yaml.safe_load(TGEN)
+    doc["telemetry"] = None  # bare key
+    cfg = parse_config(doc, {"telemetry.sample_every": "3s",
+                             "general.data_directory": str(tmp_path)})
+    assert cfg.telemetry is not None
+    assert cfg.telemetry.sample_every == 3_000_000_000
+
+
+# -- off by default --------------------------------------------------------
+
+def test_telemetry_off_writes_nothing(tmp_path):
+    doc = yaml.safe_load(TGEN)
+    del doc["telemetry"]
+    ctl, res, d = _run(doc, "off", tmp_path)
+    assert ctl.telemetry is None
+    assert "telemetry" not in res
+    assert not (d / METRICS_FILE).exists()
+    assert not (d / FLOWS_FILE).exists()
+
+
+# -- histogram unit properties ---------------------------------------------
+
+def test_histogram_layout_and_percentiles():
+    # bucket_index is monotone and bucket_lower_bound is its left inverse
+    prev = -1
+    for v in list(range(0, 4096)) + [10**6, 10**9, 10**12, 2**62]:
+        idx = bucket_index(v)
+        assert idx >= prev or v < 4096
+        lb = bucket_lower_bound(idx)
+        assert lb <= v
+        assert bucket_index(lb) == idx
+        prev = idx if v < 4096 else prev
+    # relative resolution bound: lower bound within ~3.2% of the value
+    for v in (10**6, 123_456_789, 10**12):
+        lb = bucket_lower_bound(bucket_index(v))
+        assert (v - lb) / v < 0.04
+    h = LogHistogram()
+    for v in range(1, 1001):
+        h.add(v * 1000)
+    assert h.total == 1000
+    p50 = h.percentile(50, 100)
+    p99 = h.percentile(99, 100)
+    assert abs(p50 - 500_000) / 500_000 < 0.05
+    assert abs(p99 - 990_000) / 990_000 < 0.05
+    # merge = bucket-wise addition
+    h2 = LogHistogram()
+    h2.merge(h)
+    h2.merge(h)
+    assert h2.total == 2000
+    assert h2.percentile(50, 100) == p50
+
+
+# -- report tool -----------------------------------------------------------
+
+def test_metrics_report_builds(tmp_path):
+    _, _, d = _run(GOSSIP_CHURN, "rep", tmp_path)
+    import sys
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+    import metrics_report
+
+    rep = metrics_report.build_report(d / METRICS_FILE, d / FLOWS_FILE)
+    assert rep["samples"] > 0 and rep["flows"] > 0
+    assert rep["fault_transitions"] > 0 and rep["fault_windows"]
+    assert rep["flow_percentiles"] and rep["link_utilization"]
+    for row in rep["flow_percentiles"]:
+        if row["ok"]:
+            assert row["p50_ms"] <= row["p99_ms"]

@@ -87,4 +87,27 @@ print(f"fault smoke OK: {trans} transitions, {crashes} crashes/"
       f"{boots} reboots, {bh} blackholed, {rto} RTO retransmits")
 '
 
+echo "== telemetry smoke (gossip_churn: cross-policy stream hashes + report parse) =="
+telrun() {
+    python -m shadow_tpu examples/gossip_churn.yaml --quiet \
+        --data-directory "/tmp/ci-tel-$1" \
+        --scheduler-policy "$2" --sample-every 5s > /dev/null
+    sha256sum "/tmp/ci-tel-$1/metrics.jsonl" "/tmp/ci-tel-$1/flows.jsonl" \
+        | awk '{print $1}' > "/tmp/ci-tel-$1.hashes"
+}
+telrun a tpu_batch
+telrun b thread_per_core
+diff /tmp/ci-tel-a.hashes /tmp/ci-tel-b.hashes
+python tools/metrics_report.py /tmp/ci-tel-a --json | python -c '
+import json, sys
+r = json.load(sys.stdin)
+assert r["samples"] > 0, "no telemetry samples"
+assert r["flows"] > 0, "no flow records"
+assert r["fault_transitions"] > 0, "fault timeline missing from metrics"
+assert r["fault_windows"], "no fault windows folded"
+print(f"telemetry smoke OK: {r[\"samples\"]} samples, {r[\"flows\"]} flows, "
+      f"{r[\"fault_transitions\"]} fault transitions, streams bit-identical "
+      f"across tpu_batch/thread_per_core")
+'
+
 echo "== CI gate passed =="

@@ -1,0 +1,271 @@
+#!/usr/bin/env python
+"""Render a telemetry run report from metrics.jsonl + flows.jsonl.
+
+The readout half of the telemetry subsystem (shadow_tpu/telemetry/): the
+simulator writes deterministic append-only streams; this tool reduces them
+to the tables an experiment wants on one screen —
+
+- flow-latency percentiles (p50/p90/p99/p99.9) per host-group and flow
+  class, recomputed through the same fixed-layout log histogram the run
+  summary uses (shadow_tpu/telemetry/histogram.py), so the two always
+  agree;
+- per-link (NIC) utilization: egress/ingress token-bucket headroom,
+  deferred-ingress backlog, and retransmit pressure per host-group, plus
+  the most-saturated individual hosts — "which link's queue saturated in
+  round 40k" reads straight off this table;
+- the fault timeline folded into windows (down->up, degrade->restore,
+  crash->reboot), each annotated with the flow latencies observed inside
+  it vs the whole run — "what was fetch p99 during the partition window?"
+  is one row here.
+
+Usage:
+    python tools/metrics_report.py <data_dir | metrics.jsonl> [--json]
+
+``--json`` emits the machine-readable report dict instead of tables
+(tools/ci.sh uses it as a parse gate).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from shadow_tpu.telemetry.histogram import LogHistogram  # noqa: E402
+
+
+def _load(path: Path) -> list:
+    out = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if ln:
+                out.append(json.loads(ln))
+    return out
+
+
+def group_of(name: str) -> str:
+    """Host-group key: the name with its trailing instance digits
+    stripped (quantity-expanded templates: client0..clientN -> client)."""
+    g = name.rstrip("0123456789")
+    return g if g else name
+
+
+def _quants(hist: LogHistogram) -> dict:
+    return hist.quantiles_ns_to_ms() if hist.total else {}
+
+
+def flow_tables(flows: list) -> dict:
+    """(flow class, host group) -> counts + percentiles."""
+    out: dict = {}
+    for rec in flows:
+        key = (rec["flow"], group_of(rec["host"]))
+        row = out.get(key)
+        if row is None:
+            row = out[key] = {"count": 0, "ok": 0, "failed": 0,
+                              "hist": LogHistogram()}
+        row["count"] += 1
+        if rec["status"] == "ok":
+            row["ok"] += 1
+            row["hist"].add(rec["latency_ns"])
+        else:
+            row["failed"] += 1
+    return out
+
+
+def fault_windows(faults: list, t_end: int) -> list:
+    """Fold the applied-transition records into [t0, t1) windows. A
+    transition that never restores closes at the end of the run."""
+    opens: dict = {}
+    windows: list = []
+
+    def sig(rec):
+        return (tuple(rec.get("src_nodes", ())),
+                tuple(rec.get("dst_nodes", ())),
+                tuple(rec.get("hosts", ())))
+
+    pairs = {"link_down": "link_up", "link_degrade": "degrade_end",
+             "host_down": "host_up"}
+    closers = {v: k for k, v in pairs.items()}
+    for rec in faults:
+        a = rec["action"]
+        if a in pairs:
+            opens.setdefault((a, sig(rec)), []).append(rec)
+        elif a in closers:
+            stack = opens.get((closers[a], sig(rec)))
+            if stack:
+                o = stack.pop(0)
+                windows.append({"kind": closers[a], "t0": o["t"],
+                                "t1": rec["t"], "detail": o})
+    for (kind, _s), stack in opens.items():
+        for o in stack:
+            windows.append({"kind": kind, "t0": o["t"], "t1": t_end,
+                            "detail": o})
+    windows.sort(key=lambda w: (w["t0"], w["t1"], w["kind"]))
+    return windows
+
+
+def annotate_windows(windows: list, flows: list) -> None:
+    """Per window: latency percentiles of flows that CLOSED inside it."""
+    for w in windows:
+        hist = LogHistogram()
+        n = failed = 0
+        for rec in flows:
+            if w["t0"] <= rec["t_close"] < w["t1"]:
+                n += 1
+                if rec["status"] == "ok":
+                    hist.add(rec["latency_ns"])
+                else:
+                    failed += 1
+        w["flows_closed"] = n
+        w["flows_failed"] = failed
+        w.update({f"flow_{k}": v for k, v in _quants(hist).items()})
+
+
+def link_utilization(meta: dict, samples: list, flows: list) -> list:
+    """Per host-group NIC summary: mean egress/ingress token headroom
+    over all samples (fraction of capacity — 0 means a saturated/starved
+    bucket), peak deferred-ingress backlog, and retransmit totals summed
+    from the flow records (the samples' retx column counts LIVE
+    connections only, so closed flows' retransmits would vanish from a
+    last-sample read). Flow retx is the recording endpoint's sender
+    side — download-shaped flows' server retransmits show up in the
+    per-sample retx series, not here."""
+    names = meta["hosts"]
+    cap_up = meta["cap_up"]
+    cap_down = meta["cap_down"]
+    acc: dict = {}
+    for i, name in enumerate(names):
+        g = group_of(name)
+        row = acc.get(g)
+        if row is None:
+            row = acc[g] = {"hosts": 0, "up_sum": 0.0, "down_sum": 0.0,
+                            "n": 0, "deferred_max": 0, "retx": 0,
+                            "down_host_samples": 0, "worst_up": None}
+        row["hosts"] += 1
+    for s in samples:
+        g_up = s["global"]["bucket_up"]
+        g_down = s["global"]["tokens_down"]
+        h = s["hosts"]
+        for i, name in enumerate(names):
+            row = acc[group_of(name)]
+            up_frac = g_up[i] / cap_up[i] if cap_up[i] else 1.0
+            row["up_sum"] += up_frac
+            row["down_sum"] += (g_down[i] / cap_down[i]
+                                if cap_down[i] else 1.0)
+            row["n"] += 1
+            if h["deferred"][i] > row["deferred_max"]:
+                row["deferred_max"] = h["deferred"][i]
+            row["down_host_samples"] += h["down"][i]
+            w = row["worst_up"]
+            if w is None or up_frac < w[1]:
+                row["worst_up"] = (name, up_frac)
+    for rec in flows:
+        g = acc.get(group_of(rec["host"]))
+        if g is not None:
+            g["retx"] += rec.get("retx", 0)
+    out = []
+    for g in sorted(acc):
+        row = acc[g]
+        n = row["n"] or 1
+        out.append({
+            "group": g, "hosts": row["hosts"],
+            "egress_headroom_mean": round(row["up_sum"] / n, 3),
+            "ingress_headroom_mean": round(row["down_sum"] / n, 3),
+            "deferred_max": row["deferred_max"],
+            "retx_total": row["retx"],
+            "down_host_samples": row["down_host_samples"],
+            "most_saturated_host": (row["worst_up"][0]
+                                    if row["worst_up"] else None),
+        })
+    return out
+
+
+def build_report(metrics_path: Path, flows_path: Path) -> dict:
+    recs = _load(metrics_path)
+    flows = _load(flows_path) if flows_path.exists() else []
+    meta = next((r for r in recs if r["kind"] == "meta"), None)
+    samples = [r for r in recs if r["kind"] == "sample"]
+    faults = [r for r in recs if r["kind"] == "fault"]
+    t_end = samples[-1]["t"] if samples else (
+        max((f["t_close"] for f in flows), default=0))
+    windows = fault_windows(faults, t_end)
+    annotate_windows(windows, flows)
+    ftab = flow_tables(flows)
+    report = {
+        "samples": len(samples),
+        "flows": len(flows),
+        "fault_transitions": len(faults),
+        "flow_percentiles": [
+            {"flow": k[0], "group": k[1], "count": v["count"],
+             "ok": v["ok"], "failed": v["failed"], **_quants(v["hist"])}
+            for k, v in sorted(ftab.items())],
+        "fault_windows": [
+            {k: v for k, v in w.items() if k != "detail"}
+            for w in windows],
+        "link_utilization": (link_utilization(meta, samples, flows)
+                             if meta and samples else []),
+    }
+    return report
+
+
+def _fmt_table(rows: list, cols: list) -> str:
+    if not rows:
+        return "  (none)"
+    widths = [max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+              for c in cols]
+    lines = ["  " + "  ".join(c.ljust(w) for c, w in zip(cols, widths))]
+    for r in rows:
+        lines.append("  " + "  ".join(
+            str(r.get(c, "")).ljust(w) for c, w in zip(cols, widths)))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("path", help="run data directory (or metrics.jsonl)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable report dict")
+    args = ap.parse_args(argv)
+    p = Path(args.path)
+    if p.is_dir():
+        metrics, flows = p / "metrics.jsonl", p / "flows.jsonl"
+    else:
+        metrics, flows = p, p.parent / "flows.jsonl"
+    if not metrics.exists():
+        print(f"metrics_report: {metrics} not found (run with a "
+              f"telemetry: section or --sample-every)", file=sys.stderr)
+        return 2
+    report = build_report(metrics, flows)
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+        return 0
+    print(f"telemetry report: {report['samples']} samples, "
+          f"{report['flows']} flows, "
+          f"{report['fault_transitions']} fault transitions\n")
+    print("flow latency percentiles (ms) per host-group:")
+    print(_fmt_table(report["flow_percentiles"],
+                     ["flow", "group", "count", "ok", "failed", "p50_ms",
+                      "p90_ms", "p99_ms", "p99_9_ms"]))
+    print("\nper-link (NIC) utilization per host-group "
+          "(headroom 1.0 = idle bucket, 0.0 = saturated):")
+    print(_fmt_table(report["link_utilization"],
+                     ["group", "hosts", "egress_headroom_mean",
+                      "ingress_headroom_mean", "deferred_max",
+                      "retx_total", "down_host_samples",
+                      "most_saturated_host"]))
+    print("\nfault windows (flow latencies inside each window):")
+    wrows = [{**w, "t0_s": round(w["t0"] / 1e9, 3),
+              "t1_s": round(w["t1"] / 1e9, 3)}
+             for w in report["fault_windows"]]
+    print(_fmt_table(wrows,
+                     ["kind", "t0_s", "t1_s", "flows_closed",
+                      "flows_failed", "flow_p50_ms", "flow_p99_ms"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
