@@ -75,7 +75,6 @@ static inline int64_t nsnow(void) {
 #define INF_I64 (((int64_t)1) << 61)
 #define T_NEVER_C (((int64_t)1) << 62)
 #define KIND_DGRAM 6
-#define KIND_LOSS_C 16
 #define TX_SIZE 400
 /* stream unit kinds (network/unit.py order) */
 #define TK_SYN 0
@@ -228,7 +227,7 @@ static PyObject *S_id, *S_now, *S_inbox, *S_egress_rows, *S_uid_counter,
     *S_n_events, *S_dispatch;
 
 /* cached small objects */
-static PyObject *O_zero, *O_one, *O_false, *O_kind_dgram, *O_kind_loss;
+static PyObject *O_zero, *O_one, *O_false, *O_kind_dgram;
 
 /* read an int64 attribute (Python int) */
 static int attr_i64(PyObject *o, PyObject *name, int64_t *out) {
@@ -291,19 +290,30 @@ typedef struct {
   int64_t size, t_emit, nbytes, seq;
   PyObject *payload; /* owned; NULL = None */
   int32_t kind, dst, sport, dport, frag, nfrags;
-  uint8_t want_loss;
 } ERow;
 
 typedef struct {
   PyObject *host;      /* borrowed: Core->hosts list holds the ref */
   PyObject *id_obj;    /* owned: the host's stable `id` int object */
+  PyObject *equeue;    /* owned: host.equeue (C timer push/cancel) */
   PyObject *heap;      /* owned: equeue._heap list */
   PyObject *live;      /* owned: equeue._live set */
   PyObject *cancelled; /* owned: equeue._cancelled set */
+  /* cached heap root for the per-round due check: an OWNED ref to the
+   * last-seen heap[0] plus its time. Owning the ref makes pointer
+   * identity sound (the object cannot be freed and its address reused
+   * while cached); if heap[0] is a different object, re-read. A root
+   * that was cancelled in place keeps its time — a conservative lower
+   * bound on the live head, which only costs a wasted scan, never a
+   * missed event. */
+  PyObject *head_cache;
+  int64_t head_time;
   int py_mode;         /* pcap etc.: dispatch through Python run_events */
   PyObject *egress;    /* owned: host.egress_rows (identity-stable) */
   PyObject *conns;     /* owned: host._conns dict (identity-stable) */
   PyObject *listeners; /* owned: host._listeners dict (identity-stable) */
+  PyObject *ack_eps;   /* owned: host._ack_eps dict (identity-stable:
+                          cleared in place by the barrier, never rebound) */
   /* C-registered datagram ports (gossip); tiny linear table */
   int nports;
   int port[4];
@@ -340,7 +350,6 @@ typedef struct {
   int64_t unit_chunk; /* fluid quantum payload bytes (Host.unit_chunk) */
   int64_t sock_sbuf, sock_rbuf; /* experimental.socket_*_buffer */
   int mesh_mode; /* hand live batches to Python for the mesh collective */
-  int oracle_loss; /* experimental.stream_loss_recovery == "oracle" */
   CHost *hs;
   /* scratch buffers reused across barriers */
   struct BRow *brow;
@@ -357,6 +366,20 @@ typedef struct {
   int64_t spec_hits, spec_draws; /* drained by Core_spec_stats */
   int32_t *spec_dq; /* demand queue: host ids awaiting a window */
   int spec_dq_n, spec_dq_cap;
+  /* cached sorted snapshot of the active set (run_round's iteration
+   * order). Valid while its length matches the set: discards happen
+   * ONLY inside run_round (which updates both), so between rounds the
+   * set can only GROW — a size match proves the contents are identical
+   * and the per-round snapshot + qsort can be skipped entirely. */
+  int64_t *act_ids;
+  int64_t act_n;
+  int64_t act_cap;
+  /* ids added since the last refresh (extract's touched hosts and the
+   * Python-side activate hook both land here): when the set size equals
+   * act_n + pend_n, the refresh is a tiny sorted-merge instead of a
+   * full iterate + qsort of the whole set */
+  int64_t *act_pend;
+  int64_t act_pend_n, act_pend_cap;
 } CoreObject;
 
 /* per-host speculative window + npkts class tracker. Two generations:
@@ -402,7 +425,6 @@ typedef struct BRow {
   uint32_t th;
   int32_t npk;
   int32_t kind, sport, dport, frag, nfrags;
-  uint8_t want_loss;
   uint8_t drop;
 } BRow;
 
@@ -546,6 +568,28 @@ static PyObject *heap_pop(PyObject *heap) {
   return ret;
 }
 
+/* heapq.heappush twin: append + sift-up with heap_lt. Steals the entry
+ * ref. Identical resulting layout to heapq._siftdown (both shift each
+ * passed parent down one level along the path and place the new entry at
+ * its final slot). */
+static int heap_push(PyObject *heap, PyObject *entry) {
+  if (PyList_Append(heap, entry) < 0) { Py_DECREF(entry); return -1; }
+  Py_DECREF(entry); /* the list holds it now */
+  Py_ssize_t pos = PyList_GET_SIZE(heap) - 1;
+  while (pos > 0) {
+    Py_ssize_t parent = (pos - 1) >> 1;
+    PyObject *pe = PyList_GET_ITEM(heap, parent);
+    PyObject *ce = PyList_GET_ITEM(heap, pos);
+    if (!heap_lt(ce, pe)) break;
+    Py_INCREF(pe);
+    Py_INCREF(ce);
+    PyList_SetItem(heap, parent, ce); /* steals */
+    PyList_SetItem(heap, pos, pe);    /* steals */
+    pos = parent;
+  }
+  return 0;
+}
+
 /* EventQueue._drop_cancelled_head twin. Returns borrowed head or NULL
  * (empty); -1 via *err on failure. */
 static PyObject *heap_head(CHost *h, int *err) {
@@ -586,7 +630,7 @@ static int core_emit_dgram(CoreObject *c, CHost *h, int64_t now, int dst,
 static int core_emit_fields(CoreObject *c, CHost *h, int64_t now,
                             int kind, int dst, int64_t size, int64_t nbytes,
                             PyObject *payload, int64_t seq, int sport,
-                            int dport, int frag, int nfrags, int want_loss) {
+                            int dport, int frag, int nfrags) {
   if (h->erow_n == 0 && PyList_GET_SIZE(h->egress) == 0) {
     PyObject *em = PyObject_GetAttr(c->plane, S_emitters);
     if (!em) return -1;
@@ -612,7 +656,6 @@ static int core_emit_fields(CoreObject *c, CHost *h, int64_t now,
   e->seq = seq;
   e->frag = frag;
   e->nfrags = nfrags;
-  e->want_loss = (uint8_t)(want_loss != 0);
   if (payload == Py_None) payload = NULL;
   Py_XINCREF(payload);
   e->payload = payload;
@@ -624,8 +667,8 @@ static int core_emit_dgram_inner(CoreObject *c, CHost *h, int64_t now,
                            int dst, GossipState *g, int dst_port,
                            int64_t nbytes, PyObject *payload) {
   if (core_emit_fields(c, h, now, KIND_DGRAM, dst, nbytes + HEADER, nbytes,
-                       payload, g->next_dgram++, g->port, dst_port, 0, 1,
-                       0) < 0)
+                       payload, g->next_dgram++, g->port, dst_port, 0,
+                       1) < 0)
     return -1;
   h->d_dgrams++;
   return 0;
@@ -635,12 +678,11 @@ static int core_emit_dgram_inner(CoreObject *c, CHost *h, int64_t now,
  * shape; used by materialize_egress and the device/mesh hand-off) */
 static PyObject *erow_tuple(const ERow *e) {
   PyObject *pl = e->payload ? e->payload : Py_None;
-  PyObject *t = Py_BuildValue("(iiLLiiLLiiOO)", (int)e->kind, (int)e->dst,
+  PyObject *t = Py_BuildValue("(iiLLiiLLiiO)", (int)e->kind, (int)e->dst,
                               (long long)e->size, (long long)e->t_emit,
                               (int)e->sport, (int)e->dport,
                               (long long)e->nbytes, (long long)e->seq,
-                              (int)e->frag, (int)e->nfrags,
-                              e->want_loss ? Py_True : Py_False, pl);
+                              (int)e->frag, (int)e->nfrags, pl);
   return t;
 }
 
@@ -674,19 +716,18 @@ static PyObject *Core_materialize_egress(CoreObject *c, PyObject *noarg) {
  * C engine is attached; pcap capture stays on the Python side) */
 static PyObject *Core_emit_row(CoreObject *c, PyObject *args) {
   long long hid, size, t_emit, nbytes, seq;
-  int kind, dst, sport, dport, frag, nfrags, want_loss;
+  int kind, dst, sport, dport, frag, nfrags;
   PyObject *payload;
-  if (!PyArg_ParseTuple(args, "LiiLLiiLLiipO", &hid, &kind, &dst, &size,
+  if (!PyArg_ParseTuple(args, "LiiLLiiLLiiO", &hid, &kind, &dst, &size,
                         &t_emit, &sport, &dport, &nbytes, &seq, &frag,
-                        &nfrags, &want_loss, &payload))
+                        &nfrags, &payload))
     return NULL;
   if (hid < 0 || hid >= c->H || dst < 0 || dst >= c->H) {
     PyErr_SetString(PyExc_ValueError, "host id out of range");
     return NULL;
   }
   if (core_emit_fields(c, &c->hs[hid], t_emit, kind, dst, size, nbytes,
-                       payload, seq, sport, dport, frag, nfrags,
-                       want_loss) < 0)
+                       payload, seq, sport, dport, frag, nfrags) < 0)
     return NULL;
   Py_RETURN_NONE;
 }
@@ -765,7 +806,7 @@ static int dispatch_stream(CoreObject *c, CHost *h, int hid, IRow *ir,
 static int dispatch_c(CoreObject *c, CHost *h, int hid, IRow *ir,
                       int64_t *now, int *now_dirty) {
   int64_t t = ir->t;
-  if (ir->kind <= TK_FINACK || ir->kind == KIND_LOSS_C)
+  if (ir->kind <= TK_FINACK)
     return dispatch_stream(c, h, hid, ir, now, now_dirty);
   GossipState *g = NULL;
   if (ir->kind == KIND_DGRAM && ir->nfrags == 1) {
@@ -847,19 +888,44 @@ static int64_t run_host_inner(CoreObject *c, CHost *h, int hid, int64_t end) {
     pos++; n++;
   }
   if (PyList_GET_SIZE(h->heap)) {
+    /* the inbox<->heap merge with a CACHED root: an owned ref to the
+     * last-validated heap[0] plus its (t, band, key). While the root
+     * object is unchanged, its triple is a lower bound on the live head
+     * (a later cancel only moves the live head LATER), so a row that
+     * beats the cached triple may dispatch without touching the
+     * cancelled set; anything else re-validates through heap_head.
+     * This turns the per-row cost of the hot merge from a set lookup +
+     * four tuple reads into one pointer compare + int compares. */
+    PyObject *h0own = NULL; /* owned: validated head at cache time */
+    int64_t h0t = 0, h0band = 0, h0key = 0;
+    int rcod2 = -1;
     for (;;) {
+      if (h0own && pos < ln && PyList_GET_SIZE(h->heap) &&
+          PyList_GET_ITEM(h->heap, 0) == h0own) {
+        int64_t ti = rows[pos].t;
+        if (ti < h0t ||
+            (ti == h0t &&
+             (0 < h0band || (0 == h0band && rows[pos].key < h0key)))) {
+          if (dispatch_c(c, h, hid, &rows[pos], &now, &now_dirty) < 0)
+            goto mdone;
+          pos++; n++;
+          continue;
+        }
+      }
       int herr;
       PyObject *h0 = heap_head(h, &herr);
-      if (herr) goto done;
+      if (herr) goto mdone;
       int hv = 0;
-      int64_t h0t = 0, h0band = 0, h0key = 0;
+      h0t = 0; h0band = 0; h0key = 0;
       if (h0) {
+        Py_INCREF(h0);
+        Py_XSETREF(h0own, h0);
         h0t = tup_i64(h0, 0);
-        if (h0t < end) {
-          hv = 1;
-          h0band = tup_i64(h0, 1);
-          h0key = tup_i64(h0, 2);
-        }
+        h0band = tup_i64(h0, 1);
+        h0key = tup_i64(h0, 2);
+        hv = h0t < end;
+      } else {
+        Py_CLEAR(h0own);
       }
       if (pos < ln) {
         int64_t ti = rows[pos].t;
@@ -869,32 +935,36 @@ static int64_t run_host_inner(CoreObject *c, CHost *h, int hid, int64_t end) {
             (ti == h0t &&
              (0 < h0band || (0 == h0band && rows[pos].key < h0key)))) {
           if (dispatch_c(c, h, hid, &rows[pos], &now, &now_dirty) < 0)
-            goto done;
+            goto mdone;
           pos++; n++;
           continue;
         }
       }
       if (hv) {
         PyObject *ev = heap_pop(h->heap);
-        if (!ev) goto done;
+        if (!ev) goto mdone;
         PyObject *seq = PyTuple_GET_ITEM(ev, 3);
-        if (PySet_Discard(h->live, seq) < 0) { Py_DECREF(ev); goto done; }
+        if (PySet_Discard(h->live, seq) < 0) { Py_DECREF(ev); goto mdone; }
         now = tup_i64(ev, 0);
         now_dirty = 0;
-        if (attr_set_i64(h->host, S_now, now) < 0) { Py_DECREF(ev); goto done; }
+        if (attr_set_i64(h->host, S_now, now) < 0) { Py_DECREF(ev); goto mdone; }
         PyObject *res = PyObject_CallNoArgs(PyTuple_GET_ITEM(ev, 4));
         Py_DECREF(ev);
-        if (!res) goto done;
+        if (!res) goto mdone;
         Py_DECREF(res);
-        if (attr_i64(h->host, S_now, &now) < 0) goto done;
+        if (attr_i64(h->host, S_now, &now) < 0) goto mdone;
         n++;
         continue;
       }
       break;
     }
+    rcod2 = 0;
+  mdone:
+    Py_XDECREF(h0own);
+    if (rcod2 < 0) goto done;
   }
   err = 0;
-done:
+done:;
   TM0(10);
   /* release the consumed prefix AND any unconsumed tail (error paths) */
   for (int i = 0; i < h->inbox_n; i++) Py_XDECREF(h->inbox[i].payload);
@@ -916,80 +986,107 @@ static int cmp_i64(const void *a, const void *b) {
   return (x > y) - (x < y);
 }
 
-static PyObject *Core_run_round(CoreObject *c, PyObject *args) {
-  long long end_ll;
-  if (!PyArg_ParseTuple(args, "L", &end_ll)) return NULL;
-  int64_t end = end_ll;
+/* record a newly-activated host id for the next refresh's merge */
+static int act_pend_add(CoreObject *c, int64_t hid) {
+  if (c->act_pend_n == c->act_pend_cap) {
+    int64_t ncap = c->act_pend_cap ? c->act_pend_cap * 2 : 64;
+    int64_t *nb = realloc(c->act_pend, sizeof(int64_t) * (size_t)ncap);
+    if (!nb) { PyErr_NoMemory(); return -1; }
+    c->act_pend = nb;
+    c->act_pend_cap = ncap;
+  }
+  c->act_pend[c->act_pend_n++] = hid;
+  return 0;
+}
+
+/* re-snapshot the active set when membership changed outside run_round.
+ * When every addition was recorded in act_pend (extract + the activate
+ * hook), the refresh is a merge of the small sorted pend batch into the
+ * sorted snapshot; a residual size mismatch (additions that bypassed
+ * the hook) falls back to the full iterate + qsort. */
+static int act_refresh(CoreObject *c) {
+  Py_ssize_t na = PySet_GET_SIZE(c->active);
+  if ((int64_t)na == c->act_n) {
+    c->act_pend_n = 0; /* pend entries were already merged or stale */
+    return 0;
+  }
+  if (c->act_n >= 0 && (int64_t)na == c->act_n + c->act_pend_n) {
+    int64_t pn = c->act_pend_n, an = c->act_n;
+    if (an + pn > c->act_cap) {
+      int64_t ncap = c->act_cap ? c->act_cap : 256;
+      while (ncap < an + pn) ncap *= 2;
+      int64_t *nb = realloc(c->act_ids, sizeof(int64_t) * (size_t)ncap);
+      if (!nb) { PyErr_NoMemory(); return -1; }
+      c->act_ids = nb;
+      c->act_cap = ncap;
+    }
+    qsort(c->act_pend, (size_t)pn, sizeof(int64_t), cmp_i64);
+    /* backward two-way merge into act_ids */
+    int64_t i = an - 1, j = pn - 1, w = an + pn - 1;
+    while (j >= 0) {
+      if (i >= 0 && c->act_ids[i] > c->act_pend[j])
+        c->act_ids[w--] = c->act_ids[i--];
+      else
+        c->act_ids[w--] = c->act_pend[j--];
+    }
+    c->act_n = an + pn;
+    c->act_pend_n = 0;
+    return 0;
+  }
+  c->act_pend_n = 0;
+  if (na > c->act_cap) {
+    int64_t ncap = c->act_cap ? c->act_cap : 256;
+    while (ncap < na) ncap *= 2;
+    int64_t *nb = realloc(c->act_ids, sizeof(int64_t) * (size_t)ncap);
+    if (!nb) { PyErr_NoMemory(); return -1; }
+    c->act_ids = nb;
+    c->act_cap = ncap;
+  }
+  Py_ssize_t k2 = 0;
+  PyObject *it = PyObject_GetIter(c->active);
+  if (!it) return -1;
+  PyObject *item;
+  while ((item = PyIter_Next(it))) {
+    if (k2 < na) c->act_ids[k2++] = PyLong_AsLongLong(item);
+    Py_DECREF(item);
+  }
+  Py_DECREF(it);
+  if (PyErr_Occurred()) return -1;
+  qsort(c->act_ids, (size_t)k2, sizeof(int64_t), cmp_i64);
+  c->act_n = k2;
+  return 0;
+}
+
+/* min pending event time over the active hosts — the skip-ahead path's
+ * `min(equeue.next_time() for active)` without a Python genexpr. Drops
+ * cancelled heads exactly like EventQueue.next_time, so the returned
+ * instant (and hence the round grid) is identical to the Python path. */
+static PyObject *Core_next_time(CoreObject *c, PyObject *noarg) {
+  (void)noarg;
   if (!c->active) {
     PyErr_SetString(PyExc_RuntimeError, "bind_active() not called");
     return NULL;
   }
-  /* snapshot + sort the active host ids (host-id execution order) */
-  TM0(6);
-  Py_ssize_t na = PySet_GET_SIZE(c->active);
-  int64_t *ids = malloc(sizeof(int64_t) * (size_t)(na ? na : 1));
-  if (!ids) return PyErr_NoMemory();
-  Py_ssize_t k = 0;
-  PyObject *it = PyObject_GetIter(c->active);
-  if (!it) { free(ids); return NULL; }
-  PyObject *item;
-  while ((item = PyIter_Next(it))) {
-    if (k < na) ids[k++] = PyLong_AsLongLong(item);
-    Py_DECREF(item);
-  }
-  Py_DECREF(it);
-  if (PyErr_Occurred()) { free(ids); return NULL; }
-  qsort(ids, (size_t)k, sizeof(int64_t), cmp_i64);
-  TM1(6);
-  tm_cnt[7] += k;
-  int64_t executed = 0;
-  for (Py_ssize_t i = 0; i < k; i++) {
-    int64_t hid = ids[i];
+  if (act_refresh(c) < 0) return NULL;
+  int64_t best = T_NEVER_C;
+  for (int64_t i = 0; i < c->act_n; i++) {
+    int64_t hid = c->act_ids[i];
     if (hid < 0 || hid >= c->H) continue;
-    CHost *h = &c->hs[hid];
-    int has_inbox = h->py_mode ? 0 : (h->inbox_n > 0);
-    Py_ssize_t hn = PyList_GET_SIZE(h->heap);
-    int heap_due = 0;
-    if (hn) {
-      PyObject *head = PyList_GET_ITEM(h->heap, 0);
-      heap_due = tup_i64(head, 0) < end; /* conservative (cancelled ok) */
-    }
-    if (h->py_mode) {
-      /* pcap hosts etc.: the Python run_events consumes _inbox lists */
-      PyObject *ib = PyObject_GetAttr(h->host, S_inbox);
-      int has_py_inbox = ib && ib != Py_None;
-      Py_XDECREF(ib);
-      if (!has_py_inbox && !heap_due) {
-        if (!hn && PySet_Discard(c->active, h->id_obj) < 0) goto fail;
-        continue;
-      }
-      PyObject *r = PyObject_CallMethodObjArgs(
-          h->host, S_run_events, PyTuple_GET_ITEM(args, 0), NULL);
-      if (!r) goto fail;
-      executed += PyLong_AsLongLong(r);
-      Py_DECREF(r);
-      if (PyErr_Occurred()) goto fail;
-    } else if (has_inbox || heap_due) {
-      int64_t n = run_host_c(c, h, (int)hid, end);
-      if (n < 0) goto fail;
-      executed += n;
-    }
-    if (PyList_GET_SIZE(h->heap) == 0) {
-      if (PySet_Discard(c->active, h->id_obj) < 0) goto fail;
+    int err;
+    PyObject *head = heap_head(&c->hs[hid], &err);
+    if (err) return NULL;
+    if (head) {
+      int64_t t = tup_i64(head, 0);
+      if (t < best) best = t;
     }
   }
-  free(ids);
-  return PyLong_FromLongLong(executed);
-fail:
-  free(ids);
-  return NULL;
+  return PyLong_FromLongLong(best);
 }
 
 /* ---- store construction (colplane._store_resolved twin) ---------------- */
 typedef struct {
   int64_t t, key;
   int32_t idx;   /* index into the BRow array */
-  uint8_t loss;  /* 1 = loss-notify row */
 } ORow;
 
 static int cmp_orow(const void *a, const void *b) {
@@ -1011,22 +1108,12 @@ static int store_build(CoreObject *c, BRow *rows, int n, int have_flags,
     BRow *b = &rows[i];
     if (have_flags && b->drop) {
       dropped++;
-      /* want_loss: loss-notify row back to the sender at arrival +
-       * return-path latency (fluid fast-retransmit) */
-      if (b->want_loss) {
-        int32_t sn = c->hostnode[b->src];
-        int32_t dn = c->hostnode[b->dst];
-        int64_t t = b->arrival + c->lat[(int64_t)dn * c->G + sn];
-        if (t < round_end) t = round_end;
-        out[m].t = t; out[m].key = b->key; out[m].idx = i; out[m].loss = 1;
-        m++;
-      }
     } else {
       sent++;
       nbytes_total += b->size;
       int64_t t = b->arrival;
       if (t < round_end) t = round_end;
-      out[m].t = t; out[m].key = b->key; out[m].idx = i; out[m].loss = 0;
+      out[m].t = t; out[m].key = b->key; out[m].idx = i;
       m++;
     }
   }
@@ -1042,14 +1129,14 @@ static int store_build(CoreObject *c, BRow *rows, int n, int have_flags,
       SRec *rc2 = &cb->recs[i];
       rc2->t = out[i].t;
       rc2->key = out[i].key;
-      rc2->tgt = out[i].loss ? b->src : b->dst;
+      rc2->tgt = b->dst;
       rc2->size = (int32_t)b->size;
-      rc2->peer = out[i].loss ? b->dst : b->src;
+      rc2->peer = b->src;
       rc2->bport = b->dport;
       rc2->aport = b->sport;
       rc2->nbytes = b->nbytes;
       rc2->seq = b->seq;
-      rc2->kind = out[i].loss ? KIND_LOSS_C : (int16_t)b->kind;
+      rc2->kind = (int16_t)b->kind;
       rc2->frag = b->frag;
       rc2->nfrags = b->nfrags;
       Py_XINCREF(b->payload);
@@ -1103,10 +1190,7 @@ static PyObject *Core_store_resolved(CoreObject *c, PyObject *args) {
     b->seq = tup_i64(er, 7);
     b->frag = (int32_t)tup_i64(er, 8);
     b->nfrags = (int32_t)tup_i64(er, 9);
-    int wl = PyObject_IsTrue(PyTuple_GET_ITEM(er, 10));
-    if (wl < 0) { free(br); return NULL; }
-    b->want_loss = (uint8_t)wl;
-    PyObject *pl = PyTuple_GET_ITEM(er, 11);
+    PyObject *pl = PyTuple_GET_ITEM(er, 10);
     b->payload = pl == Py_None ? NULL : pl;
     b->arrival = PyLong_AsLongLong(PyList_GET_ITEM(arrival_l, i));
     b->key = PyLong_AsLongLong(PyList_GET_ITEM(keys_l, i));
@@ -1502,7 +1586,6 @@ static PyObject *Core_barrier(CoreObject *c, PyObject *args) {
       b->dport = er->dport;
       b->frag = er->frag;
       b->nfrags = er->nfrags;
-      b->want_loss = er->want_loss;
       b->uid = base + (uint64_t)i;
       b->drop = 0;
     }
@@ -1617,11 +1700,10 @@ static PyObject *Core_barrier(CoreObject *c, PyObject *args) {
           /* egress-format tuple for the Python device/mesh machinery
            * (amortized by the batch's >= device_floor size) */
           PyObject *row_t = Py_BuildValue(
-              "(iiLLiiLLiiOO)", (int)b->kind, (int)b->dst,
+              "(iiLLiiLLiiO)", (int)b->kind, (int)b->dst,
               (long long)b->size, (long long)b->t_emit, (int)b->sport,
               (int)b->dport, (long long)b->nbytes, (long long)b->seq,
               (int)b->frag, (int)b->nfrags,
-              b->want_loss ? Py_True : Py_False,
               b->payload ? b->payload : Py_None);
           if (!row_t) { fail = 1; break; }
           PyList_SET_ITEM(rows_l, i, row_t);
@@ -1861,7 +1943,7 @@ static PyObject *Core_extract(CoreObject *c, PyObject *args) {
     CHost *h = &c->hs[touched[i]];
     if (multi && h->inbox_n > 1 && h->inbox_multi)
       qsort(h->inbox, (size_t)h->inbox_n, sizeof(IRow), cmp_irow);
-    if (h->py_mode) {
+    if (h->py_mode) { /* (see below for the active-set add) */
       /* pcap hosts: hand a plain Python list of 13-tuples to
        * Host.run_events (materialized here; py_mode hosts are rare) */
       PyObject *lst = PyList_New(h->inbox_n);
@@ -1878,7 +1960,15 @@ static PyObject *Core_extract(CoreObject *c, PyObject *args) {
       Py_DECREF(lst);
       if (r < 0) goto fail;
     }
-    if (PySet_Add(c->active, h->id_obj) < 0) goto fail;
+    /* activate, recording genuinely-new members for the merge refresh */
+    {
+      int has = PySet_Contains(c->active, h->id_obj);
+      if (has < 0) goto fail;
+      if (!has) {
+        if (PySet_Add(c->active, h->id_obj) < 0) goto fail;
+        if (act_pend_add(c, touched[i]) < 0) goto fail;
+      }
+    }
   }
   free(touched);
   Py_RETURN_NONE;
@@ -1992,12 +2082,15 @@ static int Core_traverse(CoreObject *c, visitproc visit, void *arg) {
     for (int64_t i = 0; i < c->H; i++) {
       CHost *h = &c->hs[i];
       Py_VISIT(h->id_obj);
+      Py_VISIT(h->equeue);
       Py_VISIT(h->heap);
       Py_VISIT(h->live);
       Py_VISIT(h->cancelled);
+      Py_VISIT(h->head_cache);
       Py_VISIT(h->egress);
       Py_VISIT(h->conns);
       Py_VISIT(h->listeners);
+      Py_VISIT(h->ack_eps);
       for (int j = 0; j < h->nports; j++) Py_VISIT(h->gs[j]);
       /* inbox payloads / egress payloads are bytes|None (no cycles) */
     }
@@ -2016,12 +2109,15 @@ static int Core_clear_gc(CoreObject *c) {
     for (int64_t i = 0; i < c->H; i++) {
       CHost *h = &c->hs[i];
       Py_CLEAR(h->id_obj);
+      Py_CLEAR(h->equeue);
       Py_CLEAR(h->heap);
       Py_CLEAR(h->live);
       Py_CLEAR(h->cancelled);
+      Py_CLEAR(h->head_cache);
       Py_CLEAR(h->egress);
       Py_CLEAR(h->conns);
       Py_CLEAR(h->listeners);
+      Py_CLEAR(h->ack_eps);
       for (int j = 0; j < h->nports; j++) Py_CLEAR(h->gs[j]);
       h->nports = 0;
       for (int j = 0; j < h->inbox_n; j++) Py_CLEAR(h->inbox[j].payload);
@@ -2039,12 +2135,15 @@ static void Core_dealloc(CoreObject *c) {
     for (int64_t i = 0; i < c->H; i++) {
       CHost *h = &c->hs[i];
       Py_XDECREF(h->id_obj);
+      Py_XDECREF(h->equeue);
       Py_XDECREF(h->heap);
       Py_XDECREF(h->live);
       Py_XDECREF(h->cancelled);
+      Py_XDECREF(h->head_cache);
       Py_XDECREF(h->egress);
       Py_XDECREF(h->conns);
       Py_XDECREF(h->listeners);
+      Py_XDECREF(h->ack_eps);
       for (int j = 0; j < h->inbox_n; j++) Py_XDECREF(h->inbox[j].payload);
       free(h->inbox);
       for (int j = 0; j < h->erow_n; j++) Py_XDECREF(h->erow[j].payload);
@@ -2054,6 +2153,8 @@ static void Core_dealloc(CoreObject *c) {
     free(c->hs);
   }
   free(c->brow);
+  free(c->act_ids);
+  free(c->act_pend);
   if (c->spec) {
     for (int64_t i = 0; i < c->H; i++) {
       free(c->spec[i].min_a);
@@ -2143,11 +2244,6 @@ static int Core_init(CoreObject *c, PyObject *args, PyObject *kwds) {
   if (!mp) return -1;
   c->mesh_mode = mp != Py_None;
   Py_DECREF(mp);
-  PyObject *ol = PyObject_GetAttrString(plane, "oracle_loss");
-  if (!ol) return -1;
-  c->oracle_loss = PyObject_IsTrue(ol);
-  Py_DECREF(ol);
-  if (c->oracle_loss < 0) return -1;
   c->unit_chunk = 0; /* filled from hosts[0] below (config-uniform) */
   PyObject *mod = PyImport_ImportModule("shadow_tpu.network.colplane");
   if (!mod) return -1;
@@ -2168,10 +2264,10 @@ static int Core_init(CoreObject *c, PyObject *args, PyObject *kwds) {
     }
     PyObject *eq = PyObject_GetAttrString(host, "equeue");
     if (!eq) return -1;
+    h->equeue = eq; /* owned */
     h->heap = PyObject_GetAttrString(eq, "_heap");
     h->live = PyObject_GetAttrString(eq, "_live");
     h->cancelled = PyObject_GetAttrString(eq, "_cancelled");
-    Py_DECREF(eq);
     if (!h->heap || !h->live || !h->cancelled) return -1;
     PyObject *pcap = PyObject_GetAttr(host, S_pcap);
     if (!pcap) return -1;
@@ -2185,7 +2281,12 @@ static int Core_init(CoreObject *c, PyObject *args, PyObject *kwds) {
     }
     h->conns = PyObject_GetAttrString(host, "_conns");
     h->listeners = PyObject_GetAttrString(host, "_listeners");
-    if (!h->conns || !h->listeners) return -1;
+    h->ack_eps = PyObject_GetAttrString(host, "_ack_eps");
+    if (!h->conns || !h->listeners || !h->ack_eps) return -1;
+    if (!PyDict_Check(h->ack_eps)) {
+      PyErr_SetString(PyExc_TypeError, "host._ack_eps must be a dict");
+      return -1;
+    }
     if (i == 0) {
       int64_t uc;
       if (attr_i64(host, PyUnicode_InternFromString("unit_chunk"), &uc) < 0)
@@ -2216,6 +2317,27 @@ static PyObject *Core_bind_active(CoreObject *c, PyObject *arg) {
   }
   Py_INCREF(arg);
   Py_XSETREF(c->active, arg);
+  c->act_n = -1; /* invalidate the sorted snapshot cache */
+  c->act_pend_n = 0;
+  Py_RETURN_NONE;
+}
+
+/* the activation hook (controller wires equeue.on_first and
+ * plane.activate here when the C engine is attached): set-add + pend
+ * record, so the next refresh merges instead of re-snapshotting */
+static PyObject *Core_activate(CoreObject *c, PyObject *arg) {
+  if (!c->active) {
+    PyErr_SetString(PyExc_RuntimeError, "bind_active() not called");
+    return NULL;
+  }
+  int has = PySet_Contains(c->active, arg);
+  if (has < 0) return NULL;
+  if (!has) {
+    int64_t hid = PyLong_AsLongLong(arg);
+    if (hid == -1 && PyErr_Occurred()) return NULL;
+    if (PySet_Add(c->active, arg) < 0) return NULL;
+    if (act_pend_add(c, hid) < 0) return NULL;
+  }
   Py_RETURN_NONE;
 }
 
@@ -2302,6 +2424,8 @@ static PyObject *Core_fold_counters(CoreObject *c, PyObject *noarg) {
 }
 
 static PyObject *Core_make_endpoint(CoreObject *c, PyObject *args);
+static PyObject *Core_flush_acks(CoreObject *c, PyObject *arg);
+static PyObject *Core_run_round(CoreObject *c, PyObject *args);
 static PyObject *Core_relay_new(CoreObject *c, PyObject *args);
 static PyObject *Core_tor_client_sink(CoreObject *c, PyObject *args);
 
@@ -2312,11 +2436,17 @@ static PyMethodDef Core_methods[] = {
      "_extract twin: (round_end)"},
     {"refill_ingress", (PyCFunction)Core_refill_ingress, METH_VARARGS,
      "clamped ingress token refill for an elapsed window: (dt_ns)"},
+    {"next_time", (PyCFunction)Core_next_time, METH_NOARGS,
+     "min pending event time over the active hosts (skip-ahead)"},
+    {"activate", (PyCFunction)Core_activate, METH_O,
+     "(host_id) -> None  add a host to the active set (merge-tracked)"},
+    {"flush_acks", (PyCFunction)Core_flush_acks, METH_O,
+     "(ack_hosts) -> None  flush each host's coalesced barrier acks"},
     {"run_round", (PyCFunction)Core_run_round, METH_VARARGS,
      "per-round host loop over the bound active set: (round_end) -> n"},
     {"emit_row", (PyCFunction)Core_emit_row, METH_VARARGS,
      "packed emission (Host.emit_msg delegate): (hid, kind, dst, size, "
-     "t_emit, sport, dport, nbytes, seq, frag, nfrags, want_loss, payload)"},
+     "t_emit, sport, dport, nbytes, seq, frag, nfrags, payload)"},
     {"materialize_egress", (PyCFunction)Core_materialize_egress,
      METH_NOARGS,
      "flush packed C egress into host.egress_rows tuples (Python-barrier "
@@ -2486,6 +2616,7 @@ static CHost *cep_h(CEp *e) { return &e->core->hs[e->hid]; }
 struct CTorSink;
 static int tsink_feed(struct CTorSink *s, int64_t nbytes,
                       PyObject *payload);
+static int tsink_pump(struct CTorSink *s, int64_t now);
 struct CExitStream;
 static int exit_feed(struct CExitStream *s, int64_t now, int64_t nbytes);
 
@@ -2499,7 +2630,7 @@ static int64_t cep_now(CEp *e, int *err) {
 }
 
 static PyObject *S_schedule_in, *S_cancel_m, *S_rto_fire, *S_syn_fire,
-    *S_fin_fire, *S_drop_fire;
+    *S_fin_fire, *S_drop_fire, *S_seq_ctr, *S_on_first;
 
 static int64_t cep_window(CEp *e, int *err) {
   *err = 0;
@@ -2517,57 +2648,86 @@ static int64_t cep_window(CEp *e, int *err) {
 
 static int cep_emit(CEp *e, int64_t now, int kind, int64_t nbytes,
                     PyObject *payload, int64_t seq, int64_t acked,
-                    int64_t wnd, int want_loss) {
+                    int64_t wnd) {
   return core_emit_fields(
       e->core, cep_h(e), now, kind, e->remote_host, nbytes + HEADER,
       kind == TK_DATA ? nbytes : acked, payload,
-      kind == TK_DATA ? seq : wnd, e->local_port, e->remote_port, 0, 1,
-      want_loss);
+      kind == TK_DATA ? seq : wnd, e->local_port, e->remote_port, 0, 1);
 }
 
-/* receiver._ack: round-barrier coalesced ack (Host.mark_ack twin) */
+/* receiver._ack: round-barrier coalesced ack (Host.mark_ack twin) over
+ * the cached identity-stable _ack_eps dict */
 static int cep_mark_ack(CEp *e) {
   CHost *h = cep_h(e);
-  PyObject *aeps = PyObject_GetAttrString(h->host, "_ack_eps");
-  if (!aeps) return -1;
-  int rc = -1;
+  PyObject *aeps = h->ack_eps;
   if (PyDict_GET_SIZE(aeps) == 0) {
     PyObject *al = PyObject_GetAttrString(e->core->plane, "ack_hosts");
-    if (!al) goto out;
+    if (!al) return -1;
     int r = PyList_Append(al, h->host);
     Py_DECREF(al);
-    if (r < 0) goto out;
+    if (r < 0) return -1;
   }
-  if (PyDict_SetItem(aeps, (PyObject *)e, Py_None) < 0) goto out;
-  rc = 0;
-out:
-  Py_DECREF(aeps);
-  return rc;
+  return PyDict_SetItem(aeps, (PyObject *)e, Py_None);
 }
 
-/* timers ride the host's Python event queue so seq/order match the twin */
+/* timers ride the host's Python event queue so seq/order match the twin
+ * — but the push itself runs here (EventQueue.push twin over the cached
+ * heap/_live/_seq structures): at tor_100k scale the per-unit RTO
+ * arm/cancel churn through two Python method calls was a first-order
+ * cost of the host loop. The shared _seq counter keeps C and Python
+ * pushes on one deterministic sequence. */
 static int cep_schedule(CEp *e, int64_t delay, PyObject *meth_name,
                         PyObject **slot) {
+  CHost *h = cep_h(e);
+  int64_t now;
+  if (attr_i64(h->host, S_now, &now) < 0) return -1;
+  int64_t seq;
+  if (attr_i64(h->equeue, S_seq_ctr, &seq) < 0) return -1;
   PyObject *task = PyObject_GetAttr((PyObject *)e, meth_name);
   if (!task) return -1;
-  PyObject *d = PyLong_FromLongLong(delay);
-  if (!d) { Py_DECREF(task); return -1; }
-  PyObject *h = PyObject_CallMethodObjArgs(cep_h(e)->host, S_schedule_in,
-                                           d, task, NULL);
-  Py_DECREF(d);
+  PyObject *seq_obj = PyLong_FromLongLong(seq);
+  /* (time, band=BAND_APP, key=seq, seq, task) — schedule_in's default
+   * band/key exactly (key < 0 resolves to seq) */
+  PyObject *entry = seq_obj
+      ? Py_BuildValue("(LiOOO)", (long long)(now + delay), 1, seq_obj,
+                      seq_obj, task)
+      : NULL;
   Py_DECREF(task);
-  if (!h) return -1;
-  Py_XSETREF(*slot, h);
+  if (!entry) { Py_XDECREF(seq_obj); return -1; }
+  int was_empty = PyList_GET_SIZE(h->heap) == 0;
+  if (heap_push(h->heap, entry) < 0 ||
+      PySet_Add(h->live, seq_obj) < 0 ||
+      attr_set_i64(h->equeue, S_seq_ctr, seq + 1) < 0) {
+    Py_DECREF(seq_obj);
+    return -1;
+  }
+  if (was_empty) {
+    PyObject *of = PyObject_GetAttr(h->equeue, S_on_first);
+    if (!of) { Py_DECREF(seq_obj); return -1; }
+    if (of != Py_None) {
+      PyObject *r = PyObject_CallNoArgs(of);
+      Py_DECREF(of);
+      if (!r) { Py_DECREF(seq_obj); return -1; }
+      Py_DECREF(r);
+    } else {
+      Py_DECREF(of);
+    }
+  }
+  Py_XSETREF(*slot, seq_obj); /* the handle is the seq int, like push() */
   return 0;
 }
 
 static int cep_cancel_timer(CEp *e, PyObject **slot) {
   if (!*slot) return 0;
-  PyObject *r = PyObject_CallMethodObjArgs(cep_h(e)->host, S_cancel_m,
-                                           *slot, NULL);
+  CHost *h = cep_h(e);
+  /* EventQueue.cancel twin: lazy-cancel iff still live */
+  int live = PySet_Contains(h->live, *slot);
+  if (live < 0) { Py_CLEAR(*slot); return -1; }
+  if (live && PySet_Add(h->cancelled, *slot) < 0) {
+    Py_CLEAR(*slot);
+    return -1;
+  }
   Py_CLEAR(*slot);
-  if (!r) return -1;
-  Py_DECREF(r);
   return 0;
 }
 
@@ -2592,10 +2752,8 @@ static int cs_arm_rto(CEp *e, int reset) {
 
 static int cs_emit_data(CEp *e, int64_t now, int64_t seq, int64_t nbytes,
                         PyObject *payload) {
-  /* want_loss only in oracle mode (experimental.stream_loss_recovery);
-   * dupack mode recovers from duplicate acks like the Python twin */
-  return cep_emit(e, now, TK_DATA, nbytes, payload, seq, 0, 0,
-                  e->core->oracle_loss);
+  /* recovery comes entirely from duplicate acks, like the Python twin */
+  return cep_emit(e, now, TK_DATA, nbytes, payload, seq, 0, 0);
 }
 
 static int cs_pump(CEp *e, int64_t now) {
@@ -2646,7 +2804,7 @@ static int cs_pump(CEp *e, int64_t now) {
   return 0;
 }
 
-/* the shared loss response (oracle notification OR 3rd dup ack):
+/* the fast-retransmit response (3rd consecutive duplicate ack):
  * multiplicative decrease + retransmit + RTO reset
  * (StreamSender._loss_response twin) */
 static int cs_loss_response(CEp *e, int64_t now, int64_t seq,
@@ -2657,14 +2815,6 @@ static int cs_loss_response(CEp *e, int64_t now, int64_t seq,
   e->cwnd = e->cwnd / 2 > MIN_CWND_C ? e->cwnd / 2 : MIN_CWND_C;
   if (cs_emit_data(e, now, seq, nbytes, payload) < 0) return -1;
   return cs_arm_rto(e, 1);
-}
-
-static int cs_oracle_loss(CEp *e, int64_t now, int64_t seq, int64_t nbytes,
-                          PyObject *payload) {
-  if (seq + nbytes <= e->snd_una || e->state == ST_CLOSED ||
-      e->state == ST_TIME_WAIT)
-    return 0;
-  return cs_loss_response(e, now, seq, nbytes, payload);
 }
 
 static int cs_on_rto(CEp *e, int64_t now) {
@@ -2712,6 +2862,10 @@ static int cs_on_ack(CEp *e, int64_t now, int64_t cum_ack, int64_t wnd) {
     }
     if (e->sink && e->buffered < e->send_buffer) {
       if (relay_drain(e->sink, now) < 0) return -1;
+    } else if (e->tsink && e->buffered < e->send_buffer) {
+      /* the tor-client control plane's pending-write queue (the
+       * _WriteConn on_drain pump twin) */
+      if (tsink_pump(e->tsink, now) < 0) return -1;
     } else if (e->tgen_mode == 1 && e->buffered < e->send_buffer) {
       /* TGenServer on_drain twin (push is a no-op with no backlog,
        * exactly like the Python closure called with room) */
@@ -2725,7 +2879,7 @@ static int cs_on_ack(CEp *e, int64_t now, int64_t cum_ack, int64_t wnd) {
       if (!r) return -1;
       Py_DECREF(r);
     }
-  } else if (!e->core->oracle_loss && cum_ack == e->snd_una &&
+  } else if (cum_ack == e->snd_una &&
              wnd == prev_wnd && e->snd_nxt - e->snd_una > 0 &&
              e->rtx.count) {
     /* duplicate ack (same cum, same window, data outstanding): 3rd
@@ -2808,25 +2962,19 @@ static int tgen_srv_data(CEp *e, int64_t now, PyObject *payload) {
 /* out-of-order / duplicate / out-of-window data: real TCP acks
  * IMMEDIATELY (RFC 5681 §4.2 — dup acks drive the sender's
  * fast-retransmit counter). Supersedes any coalesced ack queued this
- * round (a same-cum barrier ack would inflate the dup count). Oracle
- * mode keeps coalescing — the StreamReceiver._dup_ack twin. */
+ * round (a same-cum barrier ack would inflate the dup count) — the
+ * StreamReceiver._dup_ack twin. */
 static int cep_dup_ack(CEp *e, int64_t now) {
-  if (e->core->oracle_loss) return cep_mark_ack(e);
   if (e->state == ST_CLOSED || e->state == ST_TIME_WAIT) return 0;
   CHost *h = cep_h(e);
-  PyObject *aeps = PyObject_GetAttrString(h->host, "_ack_eps");
-  if (!aeps) return -1;
+  PyObject *aeps = h->ack_eps;
   int had = PyDict_Contains(aeps, (PyObject *)e);
-  if (had < 0) { Py_DECREF(aeps); return -1; }
-  if (had && PyDict_DelItem(aeps, (PyObject *)e) < 0) {
-    Py_DECREF(aeps);
-    return -1;
-  }
-  Py_DECREF(aeps);
+  if (had < 0) return -1;
+  if (had && PyDict_DelItem(aeps, (PyObject *)e) < 0) return -1;
   /* re-advertise last_wnd (NOT the recomputed window): buffering the
    * OOO segment shrinks window() every time, which would defeat the
    * sender's same-window dup test — see StreamReceiver._dup_ack */
-  return cep_emit(e, now, TK_ACK, 0, NULL, 0, e->rcv_nxt, e->last_wnd, 0);
+  return cep_emit(e, now, TK_ACK, 0, NULL, 0, e->rcv_nxt, e->last_wnd);
 }
 
 /* ---- receiver (StreamReceiver twin) ------------------------------------ */
@@ -2988,7 +3136,7 @@ static int ce_enter_time_wait(CEp *e, int64_t now) {
 static int ce_send_fin(CEp *e, int64_t now) {
   e->fin_tries++;
   if (e->fin_tries > FIN_RETRIES_C) return ce_drop(e); /* orphan timeout */
-  if (cep_emit(e, now, TK_FIN, 0, NULL, 0, 0, 0, 0) < 0) return -1;
+  if (cep_emit(e, now, TK_FIN, 0, NULL, 0, 0, 0) < 0) return -1;
   int64_t mult = 1LL << (e->fin_tries - 1);
   if (mult > 64) mult = 64;
   return cep_schedule(e, e->rto_ns * mult, S_fin_fire, &e->ctl_timer);
@@ -2997,7 +3145,7 @@ static int ce_send_fin(CEp *e, int64_t now) {
 static int ce_sender_drained(CEp *e, int64_t now) {
   if (e->peer_fin &&
       (e->state == ST_ESTABLISHED || e->state == ST_CLOSING)) {
-    if (cep_emit(e, now, TK_FINACK, 0, NULL, 0, 0, 0, 0) < 0) return -1;
+    if (cep_emit(e, now, TK_FINACK, 0, NULL, 0, 0, 0) < 0) return -1;
     return ce_enter_time_wait(e, now);
   }
   if (e->state == ST_CLOSING) {
@@ -3014,7 +3162,7 @@ static int ce_send_syn(CEp *e, int64_t now) {
   int err;
   int64_t w = cep_window(e, &err);
   if (err) return -1;
-  if (cep_emit(e, now, TK_SYN, 0, NULL, 0, 0, w, 0) < 0) return -1;
+  if (cep_emit(e, now, TK_SYN, 0, NULL, 0, 0, w) < 0) return -1;
   int64_t mult = 1LL << (e->syn_tries - 1);
   if (mult > 64) mult = 64;
   return cep_schedule(e, e->rto_ns * mult, S_syn_fire, &e->ctl_timer);
@@ -3029,7 +3177,7 @@ static int ce_handle_fields(CEp *e, int64_t now, int k, int64_t nbytes,
       e->adv_wnd = seq;
       int64_t w = cep_window(e, &err);
       if (err) return -1;
-      return cep_emit(e, now, TK_SYNACK, 0, NULL, 0, 0, w, 0);
+      return cep_emit(e, now, TK_SYNACK, 0, NULL, 0, 0, w);
     }
     return 0;
   }
@@ -3061,7 +3209,7 @@ static int ce_handle_fields(CEp *e, int64_t now, int k, int64_t nbytes,
   }
   if (k == TK_FIN) {
     if (e->state == ST_SYN_SENT) {
-      if (cep_emit(e, now, TK_FINACK, 0, NULL, 0, 0, 0, 0) < 0) return -1;
+      if (cep_emit(e, now, TK_FINACK, 0, NULL, 0, 0, 0) < 0) return -1;
       return ce_reset(e, "connection closed by peer");
     }
     if ((e->state == ST_ESTABLISHED || e->state == ST_CLOSING) &&
@@ -3069,7 +3217,7 @@ static int ce_handle_fields(CEp *e, int64_t now, int k, int64_t nbytes,
       e->peer_fin = 1; /* half-close: FINACK when drained */
       return 0;
     }
-    if (cep_emit(e, now, TK_FINACK, 0, NULL, 0, 0, 0, 0) < 0) return -1;
+    if (cep_emit(e, now, TK_FINACK, 0, NULL, 0, 0, 0) < 0) return -1;
     if (e->state != ST_CLOSED) return ce_enter_time_wait(e, now);
     return 0;
   }
@@ -3232,7 +3380,7 @@ static PyObject *CEp_flush_ack(CEp *e, PyObject *noarg) {
   if (err) return NULL;
   int64_t now = cep_now(e, &err);
   if (err) return NULL;
-  if (cep_emit(e, now, TK_ACK, 0, NULL, 0, e->rcv_nxt, e->last_wnd, 0) < 0)
+  if (cep_emit(e, now, TK_ACK, 0, NULL, 0, e->rcv_nxt, e->last_wnd) < 0)
     return NULL;
   Py_RETURN_NONE;
 }
@@ -3260,35 +3408,19 @@ static PyObject *CEp_handle_fields(CEp *e, PyObject *args) {
   Py_RETURN_NONE;
 }
 
-static PyObject *CEp_on_loss_notify(CEp *e, PyObject *args) {
-  long long seq, nbytes;
-  PyObject *payload;
-  if (!PyArg_ParseTuple(args, "LLO", &seq, &nbytes, &payload)) return NULL;
-  int err;
-  int64_t now = cep_now(e, &err);
-  if (err) return NULL;
-  if (cs_oracle_loss(e, now, seq, nbytes,
-                     payload == Py_None ? NULL : payload) < 0)
-    return NULL;
-  Py_RETURN_NONE;
-}
-
 static PyObject *CEp_emit(CEp *e, PyObject *args, PyObject *kw) {
   static char *kws[] = {"kind", "nbytes", "payload", "seq", "acked", "wnd",
-                        "want_loss", NULL};
+                        NULL};
   long long kind, nbytes = 0, seq = 0, acked = 0, wnd = 0;
-  int want_loss = 0;
   PyObject *payload = Py_None;
-  if (!PyArg_ParseTupleAndKeywords(args, kw, "L|LOLLLp", kws, &kind,
-                                   &nbytes, &payload, &seq, &acked, &wnd,
-                                   &want_loss))
+  if (!PyArg_ParseTupleAndKeywords(args, kw, "L|LOLLL", kws, &kind,
+                                   &nbytes, &payload, &seq, &acked, &wnd))
     return NULL;
   int err;
   int64_t now = cep_now(e, &err);
   if (err) return NULL;
   if (cep_emit(e, now, (int)kind, nbytes,
-               payload == Py_None ? NULL : payload, seq, acked, wnd,
-               want_loss) < 0)
+               payload == Py_None ? NULL : payload, seq, acked, wnd) < 0)
     return NULL;
   Py_RETURN_NONE;
 }
@@ -3502,7 +3634,6 @@ static PyMethodDef CEp_methods[] = {
     {"flush_ack", (PyCFunction)CEp_flush_ack, METH_NOARGS, NULL},
     {"on_app_read", (PyCFunction)CEp_on_app_read, METH_NOARGS, NULL},
     {"handle_fields", (PyCFunction)CEp_handle_fields, METH_VARARGS, NULL},
-    {"on_loss_notify", (PyCFunction)CEp_on_loss_notify, METH_VARARGS, NULL},
     {"emit", (PyCFunction)CEp_emit, METH_VARARGS | METH_KEYWORDS, NULL},
     {"tgen_serve", (PyCFunction)CEp_tgen_serve, METH_O,
      "(on_request) -> None  enable the C TGenServer data path"},
@@ -3578,35 +3709,155 @@ static PyObject *Core_make_endpoint(CoreObject *c, PyObject *args) {
                              (int)rport, initiator, sbuf, rbuf);
 }
 
+/* the barrier's coalesced-ack flush loop (colplane._barrier_round twin):
+ * `arg` is the id-sorted ack_hosts list; each host's _ack_eps snapshot
+ * flushes one cumulative ACK per open endpoint and the dict clears IN
+ * PLACE (identity-stable — cep_mark_ack caches it). */
+static PyObject *Core_flush_acks(CoreObject *c, PyObject *arg) {
+  if (!PyList_Check(arg)) {
+    PyErr_SetString(PyExc_TypeError, "flush_acks expects a list of hosts");
+    return NULL;
+  }
+  for (Py_ssize_t i = 0; i < PyList_GET_SIZE(arg); i++) {
+    PyObject *host = PyList_GET_ITEM(arg, i);
+    int64_t hid;
+    if (attr_i64(host, S_id, &hid) < 0) return NULL;
+    if (hid < 0 || hid >= c->H) {
+      PyErr_SetString(PyExc_ValueError, "host id out of range");
+      return NULL;
+    }
+    CHost *h = &c->hs[hid];
+    if (PyDict_GET_SIZE(h->ack_eps) == 0) continue;
+    PyObject *keys = PyDict_Keys(h->ack_eps); /* insertion-order snapshot */
+    if (!keys) return NULL;
+    PyDict_Clear(h->ack_eps);
+    int64_t now = 0;
+    int have_now = 0;
+    for (Py_ssize_t j = 0; j < PyList_GET_SIZE(keys); j++) {
+      PyObject *ep = PyList_GET_ITEM(keys, j);
+      if (Py_TYPE(ep) == &CEp_Type) {
+        CEp *e = (CEp *)ep;
+        if (e->state == ST_CLOSED) continue;
+        int err;
+        e->last_wnd = cep_window(e, &err);
+        if (err) { Py_DECREF(keys); return NULL; }
+        if (!have_now) { /* one clock read per host: flushes never move it */
+          now = cep_now(e, &err);
+          if (err) { Py_DECREF(keys); return NULL; }
+          have_now = 1;
+        }
+        if (cep_emit(e, now, TK_ACK, 0, NULL, 0, e->rcv_nxt,
+                     e->last_wnd) < 0) {
+          Py_DECREF(keys);
+          return NULL;
+        }
+      } else {
+        /* pcap-host Python endpoint: the twin's attribute path */
+        PyObject *st = PyObject_GetAttrString(ep, "state");
+        if (!st) { Py_DECREF(keys); return NULL; }
+        long sv = PyLong_AsLong(st);
+        Py_DECREF(st);
+        if (sv == -1 && PyErr_Occurred()) { Py_DECREF(keys); return NULL; }
+        if (sv == 0) continue; /* CLOSED */
+        PyObject *recv = PyObject_GetAttrString(ep, "receiver");
+        PyObject *r = recv
+            ? PyObject_CallMethod(recv, "flush_ack", NULL) : NULL;
+        Py_XDECREF(recv);
+        if (!r) { Py_DECREF(keys); return NULL; }
+        Py_DECREF(r);
+      }
+    }
+    Py_DECREF(keys);
+  }
+  Py_RETURN_NONE;
+}
+
+static PyObject *Core_run_round(CoreObject *c, PyObject *args) {
+  long long end_ll;
+  if (!PyArg_ParseTuple(args, "L", &end_ll)) return NULL;
+  int64_t end = end_ll;
+  if (!c->active) {
+    PyErr_SetString(PyExc_RuntimeError, "bind_active() not called");
+    return NULL;
+  }
+  /* sorted active host ids (host-id execution order). The snapshot is
+   * CACHED across rounds: membership only shrinks inside this function
+   * (which updates the cache in place) and only grows elsewhere, so a
+   * set-size match proves the cache is exact and the per-round
+   * iterate + qsort — the dominant cost at 10k mostly-parked hosts —
+   * is skipped. */
+  TM0(6);
+  if (act_refresh(c) < 0) return NULL;
+  TM1(6);
+  tm_cnt[7] += c->act_n;
+  int64_t executed = 0;
+  int64_t *ids = c->act_ids;
+  int64_t k = c->act_n;
+  int64_t w = 0; /* write index: survivors stay, discards compact away */
+  int64_t i = 0;
+  for (; i < k; i++) {
+    int64_t hid = ids[i];
+    if (hid < 0 || hid >= c->H) continue;
+    CHost *h = &c->hs[hid];
+    int has_inbox = h->py_mode ? 0 : (h->inbox_n > 0);
+    Py_ssize_t hn = PyList_GET_SIZE(h->heap);
+    int heap_due = 0;
+    if (hn) {
+      /* owned-root cache: same object at heap[0] => same (conservative)
+       * head time; most parked hosts cost three pointer reads here */
+      PyObject *head = PyList_GET_ITEM(h->heap, 0);
+      if (head != h->head_cache) {
+        Py_INCREF(head);
+        Py_XSETREF(h->head_cache, head);
+        h->head_time = tup_i64(head, 0);
+      }
+      heap_due = h->head_time < end; /* conservative (cancelled ok) */
+    }
+    if (h->py_mode) {
+      /* pcap hosts etc.: the Python run_events consumes _inbox lists */
+      PyObject *ib = PyObject_GetAttr(h->host, S_inbox);
+      int has_py_inbox = ib && ib != Py_None;
+      Py_XDECREF(ib);
+      if (!has_py_inbox && !heap_due) {
+        if (!hn) {
+          if (PySet_Discard(c->active, h->id_obj) < 0) goto fail;
+          continue; /* compacted out of the snapshot */
+        }
+        ids[w++] = hid;
+        continue;
+      }
+      PyObject *r = PyObject_CallMethodObjArgs(
+          h->host, S_run_events, PyTuple_GET_ITEM(args, 0), NULL);
+      if (!r) goto fail;
+      executed += PyLong_AsLongLong(r);
+      Py_DECREF(r);
+      if (PyErr_Occurred()) goto fail;
+    } else if (has_inbox || heap_due) {
+      int64_t n = run_host_c(c, h, (int)hid, end);
+      if (n < 0) goto fail;
+      executed += n;
+    }
+    if (PyList_GET_SIZE(h->heap) == 0) {
+      if (PySet_Discard(c->active, h->id_obj) < 0) goto fail;
+    } else {
+      ids[w++] = hid;
+    }
+  }
+  c->act_n = w;
+  return PyLong_FromLongLong(executed);
+fail:
+  /* keep the untouched tail so the cache still mirrors the set */
+  for (; i < k; i++) ids[w++] = ids[i];
+  c->act_n = w;
+  return NULL;
+}
+
+
 /* ---- stream row dispatch (Host.dispatch_row / _deliver_row twin) ------- */
 static int dispatch_stream(CoreObject *c, CHost *h, int hid, IRow *ir,
                            int64_t *now, int *now_dirty) {
   int k = ir->kind;
   PyObject *pl = ir->payload;
-  if (k == KIND_LOSS_C) {
-    /* loss-notify (no ingress charge): route back by four-tuple.
-     * The clock attr syncs BEFORE the endpoint logic runs: transport
-     * code schedules timers through host.schedule_in (now + delay). */
-    if (ir->t > *now) { *now = ir->t; *now_dirty = 1; }
-    if (*now_dirty) {
-      if (attr_set_i64(h->host, S_now, *now) < 0) return -1;
-      *now_dirty = 0;
-    }
-    PyObject *key = Py_BuildValue("(iii)", ir->aport, ir->peer, ir->bport);
-    if (!key) return -1;
-    PyObject *ep = PyDict_GetItem(h->conns, key);
-    Py_DECREF(key);
-    if (!ep) return 0; /* connection gone: no-op */
-    if (Py_TYPE(ep) == &CEp_Type)
-      return cs_oracle_loss((CEp *)ep, *now, ir->seq, ir->nbytes, pl);
-    PyObject *r = PyObject_CallMethod(ep, "on_loss_notify", "(LLO)",
-                                      (long long)ir->seq,
-                                      (long long)ir->nbytes,
-                                      pl ? pl : Py_None);
-    if (!r) return -1;
-    Py_DECREF(r);
-    return 0;
-  }
   /* data-plane row: clock + ingress charge, then deliver. The clock
    * attr syncs up front — endpoint handlers arm timers via
    * host.schedule_in, which reads host._now. */
@@ -3661,7 +3912,7 @@ static int dispatch_stream(CoreObject *c, CHost *h, int hid, IRow *ir,
     int err;
     int64_t w = cep_window(ne, &err);
     if (err) { Py_DECREF(ne); return -1; }
-    if (cep_emit(ne, *now, TK_SYNACK, 0, NULL, 0, 0, w, 0) < 0) {
+    if (cep_emit(ne, *now, TK_SYNACK, 0, NULL, 0, 0, w) < 0) {
       Py_DECREF(ne);
       return -1;
     }
@@ -4391,23 +4642,33 @@ static PyObject *Core_relay_new(CoreObject *c, PyObject *args) {
 }
 
 /* ======================================================================
- * C tor-client sink (models/tor.py TorClient data path).
+ * C tor-client sink (models/tor.py TorClient data path + control plane).
  *
  * The client's steady state is receiving a stream of DATA cells +
- * counted bodies through its guard connection; the Python model only
- * needs to see CONTROL cells (CREATED/EXTENDED during telescoping,
- * CONNECTED, END at completion) — a handful per circuit. This sink
- * owns the frame parsing and body-byte counting in C and calls
- * on_cell(ctype, circ, payload, bytes_received) for control cells
- * only. At tor_100k scale (100,000 clients) this removes the per-chunk
- * Python FrameReader cost the same way the relay data path did for
- * relays. Exits (TorExit) keep the full Python model (declared gap).
+ * counted bodies through its guard connection; this sink owns the frame
+ * parsing and body-byte counting in C. Since the circuit-build control
+ * plane moved native, it ALSO runs the telescoping state machine: the
+ * model hands it the three pre-built advance frames (EXTEND hop2,
+ * EXTEND hop3, BEGIN) at creation, and each CREATED/EXTENDED cell
+ * advances the stage and writes the next frame through a C pending
+ * queue (the bounded-send discipline of the Python twin's _Conn pump).
+ * Python sees exactly TWO events per circuit — on_cell fires for the
+ * stage-3 EXTENDED (telescoping done; the model records build time) and
+ * for END (fetch complete) — instead of every control cell plus every
+ * advance write. At tor_100k scale (100,000 clients) this removes the
+ * remaining per-circuit Python control-cell handling the same way the
+ * relay data path did for relays. Without frames (None) the sink is the
+ * pure data path: on_cell fires for every control cell and the model
+ * keeps writing through its own conn.
  * ====================================================================== */
 
 typedef struct CTorSink {
   PyObject_HEAD
   CEp *ep;            /* owned; ep->tsink is the borrowed back-pointer */
   PyObject *on_cell;  /* owned: callable(ctype, circ, payload, got) */
+  PyObject *frames;   /* owned tuple of 3 advance frames, or NULL */
+  int stage;          /* CREATED/EXTENDED cells consumed (twin: stage) */
+  Ring pend;          /* PendEnt write queue (_WriteConn pending twin) */
   char *buf;
   int64_t buf_len, buf_cap;
   int64_t body_left;
@@ -4416,6 +4677,33 @@ typedef struct CTorSink {
 } CTorSink;
 
 static PyTypeObject CTorSink_Type;
+
+/* the _Conn._pump twin over the C pending ring: offer each frame to the
+ * bounded send buffer; a short write parks and resumes on drain */
+static int tsink_pump(CTorSink *s, int64_t now) {
+  while (s->pend.count) {
+    PendEnt *head = ring_at(&s->pend, 0);
+    int64_t sent = cs_send(s->ep, now, 0, head->payload, head->a);
+    if (sent < 0) return -1;
+    head->a += sent;
+    int done = head->a >= PyBytes_GET_SIZE(head->payload);
+    if (done) {
+      Py_XDECREF(head->payload);
+      ring_popleft(&s->pend);
+    }
+    if (sent == 0 && !done) return 0; /* buffer full; drain resumes */
+  }
+  return 0;
+}
+
+/* queue one frame (steals the ref) and pump */
+static int tsink_write(CTorSink *s, int64_t now, PyObject *frame) {
+  PendEnt *p = ring_push(&s->pend);
+  if (!p) { Py_DECREF(frame); return -1; }
+  p->payload = frame;
+  p->a = 0;
+  return tsink_pump(s, now);
+}
 
 static int tsink_feed(CTorSink *s, int64_t nbytes, PyObject *payload) {
   if (s->body_left > 0 && (!payload || payload == Py_None)) {
@@ -4461,6 +4749,36 @@ static int tsink_feed(CTorSink *s, int64_t nbytes, PyObject *payload) {
       break; /* counted body follows in subsequent chunks */
     }
     if (s->buf_len - off < TCELL_HDR + ln) break;
+    if (s->frames) {
+      /* C control plane (TorClient.on_ctrl + advance twin) */
+      if (ctype == TC_CREATED || ctype == TC_EXTENDED) {
+        s->stage++;
+        if (s->stage == 3) {
+          /* telescoping done: the ONE mid-build Python event (the model
+           * records circuit-build time), then BEGIN goes out below */
+          PyObject *pl = PyBytes_FromStringAndSize(
+              s->buf + off + TCELL_HDR, (Py_ssize_t)ln);
+          if (!pl) { rcod = -1; break; }
+          PyObject *r = PyObject_CallFunction(s->on_cell, "iiNL", ctype,
+                                              circ, pl, (long long)s->got);
+          if (!r) { rcod = -1; break; }
+          Py_DECREF(r);
+        }
+        int idx = s->stage > 3 ? 2 : s->stage - 1;
+        PyObject *f = PyTuple_GET_ITEM(s->frames, idx);
+        Py_INCREF(f);
+        int err;
+        int64_t now = cep_now(s->ep, &err);
+        if (err) { Py_DECREF(f); rcod = -1; break; }
+        if (tsink_write(s, now, f) < 0) { rcod = -1; break; }
+        off += TCELL_HDR + ln;
+        continue;
+      }
+      if (ctype != TC_END) { /* CONNECTED etc.: the twin ignores them */
+        off += TCELL_HDR + ln;
+        continue;
+      }
+    }
     PyObject *pl = PyBytes_FromStringAndSize(s->buf + off + TCELL_HDR,
                                              (Py_ssize_t)ln);
     if (!pl) { rcod = -1; break; }
@@ -4481,13 +4799,23 @@ static int tsink_feed(CTorSink *s, int64_t nbytes, PyObject *payload) {
 static int CTorSink_traverse(CTorSink *s, visitproc visit, void *arg) {
   Py_VISIT(s->ep);
   Py_VISIT(s->on_cell);
+  Py_VISIT(s->frames);
   return 0;
+}
+
+static void tsink_clear_pend(CTorSink *s) {
+  while (s->pend.count) {
+    Py_XDECREF(((PendEnt *)ring_at(&s->pend, 0))->payload);
+    ring_popleft(&s->pend);
+  }
 }
 
 static int CTorSink_clear_gc(CTorSink *s) {
   if (s->ep && s->ep->tsink == s) s->ep->tsink = NULL;
   Py_CLEAR(s->ep);
   Py_CLEAR(s->on_cell);
+  Py_CLEAR(s->frames);
+  tsink_clear_pend(s);
   return 0;
 }
 
@@ -4496,6 +4824,9 @@ static void CTorSink_dealloc(CTorSink *s) {
   if (s->ep && s->ep->tsink == s) s->ep->tsink = NULL;
   Py_XDECREF(s->ep);
   Py_XDECREF(s->on_cell);
+  Py_XDECREF(s->frames);
+  tsink_clear_pend(s);
+  free(s->pend.buf);
   free(s->buf);
   Py_TYPE(s)->tp_free((PyObject *)s);
 }
@@ -4505,9 +4836,30 @@ static PyObject *CTorSink_bytes_received(CTorSink *s, PyObject *noarg) {
   return PyLong_FromLongLong(s->got);
 }
 
+static PyObject *CTorSink_write(CTorSink *s, PyObject *arg) {
+  /* model-side writes (the initial CREATE cell) ride the same pending
+   * queue as the C state machine's advance frames */
+  if (!PyBytes_Check(arg)) {
+    PyErr_SetString(PyExc_TypeError, "TorSink.write expects bytes");
+    return NULL;
+  }
+  if (!s->ep) {
+    PyErr_SetString(PyExc_RuntimeError, "TorSink endpoint is gone");
+    return NULL;
+  }
+  int err;
+  int64_t now = cep_now(s->ep, &err);
+  if (err) return NULL;
+  Py_INCREF(arg);
+  if (tsink_write(s, now, arg) < 0) return NULL;
+  Py_RETURN_NONE;
+}
+
 static PyMethodDef CTorSink_methods[] = {
     {"bytes_received", (PyCFunction)CTorSink_bytes_received, METH_NOARGS,
      "counted DATA body bytes received so far"},
+    {"write", (PyCFunction)CTorSink_write, METH_O,
+     "queue one framed cell through the C pending-write queue"},
     {NULL, NULL, 0, NULL}};
 
 static PyTypeObject CTorSink_Type = {
@@ -4519,15 +4871,27 @@ static PyTypeObject CTorSink_Type = {
     .tp_clear = (inquiry)CTorSink_clear_gc,
     .tp_methods = CTorSink_methods,
     .tp_free = PyObject_GC_Del,
-    .tp_doc = "C tor-client frame sink (models/tor.py TorClient twin)",
+    .tp_doc = "C tor-client frame sink + circuit-build control plane "
+              "(models/tor.py TorClient twin)",
 };
 
 static PyObject *Core_tor_client_sink(CoreObject *c, PyObject *args) {
   (void)c;
-  PyObject *ep_o, *on_cell;
-  if (!PyArg_ParseTuple(args, "OO", &ep_o, &on_cell)) return NULL;
+  PyObject *ep_o, *on_cell, *frames = Py_None;
+  if (!PyArg_ParseTuple(args, "OO|O", &ep_o, &on_cell, &frames))
+    return NULL;
   if (Py_TYPE(ep_o) != &CEp_Type) {
     PyErr_SetString(PyExc_TypeError, "tor_client_sink expects a C endpoint");
+    return NULL;
+  }
+  if (frames != Py_None &&
+      (!PyTuple_Check(frames) || PyTuple_GET_SIZE(frames) != 3 ||
+       !PyBytes_Check(PyTuple_GET_ITEM(frames, 0)) ||
+       !PyBytes_Check(PyTuple_GET_ITEM(frames, 1)) ||
+       !PyBytes_Check(PyTuple_GET_ITEM(frames, 2)))) {
+    PyErr_SetString(PyExc_TypeError,
+                    "tor_client_sink frames must be a 3-tuple of bytes "
+                    "(EXTEND hop2, EXTEND hop3, BEGIN)");
     return NULL;
   }
   CTorSink *s = PyObject_GC_New(CTorSink, &CTorSink_Type);
@@ -4538,6 +4902,11 @@ static PyObject *Core_tor_client_sink(CoreObject *c, PyObject *args) {
   s->ep = (CEp *)ep_o;
   Py_INCREF(on_cell);
   s->on_cell = on_cell;
+  if (frames != Py_None) {
+    Py_INCREF(frames);
+    s->frames = frames;
+  }
+  s->pend.esz = sizeof(PendEnt);
   s->ep->tsink = s;
   PyObject_GC_Track((PyObject *)s);
   return (PyObject *)s;
@@ -4619,14 +4988,15 @@ PyMODINIT_FUNC PyInit__colcore(void) {
   INTERN(S_syn_fire, "_syn_fire");
   INTERN(S_fin_fire, "_fin_fire");
   INTERN(S_drop_fire, "_drop_fire");
+  INTERN(S_seq_ctr, "_seq");
+  INTERN(S_on_first, "on_first");
 #undef INTERN
   O_zero = PyLong_FromLong(0);
   O_one = PyLong_FromLong(1);
   O_false = Py_False;
   Py_INCREF(O_false);
   O_kind_dgram = PyLong_FromLong(KIND_DGRAM);
-  O_kind_loss = PyLong_FromLong(KIND_LOSS_C);
-  if (!O_zero || !O_one || !O_kind_dgram || !O_kind_loss) return NULL;
+  if (!O_zero || !O_one || !O_kind_dgram) return NULL;
   if (PyType_Ready(&Core_Type) < 0 || PyType_Ready(&GossipState_Type) < 0
       || PyType_Ready(&CEp_Type) < 0 || PyType_Ready(&CRelay_Type) < 0
       || PyType_Ready(&CBatch_Type) < 0

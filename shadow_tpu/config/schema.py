@@ -118,16 +118,12 @@ class ExperimentalOptions:
     #: C engine for the columnar plane (native/colcore). Bit-identical to
     #: the Python paths; off forces the pure-Python twin (test oracle).
     native_colcore: bool = True
-    #: stream loss recovery: "dupack" = RFC 5681-shaped 3-duplicate-ack
-    #: fast retransmit (the faithful model, default); "oracle" = the
-    #: engine notifies the sender one RTT after a dropped departure
-    #: (round 2-4 behavior). DEPRECATED: selecting "oracle" additionally
-    #: requires the explicit ``loss_oracle: true`` acknowledgement below;
-    #: retirement criterion in COMPONENTS.md (component #13).
+    #: stream loss recovery: "dupack" — RFC 5681-shaped 3-duplicate-ack
+    #: fast retransmit, the only model (the round 2-4 engine-notification
+    #: oracle was retired per COMPONENTS.md #13; any other value is a
+    #: config error). The knob survives so configs stay explicit about
+    #: which recovery model produced their results.
     stream_loss_recovery: str = "dupack"
-    #: explicit opt-in gate for the deprecated oracle loss-recovery model:
-    #: without it, ``stream_loss_recovery: oracle`` is a config error.
-    loss_oracle: bool = False
     #: guest watchdog (native/managed.py): wall-clock seconds a managed
     #: process may hold its turn without making a syscall before it is
     #: killed and converted to a host_down fault (0 = off). Catches the
@@ -447,44 +443,20 @@ def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
     e.tpu_mesh_floor = int(exp.get("tpu_mesh_floor", 2048))
     e.native_colcore = bool(exp.get("native_colcore", True))
     e.stream_loss_recovery = str(exp.get("stream_loss_recovery", "dupack"))
-    _require(e.stream_loss_recovery in ("dupack", "oracle"),
-             "experimental.stream_loss_recovery must be dupack or oracle, "
+    _require(e.stream_loss_recovery == "dupack",
+             "experimental.stream_loss_recovery must be dupack (the "
+             "deprecated engine-notification oracle model was removed per "
+             "its COMPONENTS.md #13 retirement criterion), "
              f"got {e.stream_loss_recovery!r}")
-    e.loss_oracle = bool(exp.get("loss_oracle", False))
     e.guest_turn_timeout = float(exp.get("guest_turn_timeout", 0.0))
     _require(e.guest_turn_timeout >= 0,
              "experimental.guest_turn_timeout must be >= 0")
-    _require(
-        e.stream_loss_recovery != "oracle" or e.loss_oracle,
-        "experimental.stream_loss_recovery: oracle is DEPRECATED (the "
-        "engine-notification loss model was superseded by the faithful "
-        "dup-ack fast retransmit in round 5; retirement criterion in "
-        "COMPONENTS.md component #13) — set experimental.loss_oracle: "
-        "true to acknowledge and keep using it for A/B runs",
-    )
-
-    if e.stream_loss_recovery == "oracle":
-        # deprecation warning even with the loss_oracle acknowledgement:
-        # the controller logs every entry here at build (satellite of the
-        # telemetry PR; retirement criterion in COMPONENTS.md #13)
-        cfg.warnings.append(
-            "experimental.loss_oracle: the oracle loss-recovery model is "
-            "DEPRECATED and scheduled for deletion — BENCH_DETAIL.json "
-            "already carries a full dupack-only round (the retire-by "
-            "criterion in COMPONENTS.md component #13); migrate A/B runs "
-            "to stream_loss_recovery: dupack")
 
     if "telemetry" in doc:  # bare `telemetry:` enables with defaults
         cfg.telemetry = _parse_telemetry(doc["telemetry"])
 
     if doc.get("faults") is not None:  # `faults:` left empty = absent
         cfg.faults = _parse_faults(doc["faults"])
-        _require(
-            e.stream_loss_recovery != "oracle",
-            "faults require stream_loss_recovery: dupack — the deprecated "
-            "oracle notification computes its return-path latency at "
-            "resolve time, which is not stable under time-varying links",
-        )
 
     hosts_doc = doc.get("hosts", {}) or {}
     _require(isinstance(hosts_doc, dict), "hosts must be a mapping of name -> options")

@@ -190,6 +190,13 @@ class Controller:
         self._c_core = getattr(self.engine, "_c", None)
         if self._c_core is not None:
             self._c_core.bind_active(self._active)
+            # route activations through the C core so its sorted
+            # active-set snapshot can merge new members incrementally
+            # instead of re-snapshotting the whole set every round
+            act = self._c_core.activate
+            self.engine.activate = act
+            for h in self.hosts:
+                h.equeue.on_first = partial(act, h.id)
 
         # processes: pyapp: plugins run in-process; any other path is a real
         # executable run under the native preload shim (SURVEY.md §7 phase 4)
@@ -503,19 +510,26 @@ class Controller:
                 # run whose flags were computed inline (test_bitmatch.py::
                 # test_device_floor_cannot_change_results). The columnar
                 # plane's resolved-but-undelivered store rows count as
-                # queued events here (pending_head).
-                nt = min(min((hosts[i].equeue.next_time()
-                              for i in self._active), default=T_NEVER),
-                         self.engine.pending_head())
+                # queued events here (pending_head). The C core computes
+                # the same min natively (identical instants — it drops
+                # cancelled heads exactly like next_time, so the round
+                # grid cannot move).
+                def _next_queued():
+                    if self._c_core is not None:
+                        nq = self._c_core.next_time()
+                    else:
+                        nq = min((hosts[i].equeue.next_time()
+                                  for i in self._active), default=T_NEVER)
+                    return min(nq, self.engine.pending_head())
+
+                nt = _next_queued()
                 if faults is not None:
                     # a pending fault transition is a wake-up: skip-ahead
                     # must not jump over it (a reboot creates new events)
                     nt = min(nt, faults.next_time())
                 while self.engine.earliest_outstanding() < nt:
                     self.engine.flush_due(nt)
-                    nt = min(min((hosts[i].equeue.next_time()
-                                  for i in self._active), default=T_NEVER),
-                             self.engine.pending_head())
+                    nt = _next_queued()
                     if faults is not None:
                         nt = min(nt, faults.next_time())
                 if nt >= T_NEVER:

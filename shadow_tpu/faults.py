@@ -37,9 +37,7 @@ lookahead (round width <= min BASE latency) stays sound under degradation.
 
 The C engine is force-disabled while faults are configured (the Python
 planes are the semantic reference; determinism across policies is asserted
-by tests/test_faults.py), and the deprecated oracle loss-recovery model is
-rejected by config validation (its notify-time latency gather is not stable
-under time-varying links).
+by tests/test_faults.py).
 """
 
 from __future__ import annotations

@@ -163,7 +163,26 @@ class Host:
         if heap:
             head = eq.head
             pop = eq.pop_until
+            # the inbox<->heap merge with a CACHED head: while heap[0]
+            # is still the validated head object (our local ref keeps it
+            # alive, so identity is sound), its (t, band, key) is a
+            # lower bound on the live head — a later cancel only moves
+            # the live head LATER — so a row that beats it dispatches
+            # without re-running head()'s cancelled-head scan. Anything
+            # else re-validates. One identity check + tuple compare per
+            # hot row instead of a method call.
+            h0 = None
             while True:
+                if h0 is not None and pos < ln and heap and heap[0] is h0:
+                    row = rows[pos]
+                    ti = row[0]
+                    if (ti < h0[0]
+                            or (ti == h0[0]
+                                and (0, row[1]) < (h0[1], h0[2]))):
+                        dispatch(row)
+                        pos += 1
+                        n += 1
+                        continue
                 h0 = head()
                 hv = h0 is not None and h0[0] < end
                 if pos < ln:
@@ -189,20 +208,14 @@ class Host:
 
     def dispatch_row(self, row) -> None:
         """Columnar-plane arrival dispatch: the field-level twin of the
-        per-unit plane's arrival event (engine.ingress_arrival + deliver;
-        loss rows stand in for the scheduled on_loss closures). Charges
-        the ingress token bucket at event time, in event order — exactly
-        like the per-unit plane — parking the whole row into the deferred
-        backlog when tokens run short."""
+        per-unit plane's arrival event (engine.ingress_arrival + deliver).
+        Charges the ingress token bucket at event time, in event order —
+        exactly like the per-unit plane — parking the whole row into the
+        deferred backlog when tokens run short."""
         (t, _key, _tgt, kind, peer, aport, bport, nbytes, seq, frag,
          nfrags, size, payload) = row
         if t > self._now:
             self._now = t
-        if kind == U.KIND_LOSS:
-            ep = self._conns.get((aport, peer, bport))
-            if ep is not None:
-                ep.on_loss_notify(seq, nbytes, payload)
-            return
         if self.down:
             # crashed host: the arrival is consumed by the dead NIC — no
             # token charge, no delivery, no response; peers discover the
@@ -301,8 +314,7 @@ class Host:
 
     def emit_msg(self, kind: int, dst: int, size: int, nbytes: int,
                  payload, seq: int, sport: int, dport: int,
-                 frag_idx: int = 0, nfrags: int = 1,
-                 want_loss: bool = False) -> None:
+                 frag_idx: int = 0, nfrags: int = 1) -> None:
         """Field-level emission API shared by the transport and datagram
         layers. Columnar plane: one tuple append, no Unit object, no uid
         mint (uids are assigned vectorized at the barrier in the same
@@ -319,17 +331,16 @@ class Host:
                 # C engine: packed egress row, no tuple (the C side also
                 # tracks the emitters list and the emitted counter)
                 c.emit_row(self.id, kind, dst, size, self._now, sport,
-                           dport, nbytes, seq, frag_idx, nfrags,
-                           want_loss, payload)
+                           dport, nbytes, seq, frag_idx, nfrags, payload)
                 return
             eg = self.egress_rows
             if not eg:
                 cp.emitters.append(self)
             eg.append((kind, dst, size, self._now, sport, dport, nbytes,
-                       seq, frag_idx, nfrags, want_loss, payload))
+                       seq, frag_idx, nfrags, payload))
             self._n_emitted += 1
             return
-        u = Unit(
+        self.emit_unit(Unit(
             uid=self.next_uid(),
             src=self.id,
             dst=dst,
@@ -343,21 +354,7 @@ class Host:
             seq=seq,
             frag_idx=frag_idx,
             nfrags=nfrags,
-        )
-        if want_loss:
-            u.on_loss = lambda: self._dispatch_loss(
-                sport, dst, dport, seq, nbytes, payload)
-            u.loss_extra_ns = self.engine.rtt_extra_ns(self.id, dst)
-        self.emit_unit(u)
-
-    def _dispatch_loss(self, sport: int, dst: int, dport: int, seq: int,
-                       nbytes: int, payload) -> None:
-        """Loss notification fire: route back to the owning endpoint by
-        four-tuple. A lookup miss means the connection is gone — exactly
-        the cases the sender's own state checks used to no-op on."""
-        ep = self._conns.get((sport, dst, dport))
-        if ep is not None:
-            ep.on_loss_notify(seq, nbytes, payload)
+        ))
 
     def deliver(self, u: Unit, now: SimTime) -> None:
         """A unit cleared the ingress token bucket: dispatch to a socket."""

@@ -136,6 +136,10 @@ class PluginProcess(ProcessLifecycle):
         self.spawned = False  # ever spawned (host reboot respects start_time)
         self.app = None
 
+    #: spec -> app class; import_module per spawn costs an import-lock
+    #: round trip, which 100k same-model clients pay 100k times
+    _app_classes: dict = {}
+
     @classmethod
     def is_plugin_path(cls, path: str) -> bool:
         return path.startswith(cls.PYAPP_PREFIX)
@@ -143,14 +147,18 @@ class PluginProcess(ProcessLifecycle):
     def spawn(self) -> None:
         """The process start event (reference analog: SURVEY.md §3.2)."""
         spec = self.opts.path[len(self.PYAPP_PREFIX):]
-        try:
-            mod_name, cls_name = spec.rsplit(":", 1)
-        except ValueError as exc:
-            raise ValueError(
-                f"bad pyapp path {self.opts.path!r} (want pyapp:module:Class)"
-            ) from exc
-        mod = importlib.import_module(mod_name)
-        app_cls = getattr(mod, cls_name)
+        app_cls = self._app_classes.get(spec)
+        if app_cls is None:
+            try:
+                mod_name, cls_name = spec.rsplit(":", 1)
+            except ValueError as exc:
+                raise ValueError(
+                    f"bad pyapp path {self.opts.path!r} "
+                    f"(want pyapp:module:Class)"
+                ) from exc
+            mod = importlib.import_module(mod_name)
+            app_cls = getattr(mod, cls_name)
+            self._app_classes[spec] = app_cls
         api = ProcessAPI(self.host, self)
         self.app = app_cls(api, list(self.opts.args), dict(self.opts.environment))
         self.running = True

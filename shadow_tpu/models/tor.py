@@ -137,24 +137,6 @@ class _Conn:
             self.ep.on_drain = pump_then_close
 
 
-class _WriteConn:
-    """The write half of _Conn, for connections whose READ side is
-    served by the C engine (TorSink): the bounded-send pending queue +
-    on_drain pump, with nothing wired to on_data."""
-
-    __slots__ = ("ep", "pending", "sink")
-
-    def __init__(self, ep):
-        self.ep = ep
-        self.pending = []
-        self.sink = None  # the C TorSink, kept alive with the connection
-        ep.on_drain = lambda room: self._pump()
-
-    write = _Conn.write
-    write_counted = _Conn.write_counted
-    _pump = _Conn._pump
-
-
 class TorRelay:
     """args: [or_port]"""
 
@@ -444,7 +426,7 @@ class TorClient:
                     "tor_fetch", self.server, t0, state["bd"], got,
                     "ok" if got >= self.size else "error",
                     retx=int(ep.sender.loss_events))
-            conn.ep.close()
+            ep.close()
             self._finish()
 
         def on_ctrl(ctype, got):
@@ -461,15 +443,30 @@ class TorClient:
         core = getattr(getattr(host, "colplane", None), "_c", None)
         make_sink = getattr(core, "tor_client_sink", None)
         if make_sink is not None and host.pcap is None:
-            # C-engine endpoint: frame parsing + DATA-body byte counting
-            # run in native/colcore (TorSink); only control cells — a
-            # handful per circuit — reach Python. The writer side keeps
-            # the Python pending queue (telescoping cells are tiny and
-            # rare). Exact twin of the closures below.
-            conn = _WriteConn(ep)
-            sink = make_sink(
-                ep, lambda ctype, c, payload, got: on_ctrl(ctype, got))
-            conn.sink = sink  # keep the sink alive with the connection
+            # C-engine endpoint: frame parsing, DATA-body byte counting,
+            # AND the circuit-build control plane run in native/colcore
+            # (TorSink). The sink holds the three pre-built advance
+            # frames and answers each CREATED/EXTENDED natively through
+            # its own pending-write queue; Python sees exactly two
+            # events per circuit — the stage-3 EXTENDED (record the
+            # build time) and END (finish the fetch). Exact twin of the
+            # closures below (same cells, same order, same instants).
+            frames = (
+                cell(EXTEND, circ, f"{hops[1]}:{self.relay_port}".encode()),
+                cell(EXTEND, circ, f"{hops[2]}:{self.relay_port}".encode()),
+                cell(BEGIN, circ,
+                     f"{self.server}:{self.server_port}:{self.size}"
+                     .encode()),
+            )
+
+            def on_ctrl_c(ctype, c, payload, got):
+                if ctype == END:
+                    finish_fetch(got)
+                else:  # the stage-3 EXTENDED: telescoping done
+                    self.build_times.append(api.now - t0)
+                    state["bd"] = api.now
+
+            conn = make_sink(ep, on_ctrl_c, frames)
         else:
             got = {"n": 0}
 

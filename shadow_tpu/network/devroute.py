@@ -364,12 +364,6 @@ class DeviceRoutedPlane:
         return int(self.graph.latency_ns[p.host_node[src_host],
                                          p.host_node[dst_host]])
 
-    def rtt_extra_ns(self, src_host: int, dst_host: int) -> SimTime:
-        """Extra delay beyond one-way latency for loss notifications: the
-        return-path latency (so the sender learns of a loss one RTT after
-        departure, like a fast-retransmit signal)."""
-        return self.latency_between(dst_host, src_host)
-
     def has_immediate_work(self) -> bool:
         """True if the next round must run even with empty event queues
         (deferred ingress backlog waiting on token refill)."""

@@ -15,7 +15,7 @@ unit). They route either to the numpy twin (fluid.loss_flags) or to the
 device kernel (ops/propagate.py) — bit-identical by construction — based on
 batch size vs a calibrated floor. Device batches are read back
 *asynchronously with a causal deadline*: the flags are not needed until the
-earliest time any unit of the batch can arrive (or notify a loss), which is
+earliest time any unit of the batch can arrive, which is
 computable host-side; until then the readback streams in the background and
 subsequent rounds proceed. Event ordering is canonicalized with per-unit
 keys assigned at the emission barrier (core/events.py BAND_NET), so the
@@ -57,7 +57,6 @@ class _Outstanding:
 
     units: list  # list[Unit], batch order
     arrival: np.ndarray  # (N,) int64 — depart + latency
-    notify: np.ndarray  # (N,) int64 — arrival + per-unit loss-notify extra
     keys: np.ndarray  # (N,) int64 canonical event keys
     round_end: SimTime  # barrier that emitted the batch
     deadline: SimTime  # earliest event time any unit can produce
@@ -87,11 +86,10 @@ class NetworkEngine(DeviceRoutedPlane):
         self.units_blackholed = 0
         self.bytes_sent = 0
         #: targeted fault injection (tests, experiments): units for which
-        #: this predicate returns True are force-dropped in the network.
-        #: With fault_silent the sender gets no loss notification either —
-        #: recovery must come from its own timers (SURVEY.md §5.3).
+        #: this predicate returns True are force-dropped in the network —
+        #: silently; recovery must come from the endpoints' own machinery
+        #: (dup acks, RTO timers — SURVEY.md §5.3).
         self.fault_filter = None
-        self.fault_silent = False
         #: a faults: config section exists (shadow_tpu/faults.py): hosts
         #: may crash, links may cut; enables per-host blackhole accounting
         self.faults_active = False
@@ -158,8 +156,11 @@ class NetworkEngine(DeviceRoutedPlane):
         units: list[Unit] = []
         for h in self.hosts:  # host-id order == src-sorted FIFO, no sort
             if h._ack_eps:
-                # flush coalesced acks (transport.StreamReceiver._ack)
-                eps, h._ack_eps = h._ack_eps, {}
+                # flush coalesced acks (transport.StreamReceiver._ack);
+                # snapshot + clear in place — the dict's identity is
+                # load-bearing for the C engine's cached reference
+                eps = list(h._ack_eps)
+                h._ack_eps.clear()
                 for ep in eps:
                     if ep.state != 0:  # not CLOSED
                         ep.receiver.flush_ack()
@@ -207,8 +208,6 @@ class NetworkEngine(DeviceRoutedPlane):
             if ml < self.min_used_latency:
                 self.min_used_latency = ml
         thresh = self.params.drop_thresh[sn, dn]
-        extra = np.fromiter((u.loss_extra_ns for u in units), dtype=np.int64, count=n)
-        notify = arrival + extra
         keys = np.arange(self._ev_key, self._ev_key + n, dtype=np.int64)
         self._ev_key += n
 
@@ -216,9 +215,6 @@ class NetworkEngine(DeviceRoutedPlane):
         if self.fault_filter is not None:
             forced = np.fromiter((self.fault_filter(u) for u in units),
                                  dtype=bool, count=n)
-            if self.fault_silent:
-                for i in np.flatnonzero(forced):
-                    units[i].on_loss = None
             if not forced.any():
                 forced = None
 
@@ -232,7 +228,7 @@ class NetworkEngine(DeviceRoutedPlane):
             flags = loss_flags(self.params.seed, *_uid_arrays(units, n), thresh)
             if forced is not None:
                 flags = flags | forced
-            self._schedule_batch(units, arrival, notify, flags, keys, round_end)
+            self._schedule_batch(units, arrival, flags, keys, round_end)
             return
         for i in range(0, n, self.max_batch):
             j = min(n, i + self.max_batch)
@@ -242,7 +238,7 @@ class NetworkEngine(DeviceRoutedPlane):
                 handle = _ForcedHandle(handle, forced[i:j])
             deadline = max(round_end, int(arrival[i:j].min()))
             self.outstanding.append(_Outstanding(
-                units[i:j], arrival[i:j], notify[i:j], keys[i:j],
+                units[i:j], arrival[i:j], keys[i:j],
                 round_end, deadline, handle,
             ))
 
@@ -262,14 +258,14 @@ class NetworkEngine(DeviceRoutedPlane):
             flags = b.handle.read()
             self._record_dev_read(_walltime.perf_counter() - t0,
                                   len(b.units))
-            self._schedule_batch(b.units, b.arrival, b.notify,
+            self._schedule_batch(b.units, b.arrival,
                                  flags, b.keys, b.round_end)
         self._floor_settle()
 
     def flush_all(self) -> None:
         self.flush_due(T_NEVER + 1)
 
-    def _schedule_batch(self, units, arrival, notify, dropped, keys,
+    def _schedule_batch(self, units, arrival, dropped, keys,
                         round_end: SimTime) -> None:
         # bulk numpy->Python conversions (tolist is C-speed; per-element
         # int() boxing dominated this loop at 10k-host scale). The clamps
@@ -286,11 +282,6 @@ class NetworkEngine(DeviceRoutedPlane):
         for i, u in enumerate(units):
             if drop_l[i]:
                 dropped_ct += 1
-                if u.on_loss is not None:
-                    who = u.loss_host if u.loss_host is not None else u.src
-                    hosts[who].schedule(
-                        max(int(notify[i]), round_end), u.on_loss,
-                        band=BAND_NET, key=key_l[i])
             else:
                 sent += 1
                 nbytes += u.size

@@ -2,8 +2,10 @@
 
 Re-designs the reference's userspace TCP + UDP socket layer (SURVEY.md §1
 layer 9, §2 "TCP stack") as a *fluid* model suited to batched per-round
-simulation. Round 2 hardening (VERDICT.md item #5) makes the stream layer a
-real protocol rather than an oracle-dependent sketch:
+simulation. Round 2 hardening (VERDICT.md item #5) made the stream layer a
+real protocol; round 5 made loss recovery self-contained (dup-ack fast
+retransmit), and the round 2-4 engine-notification loss model was deleted
+per its COMPONENTS.md #13 retirement criterion:
 
 - **Cumulative acks + sequence accounting.** Every DATA unit carries its
   byte offset; the receiver tracks ``rcv_nxt``, buffers out-of-order
@@ -12,12 +14,13 @@ real protocol rather than an oracle-dependent sketch:
   is repaired by any later ACK — no cross-host bookkeeping (round 1's
   ``_peer_sender`` reach-across is gone).
 - **Retransmission machinery.** Two layers, like TCP's fast-retransmit vs
-  RTO: the engine's loss oracle notifies the sender one RTT after a
-  dropped DATA departure (the fluid stand-in for duplicate-ack detection)
-  and triggers an immediate retransmit + multiplicative decrease; an RTO
-  timer (2x path RTT, exponential backoff) independently guarantees
-  progress for every loss pattern the oracle does not cover (lost ACKs,
-  lost retransmits). Control units use pure timers: SYN and FIN retransmit
+  RTO: the receiver acks out-of-order data immediately, the sender counts
+  consecutive duplicate acks, and the 3rd triggers fast retransmit +
+  multiplicative decrease (RFC 5681-shaped — no simulator-side loss
+  information); an RTO timer (2x path RTT, exponential backoff)
+  independently guarantees progress for every loss pattern duplicate acks
+  do not cover (lost ACKs, lost retransmits). Control units use pure
+  timers: SYN and FIN retransmit
   on RTO with bounded retries; SYNACK loss is repaired by SYN retransmit +
   the server's duplicate-SYN re-ack; FINACK loss by FIN retransmit + the
   TIME_WAIT re-ack below.
@@ -88,8 +91,6 @@ class StreamSender:
         self.loss_events = 0
         self.bytes_acked = 0
         self.dup_acks = 0  # consecutive duplicate acks (RFC 5681 counting)
-        self.oracle = (endpoint.host.controller.cfg.experimental
-                       .stream_loss_recovery == "oracle")
 
     # -- app side ----------------------------------------------------------
     def queue(self, nbytes: int, payload: Optional[bytes]) -> int:
@@ -140,15 +141,13 @@ class StreamSender:
             self.ep._on_sender_drained()
 
     def _emit_data(self, seq: int, nbytes: int, payload: Optional[bytes]) -> None:
-        # oracle mode asks the engine for a loss notification one RTT
-        # after a dropped departure; dupack mode (default) recovers from
-        # duplicate acks like real TCP, no simulator-side information
-        self.ep.emit(U.DATA, nbytes=nbytes, payload=payload, seq=seq,
-                     want_loss=self.oracle)
+        # recovery comes entirely from duplicate acks like real TCP — the
+        # sender gets no simulator-side loss information
+        self.ep.emit(U.DATA, nbytes=nbytes, payload=payload, seq=seq)
 
     # -- loss recovery -----------------------------------------------------
     def _loss_response(self, seq: int, nbytes: int, payload) -> None:
-        """The shared loss response (oracle notification OR 3rd dup ack):
+        """The fast-retransmit response (3rd consecutive duplicate ack):
         multiplicative decrease + retransmit + RTO reset."""
         self.loss_events += 1
         if self.ep.host.faults_active:
@@ -157,13 +156,6 @@ class StreamSender:
         self.cwnd = max(self.cwnd // 2, MIN_CWND)
         self._emit_data(seq, nbytes, payload)
         self._arm_rto(reset=True)
-
-    def _on_oracle_loss(self, seq: int, nbytes: int, payload) -> None:
-        """Engine loss notification, one RTT after the dropped departure —
-        the fluid analog of fast retransmit (oracle mode only)."""
-        if seq + nbytes <= self.snd_una or self.ep.state in (CLOSED, TIME_WAIT):
-            return  # already repaired (e.g. by an RTO retransmit)
-        self._loss_response(seq, nbytes, payload)
 
     def _arm_rto(self, reset: bool = False) -> None:
         if reset and self.rto_timer is not None:
@@ -233,7 +225,7 @@ class StreamSender:
             drained = self.ep.on_drain
             if drained is not None and self.buffered < self.send_buffer:
                 drained(self.send_buffer - self.buffered)
-        elif (not self.oracle and cum_ack == self.snd_una
+        elif (cum_ack == self.snd_una
               and wnd == prev_wnd and self.inflight > 0 and self.rtx):
             # duplicate ack (RFC 5681: same cum, same window, data
             # outstanding); the 3rd CONSECUTIVE one triggers fast
@@ -329,12 +321,8 @@ class StreamReceiver:
         every time, which would make consecutive dup acks all differ and
         defeat the sender's same-window test — and it supersedes any
         coalesced ack queued this round (a same-cum barrier ack would
-        inflate the count). Oracle mode keeps plain coalescing (the
-        round 2-4 behavior the A/B compares against)."""
+        inflate the count)."""
         ep = self.ep
-        if ep.sender.oracle:
-            self._ack()
-            return
         if ep.state in (CLOSED, TIME_WAIT):
             return
         ep.host._ack_eps.pop(ep, None)
@@ -514,18 +502,14 @@ class StreamEndpoint:
             self.on_close(now)
 
     def emit(self, kind: int, nbytes: int = 0, payload: Optional[bytes] = None,
-             seq: int = 0, acked: int = 0, wnd: int = 0,
-             want_loss: bool = False) -> None:
+             seq: int = 0, acked: int = 0, wnd: int = 0) -> None:
         # control units overload the fields: nbytes carries the cumulative
-        # ack, seq carries the advertised window. want_loss requests a
-        # loss notification (dispatched back to this endpoint's sender one
-        # return-path latency after the would-be arrival — the fluid
-        # analog of duplicate-ack detection; DATA only)
+        # ack, seq carries the advertised window
         self.host.emit_msg(
             kind, self.remote_host, nbytes + HEADER,
             nbytes if kind == U.DATA else acked, payload,
             seq if kind == U.DATA else wnd,
-            self.local_port, self.remote_port, want_loss=want_loss)
+            self.local_port, self.remote_port)
 
     # -- unit arrivals (dispatched by the host) ---------------------------
     def handle(self, unit: Unit, now: SimTime) -> None:
@@ -594,12 +578,6 @@ class StreamEndpoint:
                     self.on_close(now)
             return
 
-    def on_loss_notify(self, seq: int, nbytes: int,
-                       payload: Optional[bytes]) -> None:
-        """The engine's loss notification for one of our DATA units,
-        re-dispatched by endpoint four-tuple (both planes route here)."""
-        self.sender._on_oracle_loss(seq, nbytes, payload)
-
     def fingerprint(self) -> tuple:
         """Observable protocol state for the determinism sentinel
         (shadow_tpu/checkpoint.py): the full connection state machine —
@@ -647,7 +625,7 @@ class DatagramSocket:
                     # C engine: packed egress row (round 5)
                     c.emit_row(host.id, U.DGRAM, dst_host, nbytes + HEADER,
                                host._now, port, dst_port, nbytes, dgram,
-                               0, 1, False, payload)
+                               0, 1, payload)
                     return
                 # columnar fast path: inline the emit_msg tuple append
                 # (this call is the hottest emission site at gossip scale)
@@ -655,8 +633,7 @@ class DatagramSocket:
                 if not eg:
                     cp.emitters.append(host)
                 eg.append((U.DGRAM, dst_host, nbytes + HEADER, host._now,
-                           port, dst_port, nbytes, dgram, 0, 1, False,
-                           payload))
+                           port, dst_port, nbytes, dgram, 0, 1, payload))
                 host._n_emitted += 1
                 return
             host.emit_msg(U.DGRAM, dst_host, nbytes + HEADER, nbytes,

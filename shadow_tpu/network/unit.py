@@ -16,8 +16,8 @@ deterministic under every scheduler policy.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Callable, Optional
+from dataclasses import dataclass
+from typing import Optional
 
 from shadow_tpu.core.time import SimTime
 from shadow_tpu.network.fluid import HARD_MAX_PKTS, HEADER, MTU
@@ -25,9 +25,6 @@ from shadow_tpu.network.fluid import HARD_MAX_PKTS, HEADER, MTU
 # unit kinds
 SYN, SYNACK, DATA, ACK, FIN, FINACK, DGRAM = range(7)
 KIND_NAMES = ("SYN", "SYNACK", "DATA", "ACK", "FIN", "FINACK", "DGRAM")
-#: columnar-plane row kind for a loss notification (not a wire unit; see
-#: shadow_tpu/network/colplane.py)
-KIND_LOSS = 16
 
 
 @dataclass(slots=True)
@@ -45,12 +42,6 @@ class Unit:
     seq: int = 0  # stream byte offset / datagram id
     frag_idx: int = 0
     nfrags: int = 1
-    #: called (on loss_host's thread) if the unit is lost in the network
-    on_loss: Optional[Callable[[SimTime], None]] = None
-    #: host whose event queue runs on_loss (defaults to src)
-    loss_host: Optional[int] = None
-    #: extra loss-notification delay beyond one-way latency (e.g. RTT)
-    loss_extra_ns: SimTime = 0
 
     @property
     def npkts(self) -> int:

@@ -214,7 +214,6 @@ def test_fault_injection_identical():
             return False
 
         ctl.engine.fault_filter = fault
-        ctl.engine.fault_silent = False
         res = ctl.run()
         assert remaining["n"] == 0, policy
         return res

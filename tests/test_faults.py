@@ -316,22 +316,14 @@ def test_schema_rejects_bad_fault_configs():
         _parse({"faults": {}})
 
 
-def test_faults_reject_deprecated_oracle_mode():
+def test_removed_oracle_mode_rejected():
+    """The retired engine-notification loss model (COMPONENTS.md #13) is
+    a config error now — old configs fail loudly, not silently change
+    semantics."""
     with pytest.raises(ValueError, match="dupack"):
-        _parse({
-            "faults": {"events": [
-                {"time": "1s", "kind": "host_down", "hosts": ["server"]}]},
-            "experimental": {"stream_loss_recovery": "oracle",
-                             "loss_oracle": True},
-        })
-
-
-def test_oracle_mode_requires_explicit_flag():
-    with pytest.raises(ValueError, match="DEPRECATED"):
         _parse({"experimental": {"stream_loss_recovery": "oracle"}})
-    cfg = _parse({"experimental": {"stream_loss_recovery": "oracle",
-                                   "loss_oracle": True}})
-    assert cfg.experimental.stream_loss_recovery == "oracle"
+    cfg = _parse({"experimental": {"stream_loss_recovery": "dupack"}})
+    assert cfg.experimental.stream_loss_recovery == "dupack"
 
 
 def test_unknown_host_and_node_fail_at_build():

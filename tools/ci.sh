@@ -52,6 +52,24 @@ diff /tmp/ci-win-k1.json /tmp/ci-win-k4.json
 diff /tmp/ci-win-k1.hashes /tmp/ci-win-k4.hashes
 echo "fused-window smoke OK: K=4 bit-identical to K=1 with windows served"
 
+echo "== tor C-twin smoke (tor_400relay: C tor control plane vs Python twin hash) =="
+trun() {
+    python -m shadow_tpu examples/tor_400relay.yaml --quiet --json-summary \
+        --data-directory "/tmp/ci-tor-$1" \
+        --scheduler-policy tpu_batch \
+        --set "experimental.native_colcore=$2" \
+        --set general.stop_time=10s \
+        | python -c 'import json,sys; from shadow_tpu.core.controller import VOLATILE_SUMMARY_KEYS as V; d=json.load(sys.stdin); [d.pop(k, None) for k in V]; print(json.dumps(d,sort_keys=True))' \
+        > "/tmp/ci-tor-$1.json"
+    (cd "/tmp/ci-tor-$1" && find hosts -type f | sort | xargs sha256sum) \
+        > "/tmp/ci-tor-$1.hashes"
+}
+trun c true
+trun py false
+diff /tmp/ci-tor-c.json /tmp/ci-tor-py.json
+diff /tmp/ci-tor-c.hashes /tmp/ci-tor-py.hashes
+echo "tor C-twin smoke OK: C tor control plane bit-identical to the Python model ($(python -c 'import json;print(json.load(open("/tmp/ci-tor-c.json"))["events"])') events)"
+
 echo "== checkpoint/resume smoke (tgen_100host: snapshot mid-run, resume, tree-hash equality) =="
 rm -rf /tmp/ci-ckpt-full /tmp/ci-ckpt-src /tmp/ci-ckpt-resume
 python -m shadow_tpu examples/tgen_100host.yaml --quiet \
