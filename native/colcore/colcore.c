@@ -224,7 +224,7 @@ static PyObject *S_id, *S_now, *S_inbox, *S_egress_rows, *S_uid_counter,
     *S_device_floor, *S_rows, *S_pos, *S_dispatch_row, *S_run_events,
     *S_popleft, *S_append, *S_ingress_deferred_rows, *S_pcap,
     *S_n_emitted, *S_n_delivered, *S_n_dgrams, *S_n_dgrams_recv,
-    *S_n_events, *S_dispatch;
+    *S_n_events, *S_dispatch, *S_n_teardown, *S_n_blackholed, *S_down;
 
 /* cached small objects */
 static PyObject *O_zero, *O_one, *O_false, *O_kind_dgram;
@@ -314,6 +314,10 @@ typedef struct {
   PyObject *listeners; /* owned: host._listeners dict (identity-stable) */
   PyObject *ack_eps;   /* owned: host._ack_eps dict (identity-stable:
                           cleared in place by the barrier, never rebound) */
+  /* fault lifecycle (shadow_tpu/faults.py): crashed-host flag, mirrored
+   * from Host.down by Core.host_crash/host_boot so the per-row dispatch
+   * can discard arrivals at a dead NIC without an attribute read */
+  int down;
   /* C-registered datagram ports (gossip); tiny linear table */
   int nports;
   int port[4];
@@ -328,6 +332,11 @@ typedef struct {
   int64_t d_emitted, d_delivered, d_dgrams, d_dgrams_recv, d_events;
   /* stream-transport + routing counter deltas (host.counters keys) */
   int64_t d_sbytes_q, d_sbytes_recv, d_resets, d_unroutable;
+  /* fault-accounting deltas (folded into the same attrs/counter keys the
+   * Python twin maintains: _n_teardown/_n_blackholed and the
+   * faults_active-gated stream recovery counters) */
+  int64_t d_teardown, d_blackholed;
+  int64_t d_fast_retx, d_rto_retx, d_timeouts;
 } CHost;
 
 typedef struct {
@@ -350,6 +359,10 @@ typedef struct {
   int64_t unit_chunk; /* fluid quantum payload bytes (Host.unit_chunk) */
   int64_t sock_sbuf, sock_rbuf; /* experimental.socket_*_buffer */
   int mesh_mode; /* hand live batches to Python for the mesh collective */
+  /* a faults: section exists (mirrors plane.faults_active): gates the
+   * per-host blackhole/teardown accounting and the stream-recovery
+   * counters, exactly like the Python twins gate on host.faults_active */
+  int faults_active;
   CHost *hs;
   /* scratch buffers reused across barriers */
   struct BRow *brow;
@@ -476,9 +489,18 @@ static PyObject *CBatch_head_time(CBatch *b, PyObject *noarg) {
   return PyLong_FromLongLong(b->pos < b->n ? b->recs[b->pos].t : T_NEVER_C);
 }
 
+static PyObject *CBatch_export_rows(CBatch *b, PyObject *noarg);
+static PyObject *CBatch_restore_state(CBatch *b, PyObject *state);
+
 static PyMethodDef CBatch_methods[] = {
     {"head_time", (PyCFunction)CBatch_head_time, METH_NOARGS,
      "earliest undelivered row time (StoreBatch.head_time twin)"},
+    {"export_rows", (PyCFunction)CBatch_export_rows, METH_NOARGS,
+     "checkpoint export: (pos, [13-tuple store rows]) — the plane-"
+     "neutral StoreBatch form"},
+    {"_restore_state", (PyCFunction)CBatch_restore_state, METH_O,
+     "fill an empty CBatch from (pos, rows) — export_rows' inverse, "
+     "also the plain-StoreBatch -> CBatch converter on C-plane resume"},
     {NULL, NULL, 0, NULL}};
 
 static PyMemberDef CBatch_members[] = {
@@ -806,6 +828,13 @@ static int dispatch_stream(CoreObject *c, CHost *h, int hid, IRow *ir,
 static int dispatch_c(CoreObject *c, CHost *h, int hid, IRow *ir,
                       int64_t *now, int *now_dirty) {
   int64_t t = ir->t;
+  if (h->down) {
+    /* crashed host (Host.dispatch_row twin): the arrival is consumed by
+     * the dead NIC — clock advances, no token charge, no delivery */
+    if (t > *now) { *now = t; *now_dirty = 1; }
+    h->d_teardown++;
+    return 0;
+  }
   if (ir->kind <= TK_FINACK)
     return dispatch_stream(c, h, hid, ir, now, now_dirty);
   GossipState *g = NULL;
@@ -1616,6 +1645,7 @@ static PyObject *Core_barrier(CoreObject *c, PyObject *args) {
     int64_t lat = c->lat[(int64_t)sn * c->G + dn];
     if (lat >= INF_I64) {
       bh++;
+      if (c->faults_active) c->hs[b->src].d_blackholed++;
       Py_XDECREF(b->payload); /* blackholed: drop our ref (see `nown`) */
       continue;
     }
@@ -2029,6 +2059,9 @@ static PyObject *Gossip_stats(GossipState *g, PyObject *noarg) {
                        (Py_ssize_t)g->seen.count);
 }
 
+static PyObject *Gossip_export_state(GossipState *g, PyObject *noarg);
+static PyObject *Gossip_restore_state(GossipState *g, PyObject *state);
+
 static PyMethodDef Gossip_methods[] = {
     {"originate", (PyCFunction)Gossip_originate, METH_O,
      "record a locally-originated txid and announce it to all peers"},
@@ -2036,6 +2069,10 @@ static PyMethodDef Gossip_methods[] = {
      "Python-fallback message delivery: (payload, src_host, now)"},
     {"stats", (PyCFunction)Gossip_stats, METH_NOARGS,
      "-> (received_tx, seen_count)"},
+    {"_export_state", (PyCFunction)Gossip_export_state, METH_NOARGS,
+     "checkpoint export: (hid, port, peers, seen, received, next_dgram)"},
+    {"_restore_state", (PyCFunction)Gossip_restore_state, METH_O,
+     "checkpoint restore (core binding comes via Core.adopt)"},
     {NULL, NULL, 0, NULL}};
 
 static PyTypeObject GossipState_Type = {
@@ -2244,6 +2281,11 @@ static int Core_init(CoreObject *c, PyObject *args, PyObject *kwds) {
   if (!mp) return -1;
   c->mesh_mode = mp != Py_None;
   Py_DECREF(mp);
+  PyObject *fa = PyObject_GetAttrString(plane, "faults_active");
+  if (!fa) return -1;
+  c->faults_active = PyObject_IsTrue(fa);
+  Py_DECREF(fa);
+  if (c->faults_active < 0) return -1;
   c->unit_chunk = 0; /* filled from hosts[0] below (config-uniform) */
   PyObject *mod = PyImport_ImportModule("shadow_tpu.network.colplane");
   if (!mod) return -1;
@@ -2273,6 +2315,13 @@ static int Core_init(CoreObject *c, PyObject *args, PyObject *kwds) {
     if (!pcap) return -1;
     h->py_mode = pcap != Py_None;
     Py_DECREF(pcap);
+    /* crashed-host flag: nonzero when the core is (re)built over a
+     * restored simulation whose checkpoint caught a host mid-downtime */
+    PyObject *dv = PyObject_GetAttr(host, S_down);
+    if (!dv) return -1;
+    h->down = PyObject_IsTrue(dv);
+    Py_DECREF(dv);
+    if (h->down < 0) return -1;
     h->egress = PyObject_GetAttr(host, S_egress_rows);
     if (!h->egress) return -1;
     if (!PyList_Check(h->egress)) {
@@ -2394,19 +2443,28 @@ static PyObject *Core_fold_counters(CoreObject *c, PyObject *noarg) {
         attr_add_i64(h->host, S_n_delivered, h->d_delivered) < 0 ||
         attr_add_i64(h->host, S_n_dgrams, h->d_dgrams) < 0 ||
         attr_add_i64(h->host, S_n_dgrams_recv, h->d_dgrams_recv) < 0 ||
-        attr_add_i64(h->host, S_n_events, h->d_events) < 0)
+        attr_add_i64(h->host, S_n_events, h->d_events) < 0 ||
+        attr_add_i64(h->host, S_n_teardown, h->d_teardown) < 0 ||
+        attr_add_i64(h->host, S_n_blackholed, h->d_blackholed) < 0)
       return NULL;
     h->d_emitted = h->d_delivered = h->d_dgrams = h->d_dgrams_recv = 0;
     h->d_events = 0;
+    h->d_teardown = h->d_blackholed = 0;
     /* stream/routing counters go through host.counters.add (key space
-     * shared with the Python transport) */
-    static const char *names2[4] = {"stream_bytes_queued",
+     * shared with the Python transport; the last three are the
+     * faults_active-gated recovery counters — the deltas are only ever
+     * incremented with faults on, so the fold stays unconditional) */
+    static const char *names2[7] = {"stream_bytes_queued",
                                     "stream_bytes_received",
-                                    "stream_resets", "units_unroutable"};
-    int64_t *vals[4] = {&h->d_sbytes_q, &h->d_sbytes_recv, &h->d_resets,
-                        &h->d_unroutable};
+                                    "stream_resets", "units_unroutable",
+                                    "stream_fast_retransmits",
+                                    "stream_rto_retransmits",
+                                    "stream_timeouts"};
+    int64_t *vals[7] = {&h->d_sbytes_q, &h->d_sbytes_recv, &h->d_resets,
+                        &h->d_unroutable, &h->d_fast_retx,
+                        &h->d_rto_retx, &h->d_timeouts};
     PyObject *ctrs = NULL;
-    for (int j = 0; j < 4; j++) {
+    for (int j = 0; j < 7; j++) {
       if (!*vals[j]) continue;
       if (!ctrs) {
         ctrs = PyObject_GetAttrString(h->host, "counters");
@@ -2428,6 +2486,52 @@ static PyObject *Core_flush_acks(CoreObject *c, PyObject *arg);
 static PyObject *Core_run_round(CoreObject *c, PyObject *args);
 static PyObject *Core_relay_new(CoreObject *c, PyObject *args);
 static PyObject *Core_tor_client_sink(CoreObject *c, PyObject *args);
+static PyObject *Core_adopt(CoreObject *c, PyObject *arg);
+
+/* -- fault lifecycle (shadow_tpu/faults.py) ------------------------------ */
+static PyObject *Core_set_faults_active(CoreObject *c, PyObject *arg) {
+  int v = PyObject_IsTrue(arg);
+  if (v < 0) return NULL;
+  c->faults_active = v;
+  Py_RETURN_NONE;
+}
+
+/* Host.crash's C-side half: mark the CHost down (per-row dispatch
+ * discards arrivals), drop the C-registered gossip handlers (a reboot
+ * re-registers fresh state via gossip_register), and defensively clear
+ * the transient inbox/egress buffers (both are empty at the round
+ * starts where faults apply). The Python side of crash() — conns,
+ * listeners, timers, parked rows — operates on the SHARED structures
+ * this core caches, so it needs no C involvement. */
+static PyObject *Core_host_crash(CoreObject *c, PyObject *arg) {
+  int64_t hid = PyLong_AsLongLong(arg);
+  if (hid == -1 && PyErr_Occurred()) return NULL;
+  if (hid < 0 || hid >= c->H) {
+    PyErr_SetString(PyExc_ValueError, "host id out of range");
+    return NULL;
+  }
+  CHost *h = &c->hs[hid];
+  h->down = 1;
+  for (int j = 0; j < h->nports; j++) Py_CLEAR(h->gs[j]);
+  h->nports = 0;
+  for (int j = 0; j < h->inbox_n; j++) Py_CLEAR(h->inbox[j].payload);
+  h->inbox_n = 0;
+  h->inbox_multi = 0;
+  for (int j = 0; j < h->erow_n; j++) Py_CLEAR(h->erow[j].payload);
+  h->erow_n = 0;
+  Py_RETURN_NONE;
+}
+
+static PyObject *Core_host_boot(CoreObject *c, PyObject *arg) {
+  int64_t hid = PyLong_AsLongLong(arg);
+  if (hid == -1 && PyErr_Occurred()) return NULL;
+  if (hid < 0 || hid >= c->H) {
+    PyErr_SetString(PyExc_ValueError, "host id out of range");
+    return NULL;
+  }
+  c->hs[hid].down = 0;
+  Py_RETURN_NONE;
+}
 
 static PyMethodDef Core_methods[] = {
     {"barrier", (PyCFunction)Core_barrier, METH_VARARGS,
@@ -2474,6 +2578,16 @@ static PyMethodDef Core_methods[] = {
      "(hid, on_ctrl) -> Relay (C tor-relay data path)"},
     {"tor_client_sink", (PyCFunction)Core_tor_client_sink, METH_VARARGS,
      "(endpoint, on_cell) -> TorSink (C tor-client data path)"},
+    {"set_faults_active", (PyCFunction)Core_set_faults_active, METH_O,
+     "(flag) -> enable the faults_active-gated accounting (blackhole/"
+     "teardown per-host counts, stream recovery counters)"},
+    {"host_crash", (PyCFunction)Core_host_crash, METH_O,
+     "(hid) -> C-side host crash teardown (Host.crash delegates)"},
+    {"host_boot", (PyCFunction)Core_host_boot, METH_O,
+     "(hid) -> clear the C-side down flag (Host.reboot delegates)"},
+    {"adopt", (PyCFunction)Core_adopt, METH_O,
+     "(objs) -> bind checkpoint-restored C objects (endpoints, gossip "
+     "states, relays) to this core (Controller._reattach_runtime)"},
     {NULL, NULL, 0, NULL}};
 
 static PyTypeObject Core_Type = {
@@ -2507,6 +2621,10 @@ static PyTypeObject Core_Type = {
 #define INIT_CWND_C (10 * MSS_C)
 #define MIN_CWND_C (2 * MSS_C)
 #define RTO_MIN_NS_C 200000000LL
+/* RTO ceiling (transport.py RTO_MAX_NS twin): a connection created
+ * across a CUT path sees INF latency, and 2x that both overflows int64
+ * and means "never retry" — cap like TCP's conventional 60 s max */
+#define RTO_MAX_NS_C 60000000000LL
 #define SYN_RETRIES_C 5
 #define FIN_RETRIES_C 5
 #define DATA_RETRIES_C 8
@@ -2561,6 +2679,11 @@ typedef struct CEp {
   int initiator, state, syn_tries, fin_tries, peer_fin;
   int64_t rto_ns;
   PyObject *ctl_timer; /* owned PyLong handle, or NULL */
+  /* opt-in idle timeout (StreamEndpoint.set_idle_timeout twin): rearmed
+   * on every arrival, expiry surfaces ETIMEDOUT — the pure-receiver
+   * dead-peer detector fault configs rely on (faults.py) */
+  int64_t idle_timeout_ns; /* 0 = off */
+  PyObject *idle_timer; /* owned PyLong handle, or NULL */
   /* sender */
   int64_t chunk, cwnd, ssthresh, send_buffer, snd_nxt, snd_una, adv_wnd;
   int64_t buffered, bytes_acked;
@@ -2630,7 +2753,7 @@ static int64_t cep_now(CEp *e, int *err) {
 }
 
 static PyObject *S_schedule_in, *S_cancel_m, *S_rto_fire, *S_syn_fire,
-    *S_fin_fire, *S_drop_fire, *S_seq_ctr, *S_on_first;
+    *S_fin_fire, *S_drop_fire, *S_idle_fire, *S_seq_ctr, *S_on_first;
 
 static int64_t cep_window(CEp *e, int *err) {
   *err = 0;
@@ -2810,6 +2933,7 @@ static int cs_pump(CEp *e, int64_t now) {
 static int cs_loss_response(CEp *e, int64_t now, int64_t seq,
                             int64_t nbytes, PyObject *payload) {
   e->loss_events++;
+  if (e->core->faults_active) cep_h(e)->d_fast_retx++;
   int64_t inflight = e->snd_nxt - e->snd_una;
   e->ssthresh = inflight / 2 > MIN_CWND_C ? inflight / 2 : MIN_CWND_C;
   e->cwnd = e->cwnd / 2 > MIN_CWND_C ? e->cwnd / 2 : MIN_CWND_C;
@@ -2823,8 +2947,11 @@ static int cs_on_rto(CEp *e, int64_t now) {
       e->state == ST_TIME_WAIT)
     return 0;
   if (e->adv_wnd > 0) e->retries++;
-  if (e->retries > DATA_RETRIES_C)
+  if (e->retries > DATA_RETRIES_C) {
+    if (e->core->faults_active) cep_h(e)->d_timeouts++;
     return ce_reset(e, "connection timed out (ETIMEDOUT): data retransmission retries exhausted");
+  }
+  if (e->core->faults_active) cep_h(e)->d_rto_retx++;
   int64_t inflight = e->snd_nxt - e->snd_una;
   e->ssthresh = inflight / 2 > MIN_CWND_C ? inflight / 2 : MIN_CWND_C;
   e->cwnd = MIN_CWND_C;
@@ -3071,6 +3198,7 @@ static int ce_cancel_ctl(CEp *e) { return cep_cancel_timer(e, &e->ctl_timer); }
 static int ce_drop(CEp *e) {
   if (ce_cancel_ctl(e) < 0) return -1;
   if (cep_cancel_timer(e, &e->rto_timer) < 0) return -1;
+  if (cep_cancel_timer(e, &e->idle_timer) < 0) return -1;
   e->state = ST_CLOSED;
   e->tsink = NULL; /* borrowed back-pointer; the sink still owns us */
   Py_CLEAR(e->xsink); /* the exit stream dies with its server conn */
@@ -3116,6 +3244,7 @@ static int ce_enter_time_wait(CEp *e, int64_t now) {
   e->state = ST_TIME_WAIT;
   if (ce_cancel_ctl(e) < 0) return -1;
   if (cep_cancel_timer(e, &e->rto_timer) < 0) return -1;
+  if (cep_cancel_timer(e, &e->idle_timer) < 0) return -1;
   /* schedule the final drop WITHOUT tracking a handle (Python twin
    * schedules self._drop unconditionally) */
   PyObject *tmp = NULL;
@@ -3172,6 +3301,14 @@ static int ce_send_syn(CEp *e, int64_t now) {
 static int ce_handle_fields(CEp *e, int64_t now, int k, int64_t nbytes,
                             PyObject *payload, int64_t seq) {
   int err;
+  if (e->idle_timer) {
+    /* any arrival proves the peer is alive (StreamEndpoint twin: the
+     * rearm consumes one seq, exactly like _rearm_idle's schedule_in) */
+    if (cep_cancel_timer(e, &e->idle_timer) < 0) return -1;
+    if (cep_schedule(e, e->idle_timeout_ns, S_idle_fire,
+                     &e->idle_timer) < 0)
+      return -1;
+  }
   if (k == TK_SYN) {
     if (e->state == ST_ESTABLISHED) { /* dup SYN: SYNACK was lost */
       e->adv_wnd = seq;
@@ -3280,6 +3417,7 @@ static void CEp_dealloc(CEp *e) {
   Py_XDECREF(e->core);
   Py_XDECREF(e->ctl_timer);
   Py_XDECREF(e->rto_timer);
+  Py_XDECREF(e->idle_timer);
   for (int i = 0; i < e->sendbuf.count; i++)
     Py_XDECREF(((SQEnt *)ring_at(&e->sendbuf, i))->payload);
   for (int i = 0; i < e->rtx.count; i++)
@@ -3463,6 +3601,66 @@ static PyObject *CEp_drop_fire(CEp *e, PyObject *noarg) {
   Py_RETURN_NONE;
 }
 
+static PyObject *CEp_idle_fire(CEp *e, PyObject *noarg) {
+  /* StreamEndpoint._idle_expired twin */
+  (void)noarg;
+  Py_CLEAR(e->idle_timer);
+  if (e->state == ST_CLOSED || e->state == ST_TIME_WAIT) Py_RETURN_NONE;
+  if (e->core->faults_active) cep_h(e)->d_timeouts++;
+  if (ce_reset(e, "connection timed out (ETIMEDOUT): idle timeout — no "
+                  "traffic from peer") < 0)
+    return NULL;
+  Py_RETURN_NONE;
+}
+
+static PyObject *CEp_set_idle_timeout(CEp *e, PyObject *arg) {
+  /* StreamEndpoint.set_idle_timeout twin: arm (or disarm with 0/None) */
+  int64_t t = 0;
+  if (arg != Py_None) {
+    t = PyLong_AsLongLong(arg);
+    if (t == -1 && PyErr_Occurred()) return NULL;
+  }
+  if (cep_cancel_timer(e, &e->idle_timer) < 0) return NULL;
+  e->idle_timeout_ns = t > 0 ? t : 0;
+  if (e->idle_timeout_ns &&
+      cep_schedule(e, e->idle_timeout_ns, S_idle_fire, &e->idle_timer) < 0)
+    return NULL;
+  Py_RETURN_NONE;
+}
+
+/* Host.crash teardown hooks (faults.py): the crash loop duck-types
+ * ep._cancel_ctl() / ep.sender._cancel_rto() — identical disarm
+ * semantics to the Python endpoint's private methods */
+static PyObject *CEp_cancel_ctl_m(CEp *e, PyObject *noarg) {
+  (void)noarg;
+  if (ce_cancel_ctl(e) < 0) return NULL;
+  Py_RETURN_NONE;
+}
+
+static PyObject *CEp_cancel_rto_m(CEp *e, PyObject *noarg) {
+  (void)noarg;
+  if (cep_cancel_timer(e, &e->rto_timer) < 0) return NULL;
+  Py_RETURN_NONE;
+}
+
+static PyObject *CEp_fingerprint(CEp *e, PyObject *noarg) {
+  /* StreamEndpoint.fingerprint twin for the determinism sentinel: the
+   * SAME 20 fields in the same order with the same Python types (bools
+   * stay bools — checkpoint._feed encodes them differently from ints),
+   * so digest streams are identical with the C engine on and off */
+  (void)noarg;
+  return Py_BuildValue(
+      "(iOiiOLLLLLLiLiiLLLLL)", e->state,
+      e->initiator ? Py_True : Py_False, e->syn_tries, e->fin_tries,
+      e->peer_fin ? Py_True : Py_False, (long long)e->snd_nxt,
+      (long long)e->snd_una, (long long)e->cwnd, (long long)e->ssthresh,
+      (long long)e->adv_wnd, (long long)e->buffered, e->retries,
+      (long long)e->rto_backoff, e->dup_acks, e->loss_events,
+      (long long)e->bytes_acked, (long long)e->rcv_nxt,
+      (long long)e->ooo_bytes, (long long)e->bytes_received,
+      (long long)e->last_wnd);
+}
+
 /* opt-in surface for the models/tgen.py fast path; Python-plane
  * endpoints don't have these attrs, so the model falls back to its
  * closure implementation (getattr probe) */
@@ -3626,6 +3824,9 @@ static PyGetSetDef CEp_getset[] = {
     {"tgen_t_first", (getter)CEp_get_tgen_t_first, NULL, NULL, NULL},
     {NULL, NULL, NULL, NULL, NULL}};
 
+static PyObject *CEp_export_state(CEp *e, PyObject *noarg);
+static PyObject *CEp_restore_state(CEp *e, PyObject *state);
+
 static PyMethodDef CEp_methods[] = {
     {"send", (PyCFunction)CEp_send, METH_VARARGS | METH_KEYWORDS, NULL},
     {"close", (PyCFunction)CEp_close, METH_NOARGS, NULL},
@@ -3643,6 +3844,17 @@ static PyMethodDef CEp_methods[] = {
     {"_syn_fire", (PyCFunction)CEp_syn_fire, METH_NOARGS, NULL},
     {"_fin_fire", (PyCFunction)CEp_fin_fire, METH_NOARGS, NULL},
     {"_drop_fire", (PyCFunction)CEp_drop_fire, METH_NOARGS, NULL},
+    {"_idle_fire", (PyCFunction)CEp_idle_fire, METH_NOARGS, NULL},
+    {"set_idle_timeout", (PyCFunction)CEp_set_idle_timeout, METH_O,
+     "arm (or disarm with 0/None) the idle timeout (transport.py twin)"},
+    {"_cancel_ctl", (PyCFunction)CEp_cancel_ctl_m, METH_NOARGS, NULL},
+    {"_cancel_rto", (PyCFunction)CEp_cancel_rto_m, METH_NOARGS, NULL},
+    {"fingerprint", (PyCFunction)CEp_fingerprint, METH_NOARGS,
+     "StreamEndpoint.fingerprint twin (determinism sentinel)"},
+    {"_export_state", (PyCFunction)CEp_export_state, METH_NOARGS,
+     "checkpoint export: full protocol state as a plain tuple"},
+    {"_restore_state", (PyCFunction)CEp_restore_state, METH_O,
+     "checkpoint restore: fill an orphan endpoint from _export_state"},
     {NULL, NULL, 0, NULL}};
 
 static PyTypeObject CEp_Type = {
@@ -3690,7 +3902,10 @@ static CEp *cep_new(CoreObject *c, int hid, int lport, int rhost, int rport,
   int32_t sn = c->hostnode[hid], dn = c->hostnode[rhost];
   int64_t rtt = c->lat[(int64_t)sn * c->G + dn] +
                 c->lat[(int64_t)dn * c->G + sn];
-  e->rto_ns = 2 * rtt > RTO_MIN_NS_C ? 2 * rtt : RTO_MIN_NS_C;
+  /* cap BEFORE doubling: rtt can be 2x INF_I64 on a cut path and 2*rtt
+   * would overflow int64 (the Python twin computes in big ints) */
+  e->rto_ns = rtt > RTO_MAX_NS_C / 2 ? RTO_MAX_NS_C
+              : (2 * rtt > RTO_MIN_NS_C ? 2 * rtt : RTO_MIN_NS_C);
   PyObject_GC_Track((PyObject *)e);
   return e;
 }
@@ -4548,6 +4763,16 @@ static void CExitStream_dealloc(CExitStream *s) {
   Py_TYPE(s)->tp_free((PyObject *)s);
 }
 
+static PyObject *CExitStream_export_state(CExitStream *s, PyObject *noarg);
+static PyObject *CExitStream_restore_state(CExitStream *s, PyObject *state);
+
+static PyMethodDef CExitStream_methods[] = {
+    {"_export_state", (PyCFunction)CExitStream_export_state, METH_NOARGS,
+     "checkpoint export (the owning endpoint re-links `ep` on restore)"},
+    {"_restore_state", (PyCFunction)CExitStream_restore_state, METH_O,
+     "checkpoint restore"},
+    {NULL, NULL, 0, NULL}};
+
 static PyTypeObject CExitStream_Type = {
     PyVarObject_HEAD_INIT(NULL, 0).tp_name = "_colcore.ExitStream",
     .tp_basicsize = sizeof(CExitStream),
@@ -4555,6 +4780,7 @@ static PyTypeObject CExitStream_Type = {
     .tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC,
     .tp_traverse = (traverseproc)CExitStream_traverse,
     .tp_clear = (inquiry)CExitStream_clear_gc,
+    .tp_methods = CExitStream_methods,
     .tp_free = PyObject_GC_Del,
     .tp_doc = "C tor-exit reframe stream (models/tor.py TorExit twin)",
 };
@@ -4591,7 +4817,14 @@ static PyObject *CRelay_stats(CRelayObj *r, PyObject *noarg) {
                        (long long)r->bytes_relayed);
 }
 
+static PyObject *CRelay_export_state(CRelayObj *r, PyObject *noarg);
+static PyObject *CRelay_restore_state(CRelayObj *r, PyObject *state);
+
 static PyMethodDef CRelay_methods[] = {
+    {"_export_state", (PyCFunction)CRelay_export_state, METH_NOARGS,
+     "checkpoint export: conns + circuit table + counters"},
+    {"_restore_state", (PyCFunction)CRelay_restore_state, METH_O,
+     "checkpoint restore (core binding comes via Core.adopt)"},
     {"add_conn", (PyCFunction)CRelay_add_conn, METH_O,
      "attach a C endpoint as a relay connection -> cid"},
     {"splice", (PyCFunction)CRelay_splice, METH_VARARGS,
@@ -4855,11 +5088,18 @@ static PyObject *CTorSink_write(CTorSink *s, PyObject *arg) {
   Py_RETURN_NONE;
 }
 
+static PyObject *CTorSink_export_state(CTorSink *s, PyObject *noarg);
+static PyObject *CTorSink_restore_state(CTorSink *s, PyObject *state);
+
 static PyMethodDef CTorSink_methods[] = {
     {"bytes_received", (PyCFunction)CTorSink_bytes_received, METH_NOARGS,
      "counted DATA body bytes received so far"},
     {"write", (PyCFunction)CTorSink_write, METH_O,
      "queue one framed cell through the C pending-write queue"},
+    {"_export_state", (PyCFunction)CTorSink_export_state, METH_NOARGS,
+     "checkpoint export: endpoint + frames + parser + pending queue"},
+    {"_restore_state", (PyCFunction)CTorSink_restore_state, METH_O,
+     "checkpoint restore (re-links ep->tsink)"},
     {NULL, NULL, 0, NULL}};
 
 static PyTypeObject CTorSink_Type = {
@@ -4912,6 +5152,736 @@ static PyObject *Core_tor_client_sink(CoreObject *c, PyObject *args) {
   return (PyObject *)s;
 }
 
+/* ======================================================================
+ * Checkpoint export/restore (shadow_tpu/checkpoint.py).
+ *
+ * Every C object that can be live at a round boundary — stream
+ * endpoints, tor relays/sinks/exit streams, gossip states, packed store
+ * batches — exports its COMPLETE state as plain Python structures
+ * (ints, bytes, lists, the callbacks themselves), and rebuilds from
+ * them: checkpoint._SimPickler reduces each object to
+ * (shell(kind), state, _restore_state) so shared references and
+ * reference cycles ride the pickle memo exactly like Python objects.
+ * Core pointers are NOT exported: Controller._reattach_runtime rebuilds
+ * the Core and binds the restored objects via Core.adopt(). The
+ * module-level ABI constant ties a checkpoint to this state format —
+ * the checkpoint header refuses a mismatch by name.
+ * ====================================================================== */
+
+static PyObject *ornone(PyObject *o) { return o ? o : Py_None; }
+
+/* -- ring export/restore helpers ---------------------------------------- */
+static PyObject *export_sq(Ring *r) {
+  PyObject *l = PyList_New(r->count);
+  if (!l) return NULL;
+  for (int i = 0; i < r->count; i++) {
+    SQEnt *q = ring_at(r, i);
+    PyObject *t = Py_BuildValue("(LO)", (long long)q->nbytes,
+                                ornone(q->payload));
+    if (!t) { Py_DECREF(l); return NULL; }
+    PyList_SET_ITEM(l, i, t);
+  }
+  return l;
+}
+
+static PyObject *export_rtx(Ring *r) {
+  PyObject *l = PyList_New(r->count);
+  if (!l) return NULL;
+  for (int i = 0; i < r->count; i++) {
+    RtxEnt *q = ring_at(r, i);
+    PyObject *t = Py_BuildValue("(LLO)", (long long)q->seq,
+                                (long long)q->n, ornone(q->payload));
+    if (!t) { Py_DECREF(l); return NULL; }
+    PyList_SET_ITEM(l, i, t);
+  }
+  return l;
+}
+
+static PyObject *export_pend(Ring *r) {
+  PyObject *l = PyList_New(r->count);
+  if (!l) return NULL;
+  for (int i = 0; i < r->count; i++) {
+    PendEnt *q = ring_at(r, i);
+    PyObject *t = Py_BuildValue("(OL)", ornone(q->payload),
+                                (long long)q->a);
+    if (!t) { Py_DECREF(l); return NULL; }
+    PyList_SET_ITEM(l, i, t);
+  }
+  return l;
+}
+
+static int restore_sq(Ring *r, PyObject *l) {
+  if (!PyList_Check(l)) {
+    PyErr_SetString(PyExc_TypeError, "restore: expected a list");
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < PyList_GET_SIZE(l); i++) {
+    long long n;
+    PyObject *pl;
+    if (!PyArg_ParseTuple(PyList_GET_ITEM(l, i), "LO", &n, &pl)) return -1;
+    SQEnt *q = ring_push(r);
+    if (!q) return -1;
+    q->nbytes = n;
+    q->payload = pl == Py_None ? NULL : (Py_INCREF(pl), pl);
+  }
+  return 0;
+}
+
+static int restore_rtx(Ring *r, PyObject *l) {
+  if (!PyList_Check(l)) {
+    PyErr_SetString(PyExc_TypeError, "restore: expected a list");
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < PyList_GET_SIZE(l); i++) {
+    long long seq, n;
+    PyObject *pl;
+    if (!PyArg_ParseTuple(PyList_GET_ITEM(l, i), "LLO", &seq, &n, &pl))
+      return -1;
+    RtxEnt *q = ring_push(r);
+    if (!q) return -1;
+    q->seq = seq;
+    q->n = n;
+    q->payload = pl == Py_None ? NULL : (Py_INCREF(pl), pl);
+  }
+  return 0;
+}
+
+static int restore_pend(Ring *r, PyObject *l) {
+  if (!PyList_Check(l)) {
+    PyErr_SetString(PyExc_TypeError, "restore: expected a list");
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < PyList_GET_SIZE(l); i++) {
+    long long a;
+    PyObject *pl;
+    if (!PyArg_ParseTuple(PyList_GET_ITEM(l, i), "OL", &pl, &a)) return -1;
+    PendEnt *q = ring_push(r);
+    if (!q) return -1;
+    q->a = a;
+    q->payload = pl == Py_None ? NULL : (Py_INCREF(pl), pl);
+  }
+  return 0;
+}
+
+/* -- shells (empty objects the unpickler fills via _restore_state) ------- */
+static CEp *cep_shell(void) {
+  CEp *e = PyObject_GC_New(CEp, &CEp_Type);
+  if (!e) return NULL;
+  memset(((char *)e) + sizeof(PyObject), 0, sizeof(CEp) - sizeof(PyObject));
+  e->sendbuf.esz = sizeof(SQEnt);
+  e->rtx.esz = sizeof(RtxEnt);
+  e->ooo.esz = sizeof(RtxEnt);
+  e->tgen_t_first = -1;
+  PyObject_GC_Track((PyObject *)e);
+  return e;
+}
+
+static CRelayObj *relay_shell(void) {
+  CRelayObj *r = PyObject_GC_New(CRelayObj, &CRelay_Type);
+  if (!r) return NULL;
+  memset(((char *)r) + sizeof(PyObject), 0,
+         sizeof(CRelayObj) - sizeof(PyObject));
+  r->next_circ = 1;
+  PyObject_GC_Track((PyObject *)r);
+  return r;
+}
+
+static CTorSink *tsink_shell(void) {
+  CTorSink *s = PyObject_GC_New(CTorSink, &CTorSink_Type);
+  if (!s) return NULL;
+  memset(((char *)s) + sizeof(PyObject), 0,
+         sizeof(CTorSink) - sizeof(PyObject));
+  s->pend.esz = sizeof(PendEnt);
+  PyObject_GC_Track((PyObject *)s);
+  return s;
+}
+
+static CExitStream *xstream_shell(void) {
+  CExitStream *s = PyObject_GC_New(CExitStream, &CExitStream_Type);
+  if (!s) return NULL;
+  memset(((char *)s) + sizeof(PyObject), 0,
+         sizeof(CExitStream) - sizeof(PyObject));
+  PyObject_GC_Track((PyObject *)s);
+  return s;
+}
+
+static GossipState *gossip_shell(void) {
+  GossipState *g = PyObject_GC_New(GossipState, &GossipState_Type);
+  if (!g) return NULL;
+  g->core = NULL;
+  g->hid = 0;
+  g->port = 0;
+  g->port_obj = NULL;
+  g->peers = NULL;
+  g->npeers = 0;
+  memset(&g->seen, 0, sizeof g->seen);
+  g->received_tx = 0;
+  g->next_dgram = 0;
+  PyObject_GC_Track((PyObject *)g);
+  return g;
+}
+
+/* -- CEp export/restore (47 positional fields; ABI-guarded) -------------- */
+static PyObject *CEp_export_state(CEp *e, PyObject *noarg) {
+  (void)noarg;
+  PyObject *sb = export_sq(&e->sendbuf);
+  PyObject *rt = sb ? export_rtx(&e->rtx) : NULL;
+  PyObject *oo = rt ? export_rtx(&e->ooo) : NULL;
+  if (!oo) {
+    Py_XDECREF(sb);
+    Py_XDECREF(rt);
+    return NULL;
+  }
+  return Py_BuildValue(
+      "(iiiiOiiiOLOLLLLLLLLLLiiONNLLLLLiNOOOOOOiLLOLOLO)",
+      e->hid, e->local_port, e->remote_host, e->remote_port,
+      e->initiator ? Py_True : Py_False, e->state, e->syn_tries,
+      e->fin_tries, e->peer_fin ? Py_True : Py_False,
+      (long long)e->rto_ns, ornone(e->ctl_timer), (long long)e->chunk,
+      (long long)e->cwnd, (long long)e->ssthresh,
+      (long long)e->send_buffer, (long long)e->snd_nxt,
+      (long long)e->snd_una, (long long)e->adv_wnd,
+      (long long)e->buffered, (long long)e->bytes_acked,
+      (long long)e->rto_backoff, e->retries, e->loss_events,
+      ornone(e->rto_timer), sb, rt, (long long)e->recv_buffer,
+      (long long)e->rcv_nxt, (long long)e->ooo_bytes,
+      (long long)e->bytes_received, (long long)e->last_wnd, e->dup_acks,
+      oo, ornone(e->app_unread), ornone(e->on_connected),
+      ornone(e->on_data), ornone(e->on_drain), ornone(e->on_close),
+      ornone(e->on_error), e->tgen_mode, (long long)e->tgen_pending,
+      (long long)e->tgen_want, ornone(e->tgen_cb),
+      (long long)e->tgen_t_first, ornone(e->xsink),
+      (long long)e->idle_timeout_ns, ornone(e->idle_timer));
+}
+
+static PyObject *CEp_restore_state(CEp *e, PyObject *state) {
+  int hid, lport, rhost, rport, initiator, st, syn_tries, fin_tries,
+      peer_fin, retries, loss_events, dup_acks, tgen_mode;
+  long long rto_ns, chunk, cwnd, ssthresh, sbuf, snd_nxt, snd_una,
+      adv_wnd, buffered, bytes_acked, rto_backoff, rbuf, rcv_nxt,
+      ooo_bytes, bytes_received, last_wnd, tgen_pending, tgen_want,
+      tgen_t_first, idle_ns;
+  PyObject *ctl_t, *rto_t, *sb, *rt, *oo, *app_unread, *on_connected,
+      *on_data, *on_drain, *on_close, *on_error, *tgen_cb, *xs, *idle_t;
+  if (!PyArg_ParseTuple(
+          state, "iiiiiiiiiLOLLLLLLLLLLiiOOOLLLLLiOOOOOOOiLLOLOLO",
+          &hid, &lport, &rhost, &rport, &initiator, &st, &syn_tries,
+          &fin_tries, &peer_fin, &rto_ns, &ctl_t, &chunk, &cwnd,
+          &ssthresh, &sbuf, &snd_nxt, &snd_una, &adv_wnd, &buffered,
+          &bytes_acked, &rto_backoff, &retries, &loss_events, &rto_t,
+          &sb, &rt, &rbuf, &rcv_nxt, &ooo_bytes, &bytes_received,
+          &last_wnd, &dup_acks, &oo, &app_unread, &on_connected,
+          &on_data, &on_drain, &on_close, &on_error, &tgen_mode,
+          &tgen_pending, &tgen_want, &tgen_cb, &tgen_t_first, &xs,
+          &idle_ns, &idle_t))
+    return NULL;
+  e->hid = hid;
+  e->local_port = lport;
+  e->remote_host = rhost;
+  e->remote_port = rport;
+  e->initiator = initiator;
+  e->state = st;
+  e->syn_tries = syn_tries;
+  e->fin_tries = fin_tries;
+  e->peer_fin = peer_fin;
+  e->rto_ns = rto_ns;
+  e->chunk = chunk;
+  e->cwnd = cwnd;
+  e->ssthresh = ssthresh;
+  e->send_buffer = sbuf;
+  e->snd_nxt = snd_nxt;
+  e->snd_una = snd_una;
+  e->adv_wnd = adv_wnd;
+  e->buffered = buffered;
+  e->bytes_acked = bytes_acked;
+  e->rto_backoff = rto_backoff;
+  e->retries = retries;
+  e->loss_events = loss_events;
+  e->recv_buffer = rbuf;
+  e->rcv_nxt = rcv_nxt;
+  e->ooo_bytes = ooo_bytes;
+  e->bytes_received = bytes_received;
+  e->last_wnd = last_wnd;
+  e->dup_acks = dup_acks;
+  e->tgen_mode = tgen_mode;
+  e->tgen_pending = tgen_pending;
+  e->tgen_want = tgen_want;
+  e->tgen_t_first = tgen_t_first;
+  e->idle_timeout_ns = idle_ns;
+#define EP_SLOT(slot, v)                                \
+  do {                                                  \
+    PyObject *nv = (v) == Py_None ? NULL : (v);         \
+    Py_XINCREF(nv);                                     \
+    Py_XSETREF(slot, nv);                               \
+  } while (0)
+  EP_SLOT(e->ctl_timer, ctl_t);
+  EP_SLOT(e->rto_timer, rto_t);
+  EP_SLOT(e->idle_timer, idle_t);
+  EP_SLOT(e->app_unread, app_unread);
+  EP_SLOT(e->on_connected, on_connected);
+  EP_SLOT(e->on_data, on_data);
+  EP_SLOT(e->on_drain, on_drain);
+  EP_SLOT(e->on_close, on_close);
+  EP_SLOT(e->on_error, on_error);
+  EP_SLOT(e->tgen_cb, tgen_cb);
+#undef EP_SLOT
+  if (restore_sq(&e->sendbuf, sb) < 0) return NULL;
+  if (restore_rtx(&e->rtx, rt) < 0) return NULL;
+  if (restore_rtx(&e->ooo, oo) < 0) return NULL;
+  if (xs != Py_None) {
+    if (Py_TYPE(xs) != &CExitStream_Type) {
+      PyErr_SetString(PyExc_TypeError,
+                      "endpoint restore: xsink is not an ExitStream");
+      return NULL;
+    }
+    Py_INCREF(xs);
+    Py_XSETREF(e->xsink, xs);
+    ((CExitStream *)xs)->ep = e; /* borrowed back-pointer (owner = us) */
+  }
+  Py_RETURN_NONE;
+}
+
+/* -- CRelay export/restore ------------------------------------------------ */
+typedef struct { uint64_t ts, k, v; } TExp;
+
+static int cmp_texp(const void *a, const void *b) {
+  uint64_t x = ((const TExp *)a)->ts, y = ((const TExp *)b)->ts;
+  return (x > y) - (x < y);
+}
+
+static PyObject *CRelay_export_state(CRelayObj *r, PyObject *noarg) {
+  (void)noarg;
+  PyObject *conns = PyList_New(r->nconns);
+  if (!conns) return NULL;
+  for (int i = 0; i < r->nconns; i++) {
+    CRelayConn *rc = r->conns[i];
+    if (!rc) {
+      Py_INCREF(Py_None);
+      PyList_SET_ITEM(conns, i, Py_None);
+      continue;
+    }
+    PyObject *pend = export_pend(&rc->pend);
+    if (!pend) { Py_DECREF(conns); return NULL; }
+    PyObject *buf = PyBytes_FromStringAndSize(
+        rc->buf ? rc->buf : "", (Py_ssize_t)rc->buf_len);
+    if (!buf) { Py_DECREF(pend); Py_DECREF(conns); return NULL; }
+    PyObject *t = Py_BuildValue("(OiNLiN)", (PyObject *)rc->ep,
+                                rc->close_after_drain, buf,
+                                (long long)rc->body_left, rc->body_circ,
+                                pend);
+    if (!t) { Py_DECREF(conns); return NULL; }
+    PyList_SET_ITEM(conns, i, t);
+  }
+  /* circuit table in dict insertion order (the ts seq) */
+  TExp *te = malloc(sizeof(TExp) * (size_t)(r->tcount ? r->tcount : 1));
+  if (!te) { Py_DECREF(conns); return PyErr_NoMemory(); }
+  int m = 0;
+  for (int i = 0; i < r->tcap; i++) {
+    if (!r->tk[i]) continue;
+    te[m].ts = r->ts[i];
+    te[m].k = r->tk[i];
+    te[m].v = r->tv[i];
+    m++;
+  }
+  if (m > 1) qsort(te, (size_t)m, sizeof(TExp), cmp_texp);
+  PyObject *tab = PyList_New(m);
+  if (!tab) { free(te); Py_DECREF(conns); return NULL; }
+  for (int i = 0; i < m; i++) {
+    uint64_t k = te[i].k - 1;
+    PyObject *t = Py_BuildValue("(iiii)", (int)(k >> 32),
+                                (int)(uint32_t)k, (int)(te[i].v >> 32),
+                                (int)(uint32_t)te[i].v);
+    if (!t) { free(te); Py_DECREF(tab); Py_DECREF(conns); return NULL; }
+    PyList_SET_ITEM(tab, i, t);
+  }
+  free(te);
+  return Py_BuildValue("(iOiiLLNN)", r->hid, ornone(r->on_ctrl),
+                       r->exit_mode, r->next_circ,
+                       (long long)r->cells_relayed,
+                       (long long)r->bytes_relayed, conns, tab);
+}
+
+static PyObject *CRelay_restore_state(CRelayObj *r, PyObject *state) {
+  int hid, exit_mode, next_circ;
+  long long cells, nbytes;
+  PyObject *on_ctrl, *conns, *tab;
+  if (!PyArg_ParseTuple(state, "iOiiLLOO", &hid, &on_ctrl, &exit_mode,
+                        &next_circ, &cells, &nbytes, &conns, &tab))
+    return NULL;
+  if (!PyList_Check(conns) || !PyList_Check(tab)) {
+    PyErr_SetString(PyExc_TypeError, "relay restore: expected lists");
+    return NULL;
+  }
+  r->hid = hid;
+  if (on_ctrl != Py_None) {
+    Py_INCREF(on_ctrl);
+    Py_XSETREF(r->on_ctrl, on_ctrl);
+  }
+  r->exit_mode = exit_mode;
+  r->next_circ = next_circ;
+  r->cells_relayed = cells;
+  r->bytes_relayed = nbytes;
+  int n = (int)PyList_GET_SIZE(conns);
+  r->conns = calloc((size_t)(n ? n : 1), sizeof(CRelayConn *));
+  if (!r->conns) return PyErr_NoMemory();
+  r->conns_cap = n ? n : 1;
+  r->nconns = n;
+  for (int i = 0; i < n; i++) {
+    PyObject *it = PyList_GET_ITEM(conns, i);
+    if (it == Py_None) continue;
+    PyObject *ep, *buf, *pend;
+    int cad, bcirc;
+    long long bleft;
+    if (!PyArg_ParseTuple(it, "OiOLiO", &ep, &cad, &buf, &bleft, &bcirc,
+                          &pend))
+      return NULL;
+    if (Py_TYPE(ep) != &CEp_Type || !PyBytes_Check(buf)) {
+      PyErr_SetString(PyExc_TypeError,
+                      "relay restore: bad conn entry types");
+      return NULL;
+    }
+    CRelayConn *rc = calloc(1, sizeof(CRelayConn));
+    if (!rc) return PyErr_NoMemory();
+    rc->relay = r;
+    Py_INCREF(ep);
+    rc->ep = (CEp *)ep;
+    rc->cid = i;
+    rc->close_after_drain = cad;
+    rc->body_left = bleft;
+    rc->body_circ = bcirc;
+    rc->pend.esz = sizeof(PendEnt);
+    r->conns[i] = rc; /* registered first: dealloc cleans up on error */
+    Py_ssize_t bl = PyBytes_GET_SIZE(buf);
+    if (bl) {
+      rc->buf = malloc((size_t)bl);
+      if (!rc->buf) return PyErr_NoMemory();
+      memcpy(rc->buf, PyBytes_AS_STRING(buf), (size_t)bl);
+      rc->buf_len = bl;
+      rc->buf_cap = bl;
+    }
+    if (restore_pend(&rc->pend, pend) < 0) return NULL;
+    ((CEp *)ep)->sink = rc;
+  }
+  for (Py_ssize_t i = 0; i < PyList_GET_SIZE(tab); i++) {
+    int cid, circ, ncid, ncirc;
+    if (!PyArg_ParseTuple(PyList_GET_ITEM(tab, i), "iiii", &cid, &circ,
+                          &ncid, &ncirc))
+      return NULL;
+    if (rtab_put(r, cid, circ, ncid, ncirc) < 0) return NULL;
+  }
+  Py_RETURN_NONE;
+}
+
+/* -- CTorSink / CExitStream export/restore -------------------------------- */
+static PyObject *CTorSink_export_state(CTorSink *s, PyObject *noarg) {
+  (void)noarg;
+  PyObject *pend = export_pend(&s->pend);
+  if (!pend) return NULL;
+  PyObject *buf = PyBytes_FromStringAndSize(s->buf ? s->buf : "",
+                                            (Py_ssize_t)s->buf_len);
+  if (!buf) { Py_DECREF(pend); return NULL; }
+  return Py_BuildValue("(OOOiNNLL)", ornone((PyObject *)s->ep),
+                       ornone(s->on_cell), ornone(s->frames), s->stage,
+                       pend, buf, (long long)s->body_left,
+                       (long long)s->got);
+}
+
+static PyObject *CTorSink_restore_state(CTorSink *s, PyObject *state) {
+  PyObject *ep, *on_cell, *frames, *pend, *buf;
+  int stage;
+  long long bleft, got;
+  if (!PyArg_ParseTuple(state, "OOOiOOLL", &ep, &on_cell, &frames,
+                        &stage, &pend, &buf, &bleft, &got))
+    return NULL;
+  if (ep == Py_None || Py_TYPE(ep) != &CEp_Type || !PyBytes_Check(buf)) {
+    PyErr_SetString(PyExc_TypeError, "tor-sink restore: bad state types");
+    return NULL;
+  }
+  Py_INCREF(ep);
+  Py_XSETREF(s->ep, (CEp *)ep);
+  s->ep->tsink = s; /* the borrowed back-pointer the data path follows */
+  if (on_cell != Py_None) {
+    Py_INCREF(on_cell);
+    Py_XSETREF(s->on_cell, on_cell);
+  }
+  if (frames != Py_None) {
+    Py_INCREF(frames);
+    Py_XSETREF(s->frames, frames);
+  }
+  s->stage = stage;
+  if (restore_pend(&s->pend, pend) < 0) return NULL;
+  Py_ssize_t bl = PyBytes_GET_SIZE(buf);
+  if (bl) {
+    s->buf = malloc((size_t)bl);
+    if (!s->buf) return PyErr_NoMemory();
+    memcpy(s->buf, PyBytes_AS_STRING(buf), (size_t)bl);
+    s->buf_len = bl;
+    s->buf_cap = bl;
+  }
+  s->body_left = bleft;
+  s->got = got;
+  Py_RETURN_NONE;
+}
+
+static PyObject *CExitStream_export_state(CExitStream *s, PyObject *noarg) {
+  (void)noarg;
+  return Py_BuildValue("(OiiiLL)", ornone((PyObject *)s->relay), s->cid,
+                       s->circ, s->done, (long long)s->want,
+                       (long long)s->got);
+}
+
+static PyObject *CExitStream_restore_state(CExitStream *s,
+                                           PyObject *state) {
+  PyObject *relay;
+  int cid, circ, done;
+  long long want, got;
+  if (!PyArg_ParseTuple(state, "OiiiLL", &relay, &cid, &circ, &done,
+                        &want, &got))
+    return NULL;
+  if (relay == Py_None || Py_TYPE(relay) != &CRelay_Type) {
+    PyErr_SetString(PyExc_TypeError,
+                    "exit-stream restore: relay is not a Relay");
+    return NULL;
+  }
+  Py_INCREF(relay);
+  Py_XSETREF(s->relay, (CRelayObj *)relay);
+  s->cid = cid;
+  s->circ = circ;
+  s->done = done;
+  s->want = want;
+  s->got = got;
+  /* s->ep is set by the OWNING endpoint's _restore_state */
+  Py_RETURN_NONE;
+}
+
+/* -- GossipState export/restore ------------------------------------------- */
+typedef struct { uint32_t off; uint16_t len; } SeenExp;
+
+static int cmp_seen_off(const void *a, const void *b) {
+  uint32_t x = ((const SeenExp *)a)->off, y = ((const SeenExp *)b)->off;
+  return (x > y) - (x < y);
+}
+
+static PyObject *Gossip_export_state(GossipState *g, PyObject *noarg) {
+  (void)noarg;
+  PyObject *peers = PyList_New(g->npeers);
+  if (!peers) return NULL;
+  for (int i = 0; i < g->npeers; i++) {
+    PyObject *v = PyLong_FromLong(g->peers[i]);
+    if (!v) { Py_DECREF(peers); return NULL; }
+    PyList_SET_ITEM(peers, i, v);
+  }
+  /* seen keys in ARENA (insertion) order so re-adding reproduces the
+   * identical arena layout */
+  SeenSet *ss = &g->seen;
+  size_t cnt = ss->count;
+  SeenExp *se = malloc(sizeof(SeenExp) * (cnt ? cnt : 1));
+  if (!se) { Py_DECREF(peers); return PyErr_NoMemory(); }
+  size_t m = 0;
+  for (size_t i = 0; ss->hash && i < ss->cap; i++) {
+    if (!ss->hash[i]) continue;
+    se[m].off = ss->off[i];
+    se[m].len = ss->len[i];
+    m++;
+  }
+  if (m > 1) qsort(se, m, sizeof(SeenExp), cmp_seen_off);
+  PyObject *seen = PyList_New((Py_ssize_t)m);
+  if (!seen) { free(se); Py_DECREF(peers); return NULL; }
+  for (size_t i = 0; i < m; i++) {
+    PyObject *b = PyBytes_FromStringAndSize(ss->arena + se[i].off,
+                                            (Py_ssize_t)se[i].len);
+    if (!b) { free(se); Py_DECREF(seen); Py_DECREF(peers); return NULL; }
+    PyList_SET_ITEM(seen, (Py_ssize_t)i, b);
+  }
+  free(se);
+  return Py_BuildValue("(iiNNLL)", g->hid, g->port, peers, seen,
+                       (long long)g->received_tx,
+                       (long long)g->next_dgram);
+}
+
+static PyObject *Gossip_restore_state(GossipState *g, PyObject *state) {
+  int hid, port;
+  PyObject *peers, *seen;
+  long long rtx, nd;
+  if (!PyArg_ParseTuple(state, "iiOOLL", &hid, &port, &peers, &seen,
+                        &rtx, &nd))
+    return NULL;
+  if (!PyList_Check(peers) || !PyList_Check(seen)) {
+    PyErr_SetString(PyExc_TypeError, "gossip restore: expected lists");
+    return NULL;
+  }
+  g->hid = hid;
+  g->port = port;
+  PyObject *po = PyLong_FromLong(port);
+  if (!po) return NULL;
+  Py_XSETREF(g->port_obj, po);
+  Py_ssize_t np = PyList_GET_SIZE(peers);
+  free(g->peers);
+  g->peers = malloc(sizeof(int32_t) * (size_t)(np ? np : 1));
+  if (!g->peers) { g->npeers = 0; return PyErr_NoMemory(); }
+  g->npeers = (int)np;
+  for (Py_ssize_t i = 0; i < np; i++) {
+    g->peers[i] =
+        (int32_t)PyLong_AsLongLong(PyList_GET_ITEM(peers, i));
+  }
+  if (PyErr_Occurred()) return NULL;
+  seen_free(&g->seen);
+  if (seen_init(&g->seen) < 0) return PyErr_NoMemory();
+  for (Py_ssize_t i = 0; i < PyList_GET_SIZE(seen); i++) {
+    PyObject *b = PyList_GET_ITEM(seen, i);
+    char *kb;
+    Py_ssize_t kn;
+    if (PyBytes_AsStringAndSize(b, &kb, &kn) < 0) return NULL;
+    if (seen_add(&g->seen, kb, kn) < 0) return PyErr_NoMemory();
+  }
+  g->received_tx = rtx;
+  g->next_dgram = nd;
+  Py_RETURN_NONE;
+}
+
+/* -- CBatch export/restore ------------------------------------------------ */
+static PyObject *CBatch_export_rows(CBatch *b, PyObject *noarg) {
+  (void)noarg;
+  PyObject *rows = PyList_New(b->n);
+  if (!rows) return NULL;
+  for (int i = 0; i < b->n; i++) {
+    PyObject *t = srec_tuple(&b->recs[i], b->pay[i]);
+    if (!t) { Py_DECREF(rows); return NULL; }
+    PyList_SET_ITEM(rows, i, t);
+  }
+  return Py_BuildValue("(iN)", b->pos, rows);
+}
+
+static PyObject *CBatch_restore_state(CBatch *b, PyObject *state) {
+  int pos;
+  PyObject *rows;
+  if (!PyArg_ParseTuple(state, "iO", &pos, &rows)) return NULL;
+  if (!PyList_Check(rows)) {
+    PyErr_SetString(PyExc_TypeError, "batch restore: expected a list");
+    return NULL;
+  }
+  int n = (int)PyList_GET_SIZE(rows);
+  for (int i = 0; i < b->n; i++) Py_CLEAR(b->pay[i]);
+  free(b->recs);
+  free(b->pay);
+  b->n = 0;
+  b->recs = malloc(sizeof(SRec) * (size_t)(n ? n : 1));
+  b->pay = calloc((size_t)(n ? n : 1), sizeof(PyObject *));
+  if (!b->recs || !b->pay) return PyErr_NoMemory();
+  b->n = n;
+  b->pos = pos;
+  for (int i = 0; i < n; i++) {
+    PyObject *r = PyList_GET_ITEM(rows, i);
+    if (!PyTuple_Check(r) || PyTuple_GET_SIZE(r) != 13) {
+      PyErr_SetString(PyExc_TypeError,
+                      "batch restore: rows must be 13-tuples");
+      return NULL;
+    }
+    SRec *s = &b->recs[i];
+    s->t = tup_i64(r, 0);
+    s->key = tup_i64(r, 1);
+    s->tgt = (int32_t)tup_i64(r, 2);
+    s->kind = (int16_t)tup_i64(r, 3);
+    s->peer = (int32_t)tup_i64(r, 4);
+    s->aport = (int32_t)tup_i64(r, 5);
+    s->bport = (int32_t)tup_i64(r, 6);
+    s->nbytes = tup_i64(r, 7);
+    s->seq = tup_i64(r, 8);
+    s->frag = (int32_t)tup_i64(r, 9);
+    s->nfrags = (int32_t)tup_i64(r, 10);
+    s->size = (int32_t)tup_i64(r, 11);
+    PyObject *pl = PyTuple_GET_ITEM(r, 12);
+    if (pl != Py_None) {
+      Py_INCREF(pl);
+      b->pay[i] = pl;
+    }
+  }
+  if (PyErr_Occurred()) return NULL;
+  Py_RETURN_NONE;
+}
+
+/* -- shell factory + adoption --------------------------------------------- */
+static PyObject *mod_shell(PyObject *self, PyObject *arg) {
+  (void)self;
+  const char *k = PyUnicode_AsUTF8(arg);
+  if (!k) return NULL;
+  if (!strcmp(k, "Endpoint")) return (PyObject *)cep_shell();
+  if (!strcmp(k, "Relay")) return (PyObject *)relay_shell();
+  if (!strcmp(k, "TorSink")) return (PyObject *)tsink_shell();
+  if (!strcmp(k, "ExitStream")) return (PyObject *)xstream_shell();
+  if (!strcmp(k, "GossipState")) return (PyObject *)gossip_shell();
+  if (!strcmp(k, "CBatch")) return (PyObject *)cbatch_new(0);
+  return PyErr_Format(PyExc_ValueError, "unknown colcore shell kind %s",
+                      k);
+}
+
+static PyObject *Core_adopt(CoreObject *c, PyObject *arg) {
+  PyObject *seq = PySequence_Fast(
+      arg, "adopt expects a sequence of restored C objects");
+  if (!seq) return NULL;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *o = PySequence_Fast_GET_ITEM(seq, i);
+    if (Py_TYPE(o) == &CEp_Type) {
+      CEp *e = (CEp *)o;
+      if (e->hid < 0 || e->hid >= c->H || e->remote_host < 0 ||
+          e->remote_host >= c->H) {
+        Py_DECREF(seq);
+        PyErr_SetString(PyExc_ValueError,
+                        "restored endpoint host id out of range");
+        return NULL;
+      }
+      Py_INCREF(c);
+      CoreObject *old = e->core;
+      e->core = c;
+      Py_XDECREF(old);
+    } else if (Py_TYPE(o) == &GossipState_Type) {
+      GossipState *g = (GossipState *)o;
+      if (g->hid < 0 || g->hid >= c->H) {
+        Py_DECREF(seq);
+        PyErr_SetString(PyExc_ValueError,
+                        "restored gossip host id out of range");
+        return NULL;
+      }
+      CHost *h = &c->hs[g->hid];
+      int have = 0;
+      for (int j = 0; j < h->nports; j++)
+        if (h->gs[j] == g) have = 1;
+      if (!have) {
+        if (h->nports >= 4) {
+          Py_DECREF(seq);
+          PyErr_SetString(PyExc_ValueError,
+                          "too many C ports on one host (restore)");
+          return NULL;
+        }
+        h->port[h->nports] = g->port;
+        Py_INCREF(g);
+        h->gs[h->nports] = g;
+        h->nports++;
+      }
+      Py_INCREF(c);
+      CoreObject *old = g->core;
+      g->core = c;
+      Py_XDECREF(old);
+    } else if (Py_TYPE(o) == &CRelay_Type) {
+      CRelayObj *r = (CRelayObj *)o;
+      if (r->hid < 0 || r->hid >= c->H) {
+        Py_DECREF(seq);
+        PyErr_SetString(PyExc_ValueError,
+                        "restored relay host id out of range");
+        return NULL;
+      }
+      Py_INCREF(c);
+      CoreObject *old = r->core;
+      r->core = c;
+      Py_XDECREF(old);
+    }
+    /* CBatch / TorSink / ExitStream carry no core pointer */
+  }
+  Py_DECREF(seq);
+  Py_RETURN_NONE;
+}
+
 /* ---- module ------------------------------------------------------------ */
 static PyObject *mod_unit_dropped(PyObject *self, PyObject *args) {
   (void)self;
@@ -4943,6 +5913,9 @@ static PyMethodDef module_methods[] = {
     {"perf_dump", mod_perf_dump, METH_NOARGS, "drain section timers"},
     {"unit_dropped", mod_unit_dropped, METH_VARARGS,
      "(seed, uid, npk, thresh) -> bool  (test hook: fluid.loss_flags twin)"},
+    {"shell", mod_shell, METH_O,
+     "(type name) -> empty C object for checkpoint restore "
+     "(filled via _restore_state; see shadow_tpu/checkpoint.py)"},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef colcore_module = {
@@ -4981,6 +5954,9 @@ PyMODINIT_FUNC PyInit__colcore(void) {
   INTERN(S_n_dgrams, "_n_dgrams");
   INTERN(S_n_dgrams_recv, "_n_dgrams_recv");
   INTERN(S_n_events, "_n_events");
+  INTERN(S_n_teardown, "_n_teardown");
+  INTERN(S_n_blackholed, "_n_blackholed");
+  INTERN(S_down, "down");
   INTERN(S_dispatch, "dispatch");
   INTERN(S_schedule_in, "schedule_in");
   INTERN(S_cancel_m, "cancel");
@@ -4988,6 +5964,7 @@ PyMODINIT_FUNC PyInit__colcore(void) {
   INTERN(S_syn_fire, "_syn_fire");
   INTERN(S_fin_fire, "_fin_fire");
   INTERN(S_drop_fire, "_drop_fire");
+  INTERN(S_idle_fire, "_idle_fire");
   INTERN(S_seq_ctr, "_seq");
   INTERN(S_on_first, "on_first");
 #undef INTERN
@@ -5005,6 +5982,11 @@ PyMODINIT_FUNC PyInit__colcore(void) {
     return NULL;
   PyObject *m = PyModule_Create(&colcore_module);
   if (!m) return NULL;
+  /* checkpoint state-format fingerprint (shadow_tpu/checkpoint.py): a
+   * checkpoint carrying C-engine state records this value in its header
+   * and loading refuses a mismatch by name. Bump on ANY change to the
+   * _export_state/_restore_state layouts. */
+  PyModule_AddIntConstant(m, "ABI", 1);
   Py_INCREF(&Core_Type);
   PyModule_AddObject(m, "Core", (PyObject *)&Core_Type);
   Py_INCREF(&GossipState_Type);

@@ -8,14 +8,18 @@ digest stream that turns "whole-run hash mismatch" debugging into a
 bisection (tools/bisect_divergence.py).
 
 Why whole-graph serialization works here: at a round *boundary* the entire
-simulation is quiescent Python state — host event heaps, transport endpoint
+simulation is quiescent state — host event heaps, transport endpoint
 machines, fluid bucket arrays, the columnar pending-arrival store,
-counter-based RNG generators, the fault-timeline cursor. The only
+counter-based RNG generators, the fault-timeline cursor. State held in C
+extension objects (native/colcore endpoints, tor sinks/relays, gossip
+states, packed store batches) exports to plain Python structures through
+per-type ``_export_state`` reducers and rebuilds on load (the header's
+``colcore`` ABI fingerprint refuses a mismatched build by name). The only
 non-snapshottable state is runtime plumbing (scheduler threads, the JAX
-device plane, the C engine, open pcap streams, real managed-process OS
-state), which is either rebuilt on restore (scheduler, device — both
-result-transparent by existing invariants) or refused up front with a clear
-error (managed processes, pcap).
+device plane, the Core object itself, open pcap streams, real
+managed-process OS state), which is either rebuilt on restore (scheduler,
+device, C core — all result-transparent by existing invariants) or refused
+up front with a clear error (managed processes, pcap).
 
 Before the state walk, ``engine.flush_all()`` materializes every in-flight
 loss-draw batch. Resolving draws early is result-identical by construction
@@ -69,10 +73,16 @@ from pathlib import Path
 import numpy as np
 
 FORMAT = "shadow_tpu-checkpoint"
-VERSION = 1
+#: version 2: the header gained the ``colcore`` build/ABI fingerprint and
+#: checkpoints may carry C-engine state (exported to plain structures by
+#: the reducers below). Version-1 checkpoints are refused by the version
+#: gate — see MIGRATION.md.
+VERSION = 2
 #: config keys that may legitimately differ between the checkpointing run
-#: and the resuming invocation (run-location and snapshot policy, never
-#: simulation semantics)
+#: and the resuming invocation (run-location, snapshot policy, and the
+#: data-plane implementation toggle — never simulation semantics:
+#: native_colcore is bit-identical on and off, and the resume HONORS the
+#: invocation's value by rebuilding — or not — the C core)
 VOLATILE_CONFIG_KEYS = (
     ("general", "data_directory"),
     ("general", "checkpoint_every"),
@@ -81,6 +91,7 @@ VOLATILE_CONFIG_KEYS = (
     ("general", "progress"),
     ("general", "heartbeat_interval"),
     ("general", "log_level"),
+    ("experimental", "native_colcore"),
 )
 
 DIGEST_FILE = "state_digests.jsonl"
@@ -93,15 +104,21 @@ class CheckpointError(ValueError):
 # -- closure-capable pickling -------------------------------------------------
 
 def _rebuild_function(code_bytes, module, name, defaults, kwdefaults,
-                      closure):
+                      closure, qualname=None):
     """Reconstruct a nested function/lambda from its marshaled code object.
     Globals are the (re-imported) defining module's dict — all model and
-    simulator code is importable, which the save path verified."""
+    simulator code is importable, which the save path verified. The
+    original ``__qualname__`` is restored explicitly: on Python < 3.11
+    FunctionType derives it from ``co_name``, and a rebuilt closure that
+    lost its ``<locals>`` marker would fool the reducer's importability
+    test at the NEXT checkpoint (a resumed run that checkpoints again)."""
     glb = importlib.import_module(module).__dict__ if module else {}
     fn = types.FunctionType(marshal.loads(code_bytes), glb, name,
                             defaults, closure)
     if kwdefaults:
         fn.__kwdefaults__ = kwdefaults
+    if qualname:
+        fn.__qualname__ = qualname
     return fn
 
 
@@ -112,6 +129,82 @@ def _make_cell():
 def _cell_set(cell, state):
     if state:  # () = the cell was empty (declared but never bound)
         cell.cell_contents = state[0]
+
+
+# -- C-engine state (native/colcore) ------------------------------------------
+#
+# A run with the C engine attached holds live state in C extension objects:
+# stream endpoints (CEp), tor relays/sinks/exit streams, gossip states, and
+# packed store batches. Each exports its COMPLETE state as plain Python
+# structures via ``_export_state`` and rebuilds from them — the pickler
+# reduces every C object to (shell, (), state, _colcore_setstate), so
+# shared references and reference cycles ride the memo exactly like Python
+# objects. Core pointers are never pickled: ``Controller._reattach_runtime``
+# rebuilds the core and binds the restored objects via ``Core.adopt``
+# (finish_colcore_adopt below). Packed store batches reduce to the plain
+# StoreBatch row-list form — the plane-neutral representation either plane
+# can resume from.
+
+#: restored C objects awaiting a core binding; drained by
+#: finish_colcore_adopt after _reattach_runtime rebuilds the C engine
+_PENDING_ADOPT: list = []
+#: colcore type names whose instances need a core pointer at adopt time
+_ADOPT_KINDS = frozenset(("Endpoint", "GossipState", "Relay"))
+
+
+def _colcore_shell(kind):
+    from shadow_tpu.native import _colcore
+
+    return _colcore.shell(kind)
+
+
+def _colcore_setstate(obj, state):
+    obj._restore_state(state)
+    if type(obj).__name__ in _ADOPT_KINDS:
+        _PENDING_ADOPT.append(obj)
+
+
+def _rebuild_storebatch(rows, pos):
+    from shadow_tpu.network.colplane import StoreBatch
+
+    b = StoreBatch(rows)
+    b.pos = pos
+    return b
+
+
+class _DeadCoreHandle:
+    """Stands in for a pickled reference to the old C core (reachable only
+    through activation-hook closures): _reattach_runtime rewires every
+    hook to the fresh core before the simulation resumes, so any call that
+    reaches this object is a wiring bug — fail by name."""
+
+    def __getattr__(self, name):
+        def _dead(*_a, **_k):
+            raise CheckpointError(
+                f"stale C-core reference called ({name}) — "
+                f"_reattach_runtime did not rewire an activation hook")
+
+        return _dead
+
+
+def _dead_core():
+    return _DeadCoreHandle()
+
+
+def finish_colcore_adopt(controller) -> None:
+    """Bind every checkpoint-restored C object to the rebuilt core
+    (called by Controller._reattach_runtime after attach_colcore)."""
+    global _PENDING_ADOPT
+    pend, _PENDING_ADOPT = _PENDING_ADOPT, []
+    if not pend:
+        return
+    core = getattr(controller.engine, "_c", None)
+    if core is None:
+        raise CheckpointError(
+            "checkpoint contains C-engine state but no C core was rebuilt "
+            "— resume with experimental.native_colcore enabled on a tpu "
+            "policy (or re-checkpoint from a Python-plane run)")
+    core.adopt(pend)
 
 
 #: live runtime objects that must never appear in a checkpoint; hitting one
@@ -128,10 +221,33 @@ _FORBIDDEN = (
 
 class _SimPickler(pickle.Pickler):
     def reducer_override(self, obj):
+        tp = type(obj)
+        if getattr(tp, "__module__", None) == "_colcore":
+            name = tp.__name__
+            if name == "CBatch":
+                pos, rows = obj.export_rows()
+                return (_rebuild_storebatch, (rows, pos))
+            if name == "Core":
+                # only reachable through activation-hook closures; the
+                # restore path rebuilds a fresh core and rewires them
+                return (_dead_core, ())
+            if name in ("Endpoint", "Relay", "TorSink", "GossipState",
+                        "ExitStream"):
+                # shell first, state second: cycles (endpoint <-> relay
+                # <-> model callbacks) resolve through the pickle memo
+                return (_colcore_shell, (name,), obj._export_state(),
+                        None, None, _colcore_setstate)
+            raise CheckpointError(
+                f"cannot checkpoint colcore object of type {name!r}")
         if isinstance(obj, types.FunctionType):
             qn = getattr(obj, "__qualname__", "")
             if "<locals>" not in qn and "<lambda>" not in qn:
-                return NotImplemented  # importable: pickle by reference
+                # importable IF the module really exposes this object under
+                # its name (a checkpoint-rebuilt closure can carry a bare
+                # qualname on Python < 3.11); otherwise marshal it
+                m = sys.modules.get(obj.__module__ or "")
+                if m is not None and getattr(m, obj.__name__, None) is obj:
+                    return NotImplemented  # pickle by reference
             mod = obj.__module__
             if mod is None or mod not in sys.modules:
                 raise CheckpointError(
@@ -139,7 +255,8 @@ class _SimPickler(pickle.Pickler):
                     f"{mod!r} is not importable")
             return (_rebuild_function,
                     (marshal.dumps(obj.__code__), mod, obj.__name__,
-                     obj.__defaults__, obj.__kwdefaults__, obj.__closure__))
+                     obj.__defaults__, obj.__kwdefaults__, obj.__closure__,
+                     qn))
         if isinstance(obj, types.CellType):
             try:
                 state = (obj.cell_contents,)
@@ -175,9 +292,6 @@ def config_digest(cfg) -> str:
     }
     for section, key in VOLATILE_CONFIG_KEYS:
         doc[section].pop(key, None)
-    # checkpointing forces the pure-Python planes (same coercion faults
-    # apply), so the flag's incoming value is not semantic either
-    doc["experimental"].pop("native_colcore", None)
     blob = json.dumps(doc, sort_keys=True, default=str).encode()
     return hashlib.sha256(blob).hexdigest()
 
@@ -202,6 +316,14 @@ def save_checkpoint(controller, now: int) -> Path:
     ckpt_dir = Path(controller.ckpt_dir)
     ckpt_dir.mkdir(parents=True, exist_ok=True)
     path = checkpoint_path(ckpt_dir, now)
+    # colcore build/ABI fingerprint: when the C engine is attached the
+    # payload carries C-exported state, and resuming it on a mismatched
+    # colcore build must fail fast by name instead of diverging silently
+    colcore_abi = None
+    if getattr(eng, "_c", None) is not None:
+        from shadow_tpu.native import _colcore
+
+        colcore_abi = int(_colcore.ABI)
     header = {
         "format": FORMAT,
         "version": VERSION,
@@ -210,6 +332,7 @@ def save_checkpoint(controller, now: int) -> Path:
         "rounds": controller.rounds,
         "events": controller.events,
         "config_digest": config_digest(controller.cfg),
+        "colcore": colcore_abi,
     }
     tmp = path.with_suffix(".tmp")
     try:
@@ -258,6 +381,33 @@ def load_checkpoint(path, cfg=None, mirror_log: bool = True):
             f"{'.'.join(map(str, header.get('python', ())))}, running "
             f"{sys.version_info[0]}.{sys.version_info[1]} — marshaled "
             f"closures are not portable across interpreter versions")
+    want_abi = header.get("colcore")
+    if want_abi is not None:
+        # the payload carries C-engine state: the resume needs a colcore
+        # build with a matching state-format ABI, and the invocation must
+        # not disable the C engine (C tor/tgen sink state has no Python
+        # rebuild path — re-checkpoint from a Python-plane run to demote)
+        try:
+            from shadow_tpu.native import _colcore
+        except ImportError as exc:
+            raise CheckpointError(
+                f"{path}: checkpoint carries C-engine state (colcore ABI "
+                f"{want_abi}) but shadow_tpu.native._colcore is not "
+                f"importable here — build it first: make -C native") from exc
+        if int(_colcore.ABI) != int(want_abi):
+            raise CheckpointError(
+                f"{path}: checkpoint written by colcore ABI {want_abi}, "
+                f"this build is ABI {_colcore.ABI} — the C state formats "
+                f"are incompatible; resume on the writing build or "
+                f"re-checkpoint from a Python-plane run")
+        if cfg is not None and not cfg.experimental.native_colcore:
+            raise CheckpointError(
+                f"{path}: checkpoint carries C-engine state but the "
+                f"resume invocation disables it "
+                f"(experimental.native_colcore=false); C endpoint/sink "
+                f"state cannot be demoted to the Python plane — resume "
+                f"with the C engine enabled, or re-checkpoint from a "
+                f"Python-plane run")
     if cfg is not None:
         want, got = header["config_digest"], config_digest(cfg)
         if want != got:
@@ -267,6 +417,8 @@ def load_checkpoint(path, cfg=None, mirror_log: bool = True):
                 f"vs {got[:12]}). Resume with the original config; only "
                 f"data_directory / checkpoint / digest / logging keys may "
                 f"differ.")
+    global _PENDING_ADOPT
+    _PENDING_ADOPT = []  # a failed earlier load must not leak stale objects
     with open(path, "rb") as f:
         f.readline()
         try:

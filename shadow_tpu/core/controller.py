@@ -122,29 +122,21 @@ class Controller:
 
         from shadow_tpu.network.fluid import MTU
 
-        #: fault injection (shadow_tpu/faults.py): a faults: section forces
-        #: the pure-Python planes — the C engine caches structures the
-        #: injector mutates mid-run, and the Python planes are the semantic
-        #: reference (cross-policy determinism under churn is asserted by
-        #: tests/test_faults.py). Only wall time moves.
+        #: fault injection (shadow_tpu/faults.py) runs on EVERY plane,
+        #: including the C engine: the injector mutates the effective
+        #: latency/loss/rate matrices and bucket arrays IN PLACE, and the
+        #: C core holds raw pointers into those same arrays, so a
+        #: transition is visible to all planes atomically at the next
+        #: barrier. Crash/reboot teardown has explicit C hooks
+        #: (Core.host_crash/host_boot); cross-policy and C-on/off
+        #: determinism under churn is asserted by tests/test_faults.py.
+        #: Checkpoints and the determinism sentinel likewise no longer
+        #: force the Python planes — C state exports to plain Python
+        #: structures for the pickler, and the digest walk reads only
+        #: plane-independent observables the C twin exposes identically.
         faults_cfg = cfg.faults
         have_faults = faults_cfg is not None and (
             faults_cfg.events or faults_cfg.churn)
-        if have_faults and cfg.experimental.native_colcore:
-            cfg.experimental.native_colcore = False
-            self.log.info("faults configured: C engine disabled "
-                          "(pure-Python planes carry fault semantics)")
-        #: checkpoint/restore + determinism sentinel (shadow_tpu/
-        #: checkpoint.py): both walk the Python-side structures, so like
-        #: faults they force the pure-Python planes (bit-identical to the
-        #: C engine by the test_colcore suite — disabling it cannot change
-        #: results, only wall time)
-        want_snapshots = bool(cfg.general.checkpoint_every) or \
-            cfg.general.state_digest_every > 0
-        if want_snapshots and cfg.experimental.native_colcore:
-            cfg.experimental.native_colcore = False
-            self.log.info("checkpoint/state-digest configured: C engine "
-                          "disabled (snapshots walk the Python planes)")
 
         params = NetParams.build(
             host_node=host_node,
@@ -231,6 +223,11 @@ class Controller:
             self.engine.faults_active = True
             for h in self.hosts:
                 h.faults_active = True
+            if self._c_core is not None:
+                # the C core was built before this flag existed: enable
+                # its per-host blackhole/teardown accounting and the
+                # faults-gated stream recovery counters
+                self._c_core.set_faults_active(True)
             self.faults = FaultInjector(self)
             self.log.info(
                 f"fault timeline: {len(self.faults.actions)} transitions "
@@ -260,6 +257,7 @@ class Controller:
         self.events = 0
         self.wall_seconds = 0.0
         self._events_wall = 0.0  # scheduler.run_round wall (phase timing)
+        self._ckpt_wall = 0.0  # save_checkpoint wall (phase timing)
         # checkpoint/restore + determinism sentinel (shadow_tpu/checkpoint.py)
         self.ckpt_every: SimTime = cfg.general.checkpoint_every or 0
         self.ckpt_dir = (Path(cfg.general.checkpoint_dir)
@@ -286,9 +284,12 @@ class Controller:
 
     def _reattach_runtime(self, mirror_log: bool = True) -> None:
         """Rebuild the runtime-only pieces after a checkpoint restore:
-        output location, logger mirroring, scheduler threads, and the
-        device draw plane. Everything simulation-semantic came back
-        through the pickle."""
+        output location, logger mirroring, scheduler threads, the device
+        draw plane, and the C engine (honoring the resume invocation's
+        ``experimental.native_colcore`` — a volatile config key).
+        Everything simulation-semantic came back through the pickle; any
+        checkpoint-restored C objects (endpoints, gossip states, relays)
+        are bound to the fresh core via ``checkpoint.finish_colcore_adopt``."""
         from shadow_tpu.utils.logging import LEVELS
 
         cfg = self.cfg
@@ -309,7 +310,29 @@ class Controller:
             cfg.experimental.scheduler_policy, self.hosts,
             cfg.general.parallelism)
         self.engine.reattach_device(cfg.experimental)
+        # C engine: rebuild over the restored structures and REWIRE the
+        # activation hooks — the pickled hooks may reference the dead
+        # core's placeholder (checkpoint._DeadCoreHandle)
         self._c_core = None
+        attach = getattr(self.engine, "attach_colcore", None)
+        core = attach(cfg.experimental) if attach is not None else None
+        if core is not None:
+            self._c_core = core
+            core.bind_active(self._active)
+            act = core.activate
+            self.engine.activate = act
+            for h in self.hosts:
+                h.equeue.on_first = partial(act, h.id)
+            if self.faults is not None:
+                core.set_faults_active(True)
+        else:
+            if hasattr(self.engine, "emitters"):  # columnar Python paths
+                self.engine.activate = self._active.add
+            for h in self.hosts:
+                h.equeue.on_first = partial(self._active.add, h.id)
+        from shadow_tpu import checkpoint as _ckpt
+
+        _ckpt.finish_colcore_adopt(self)
 
     def _on_signal(self, signum, frame) -> None:
         """SIGINT/SIGTERM: request a graceful stop at the next round
@@ -443,6 +466,7 @@ class Controller:
                 # round; stop at this (consistent) round boundary
                 break
             if now >= next_ckpt:
+                t_ck = _walltime.perf_counter()
                 if tel is not None:
                     tel.sync(self)  # streams complete at the boundary
                 path = _ckpt.save_checkpoint(self, now)
@@ -450,6 +474,11 @@ class Controller:
                     f"checkpoint written: {path} "
                     f"(sim {format_time(now)}, round {self.rounds})")
                 next_ckpt = ((now // ck_every) + 1) * ck_every
+                # snapshot wall is attributed like any other phase: it is
+                # plane-independent (the pickler walks the same graph fast
+                # plane or slow), so naming it keeps the benchmark's
+                # robustness-tax decomposition honest
+                self._ckpt_wall += _walltime.perf_counter() - t_ck
             if faults is not None:
                 # fault transitions apply at round starts: an action at
                 # time t takes effect at the first boundary >= t — the
@@ -644,6 +673,8 @@ class Controller:
                    for k, v in self.engine.phase_wall.items()},
                 **({"telemetry": round(self.telemetry.wall, 4)}
                    if self.telemetry is not None else {}),
+                **({"checkpoint": round(self._ckpt_wall, 4)}
+                   if self._ckpt_wall else {}),
             },
             # fused device windows (round-5 Weak #5): zero here on a
             # tpu_batch run means the device never serviced a window —

@@ -35,9 +35,14 @@ next pending action as a wake-up so idle simulations cannot jump over a
 transition. Latency factors are >= 1 by validation, so the conservative
 lookahead (round width <= min BASE latency) stays sound under degradation.
 
-The C engine is force-disabled while faults are configured (the Python
-planes are the semantic reference; determinism across policies is asserted
-by tests/test_faults.py).
+Faults run on every data plane, including the C engine: the injector
+rewrites the effective latency/loss/rate matrices and bucket arrays IN
+PLACE, and native/colcore holds raw pointers into those same numpy
+buffers, so a transition is visible to the C barrier at exactly the same
+instant as the Python ones. Host crash/reboot additionally drives the C
+core's explicit teardown hooks (Core.host_crash/host_boot) through
+Host.crash/reboot. Determinism across policies AND across the C/Python
+twins under churn is asserted by tests/test_faults.py.
 """
 
 from __future__ import annotations

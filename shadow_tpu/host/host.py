@@ -452,6 +452,15 @@ class Host:
         from shadow_tpu.core.events import BAND_APP, BAND_FAULT
 
         self.down = True
+        core = getattr(self.colplane, "_c", None)
+        if core is not None:
+            # C-side half of the teardown: mark the CHost down (its row
+            # dispatch discards arrivals at the dead NIC, counting them
+            # like dispatch_row does) and drop the C-registered gossip
+            # handlers — a reboot re-registers fresh state. The endpoint
+            # loop below works on C endpoints unchanged: CEp exposes the
+            # same _cancel_ctl/_cancel_rto/state surface.
+            core.host_crash(self.id)
         self.counters.add("host_crashes", 1)
         torn = 0
         for ep in list(self._conns.values()):
@@ -490,6 +499,9 @@ class Host:
         from shadow_tpu.core.events import BAND_FAULT
 
         self.down = False
+        core = getattr(self.colplane, "_c", None)
+        if core is not None:
+            core.host_boot(self.id)
         self.counters.add("host_boots", 1)
         self.log(f"{now} host rebooted")
         for p in self.processes:

@@ -81,7 +81,7 @@ class TGenClient:
     environment TGEN_IDLE_TIMEOUT_SEC=S (default 0 = off): arm the
     transport idle timeout on each connection — a client that is purely
     RECEIVING has no outstanding data, so only this detects a peer that
-    crashed mid-response (Python transport only; fault configs force it).
+    crashed mid-response (identical on the Python and C endpoints).
     """
 
     def __init__(self, api, args, env):
@@ -201,9 +201,7 @@ class TGenClient:
         conn.on_connected = on_connected
         conn.on_error = on_error
         if self.idle_timeout_ns:
-            set_idle = getattr(conn, "set_idle_timeout", None)
-            if set_idle is not None:  # Python transport only (no C twin)
-                set_idle(self.idle_timeout_ns)
+            conn.set_idle_timeout(self.idle_timeout_ns)
         conn.connect()
 
     def _next(self):

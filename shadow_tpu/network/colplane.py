@@ -184,14 +184,32 @@ class ColumnarPlane(DeviceRoutedPlane):
         #: tests/test_colcore.py + the cross-plane suite); absent or
         #: disabled, everything below runs pure Python.
         self._c = None
-        if (backend in ("tpu", "mesh") and self.qdisc == "fifo"
-                and getattr(tpu_options, "native_colcore", True)):
-            try:
-                from shadow_tpu.native import _colcore
+        self.attach_colcore(tpu_options)
 
-                self._c = _colcore.Core(self)
-            except ImportError:
-                pass
+    def attach_colcore(self, tpu_options):
+        """(Re)build the C engine over the current structures — the
+        constructor's hookup, callable again after a checkpoint restore
+        (Controller._reattach_runtime). Returns the core or None.
+
+        Cross-plane resume: a checkpoint written on the Python plane
+        stores resolved batches as plain StoreBatch row lists; the C
+        extractor wants packed CBatches, so convert in place (the deque's
+        identity is load-bearing — the core caches it)."""
+        self._c = None
+        if not (self.backend in ("tpu", "mesh") and self.qdisc == "fifo"
+                and getattr(tpu_options, "native_colcore", True)):
+            return None
+        try:
+            from shadow_tpu.native import _colcore
+        except ImportError:
+            return None
+        for i, b in enumerate(self.pending):
+            if isinstance(b, StoreBatch):
+                cb = _colcore.shell("CBatch")
+                cb._restore_state((b.pos, list(b.rows)))
+                self.pending[i] = cb
+        self._c = _colcore.Core(self)
+        return self._c
 
     # state queries (controller) -------------------------------------------
     def pending_head(self) -> SimTime:

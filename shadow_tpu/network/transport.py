@@ -65,6 +65,12 @@ MSS = 1460  # cwnd growth quantum (classic ethernet MSS)
 INIT_CWND = 10 * MSS  # RFC 6928
 MIN_CWND = 2 * MSS
 RTO_MIN_NS = 200 * NS_PER_MS
+#: RTO ceiling (TCP's conventional 60 s): a connection CREATED while its
+#: path is cut (faults.py blackholes it with INF latency) derives its
+#: timeout from the effective matrix, and an uncapped 2x-INF RTO both
+#: stalls retries forever and overflows the C twin's int64 timer math.
+#: Physical latencies are ms-scale, so the cap only binds on cut paths.
+RTO_MAX_NS = 60_000 * NS_PER_MS
 SYN_RETRIES = 5
 FIN_RETRIES = 5
 DATA_RETRIES = 8  # consecutive data RTOs before the connection resets
@@ -368,10 +374,10 @@ class StreamEndpoint:
         self.idle_timeout_ns: Optional[SimTime] = None
         self._idle_timer: Optional[int] = None
         self.peer_fin = False  # peer closed while we still had data to send
-        # deterministic per-path timeout: 2x RTT, floored
+        # deterministic per-path timeout: 2x RTT, floored and capped
         rtt = (host.engine.latency_between(host.id, remote_host)
                + host.engine.latency_between(remote_host, host.id))
-        self.rto_ns: SimTime = max(2 * rtt, RTO_MIN_NS)
+        self.rto_ns: SimTime = min(max(2 * rtt, RTO_MIN_NS), RTO_MAX_NS)
         # app callbacks
         self.on_connected: Optional[Callable[[SimTime], None]] = None
         self.on_data: Optional[Callable[[int, Optional[bytes], SimTime], None]] = None
@@ -403,8 +409,10 @@ class StreamEndpoint:
 
     def set_idle_timeout(self, timeout_ns: SimTime) -> None:
         """Arm (or disarm with None/0) the idle timeout; see the field
-        docstring. Python transport only — the C twin does not carry it
-        (fault configs force the Python planes, where it matters)."""
+        docstring. The C endpoint carries the exact twin
+        (colcore CEp_set_idle_timeout — same rearm-per-arrival seq
+        consumption, same expiry semantics), so fault configs behave
+        identically with the C engine on."""
         self._cancel_idle()
         self.idle_timeout_ns = timeout_ns if timeout_ns else None
         if self.idle_timeout_ns is not None:

@@ -3,12 +3,12 @@
 
 The load-bearing property: a run resumed from ANY checkpoint produces an
 output tree (and summary) identical to the uninterrupted run — across every
-scheduler policy and with the C engine on or off in the baseline (the
-checkpointing run itself always forces the Python planes, which are pinned
-bit-identical to the C engine by test_colcore). On top of the same state
-walk, the per-round digest stream must be identical across policies and
-data planes, and tools/bisect_divergence.py must name the exact first
-divergent round of a perturbed run.
+scheduler policy and with the C engine on or off (checkpointing runs keep
+whatever plane they were configured with: C-held state exports to plain
+structures through the colcore reducers and rebuilds on load). On top of
+the same state walk, the per-round digest stream must be identical across
+policies and data planes, and tools/bisect_divergence.py must name the
+exact first divergent round of a perturbed run.
 """
 
 import hashlib
@@ -139,21 +139,46 @@ def test_resume_matches_uninterrupted_smoke(tmp_path):
                                     "tpu_batch"])
 @pytest.mark.parametrize("colcore", [True, False])
 def test_resume_equivalence_matrix(tmp_path, policy, colcore):
-    """The full guarantee: for every scheduler policy, with the baseline's
-    C engine on and off, a resume from EVERY checkpoint reproduces the
-    uninterrupted output tree hash exactly. (The checkpointing run forces
-    the Python planes; the C engine is pinned bit-identical to them by
-    test_colcore, so the baseline's colcore setting cannot matter — this
-    asserts it end to end.)"""
+    """The full guarantee: for every scheduler policy, with the C engine
+    on and off in the CHECKPOINTING run itself, a resume from EVERY
+    checkpoint reproduces the uninterrupted output tree hash exactly.
+    With colcore on (tpu_batch), the checkpoints carry C-exported
+    endpoint state and the resume rebuilds + adopts it; with colcore
+    off, resuming with the default (C on) exercises the cross-plane
+    path — Python-written state continues under a freshly attached C
+    core (plain StoreBatches convert to packed CBatches, Python
+    endpoints keep dispatching through the C loop's fallback)."""
     ov = {"experimental.scheduler_policy": policy}
-    full_s, full_t = _run(tmp_path, "full",
-                          **{"experimental.native_colcore": colcore, **ov})
-    _run(tmp_path, "src", **{"general.checkpoint_every": "10s", **ov})
+    full_s, full_t = _run(tmp_path, "full", **ov)
+    _run(tmp_path, "src",
+         **{"general.checkpoint_every": "10s",
+            "experimental.native_colcore": colcore, **ov})
     paths = _checkpoints(tmp_path, "src")
     for i, p in enumerate(paths):
         res_s, res_t = _resume(tmp_path, f"res{i}", p, **ov)
         assert res_t == full_t, f"tree mismatch resuming {p.name}"
         assert res_s == full_s, f"summary mismatch resuming {p.name}"
+
+
+def test_resumed_run_can_checkpoint_again(tmp_path):
+    """A resumed run that keeps checkpointing must produce loadable
+    checkpoints of its own (second-generation resume is byte-identical).
+    Regression: checkpoint-rebuilt closures lost their <locals> qualname
+    marker on Python < 3.11 and broke the NEXT save's reducer."""
+    ov = {"experimental.scheduler_policy": "tpu_batch"}
+    _, full_t = _run(tmp_path, "full", **ov)
+    _run(tmp_path, "src", **{"general.checkpoint_every": "10s", **ov})
+    first = _checkpoints(tmp_path, "src")[0]
+    # resume WITH checkpointing still on: the continuation writes its own
+    res_s, res_t = _resume(tmp_path, "res", first,
+                           **{"general.checkpoint_every": "10s", **ov})
+    assert res_t == full_t
+    gen2 = [p for p in _checkpoints(tmp_path, "res")
+            if ckpt.read_header(p)["sim_time_ns"]
+            > ckpt.read_header(first)["sim_time_ns"]]
+    assert gen2, "resumed run wrote no later checkpoints"
+    _, res2_t = _resume(tmp_path, "res2", gen2[0], **ov)
+    assert res2_t == full_t
 
 
 def test_resume_under_active_fault_timeline(tmp_path):

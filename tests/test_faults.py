@@ -248,14 +248,18 @@ EQ_KEYS = ("sim_seconds", "rounds", "events", "units_sent", "units_dropped",
 
 def test_churn_byte_identical_across_policies_and_loss_twins():
     """THE determinism gate for faults: the same churn-enabled config under
-    thread_per_core, thread_per_host, tpu_batch, and tpu_batch with the
-    device draw kernel forced on (numpy/device twins) produces identical
-    summaries and byte-identical host output trees."""
+    thread_per_core, thread_per_host, tpu_batch (C engine ON — the
+    default), tpu_batch with the C engine forced off (pure-Python columnar
+    twin), and tpu_batch with the device draw kernel forced on
+    (numpy/device twins) produces identical summaries and byte-identical
+    host output trees."""
     runs = {}
     for policy, tag, over in (
             ("thread_per_core", "det-tpc", None),
             ("thread_per_host", "det-tph", None),
             ("tpu_batch", "det-tpu", None),
+            ("tpu_batch", "det-pyc",
+             {"experimental.native_colcore": False}),
             ("tpu_batch", "det-dev",
              {"experimental.tpu_device_floor": 1})):
         d = yaml.safe_load(CHURN_DOC)
@@ -264,11 +268,17 @@ def test_churn_byte_identical_across_policies_and_loss_twins():
             "experimental.scheduler_policy": policy,
             **(over or {}),
         })
-        runs[tag] = Controller(cfg, mirror_log=False).run()
+        ctl = Controller(cfg, mirror_log=False)
+        if tag in ("det-tpu", "det-dev"):
+            # the point of this PR: faults no longer disable the C engine
+            assert ctl.engine._c is not None, tag
+        elif tag == "det-pyc":
+            assert getattr(ctl.engine, "_c", None) is None
+        runs[tag] = ctl.run()
     ref = runs["det-tpc"]
     assert ref["counters"].get("host_crashes", 0) > 0  # churn actually ran
     assert ref["units_blackholed"] > 0  # the partition actually cut traffic
-    for tag in ("det-tph", "det-tpu", "det-dev"):
+    for tag in ("det-tph", "det-tpu", "det-pyc", "det-dev"):
         for k in EQ_KEYS:
             assert runs[tag][k] == ref[k], (tag, k, runs[tag][k], ref[k])
         cmp = filecmp.dircmp("/tmp/st-faults-det-tpc/hosts",
@@ -286,6 +296,161 @@ def test_twice_run_byte_identical():
         out.append(Controller(cfg, mirror_log=False).run())
     for k in EQ_KEYS:
         assert out[0][k] == out[1][k], k
+
+
+# -- faults ON the C engine (PR 6) ------------------------------------------
+
+def test_stream_faults_c_engine_byte_identical():
+    """C-on fault matrix for the stream scenarios: a healing partition
+    (blackhole accounting + RTO recovery) and a crash/reboot cycle (CHost
+    teardown + idle timeout + reconnect) produce byte-identical trees and
+    summaries with the C engine on vs the Python planes — including the
+    fault-accounting counters the CHost teardown deltas feed
+    (units_teardown_dropped, units_blackholed, conns_torn_down,
+    stream_timeouts, stream_rto_retransmits)."""
+    cases = {
+        "heal": ("""
+events:
+  - {time: 2s, kind: link_down, src_nodes: [0], dst_nodes: [1], duration: 3s}
+""", None),
+        "reboot": ("""
+events:
+  - {time: 2s, kind: host_down, hosts: [server], duration: 8s}
+""", {"TGEN_IDLE_TIMEOUT_SEC": "5", "TGEN_RETRIES": "2"}),
+    }
+    for name, (faults, env) in cases.items():
+        ref_ctl, ref = _run(TWO_NODE, f"cmat-{name}-tpc", faults=faults,
+                            client_env=env)
+        c_ctl, got = _run(TWO_NODE, f"cmat-{name}-c", faults=faults,
+                          client_env=env, policy="tpu_batch")
+        assert c_ctl.engine._c is not None
+        for k in EQ_KEYS:
+            assert got[k] == ref[k], (name, k, got[k], ref[k])
+        cmp = filecmp.dircmp(f"/tmp/st-faults-cmat-{name}-tpc/hosts",
+                             f"/tmp/st-faults-cmat-{name}-c/hosts")
+        assert not cmp.diff_files and not cmp.left_only \
+            and not cmp.right_only, (name, cmp.diff_files)
+        if name == "reboot":
+            # the crash/reboot accounting crossed the C plane: the C-side
+            # teardown deltas must reproduce the Python twin's numbers
+            for k in ("units_teardown_dropped", "conns_torn_down",
+                      "host_crashes", "host_boots", "stream_timeouts"):
+                assert got["counters"].get(k) == ref["counters"].get(k), k
+            assert got["counters"].get("units_teardown_dropped", 0) > 0
+
+
+def test_churn_checkpoint_resume_digest_c_engine():
+    """Satellite gate: checkpoint/resume mid-churn with the C engine ON.
+    The checkpointing run's tree and digest stream equal the
+    uninterrupted C-off run's (fast AND robust, not fast OR robust);
+    resuming from a mid-churn checkpoint reproduces the uninterrupted
+    output tree and continues the digest stream bit-exactly."""
+    import hashlib
+    import shutil
+
+    from shadow_tpu import checkpoint as ckpt
+
+    for tag in ("ckc-full", "ckc-py", "ckc-src", "ckc-res"):
+        # resumed runs APPEND to state_digests.jsonl by design (the
+        # continuation of one stream); a stale file from a previous test
+        # invocation would concatenate and break the suffix compare
+        shutil.rmtree(f"/tmp/st-faults-{tag}", ignore_errors=True)
+
+    def tree(tag):
+        out = {}
+        base = Path(f"/tmp/st-faults-{tag}")
+        for p in sorted((base / "hosts").rglob("*")):
+            if p.is_file():
+                out[str(p.relative_to(base))] = hashlib.sha256(
+                    p.read_bytes()).hexdigest()
+        assert out
+        return out
+
+    over = {"general.state_digest_every": 50}
+    # uninterrupted reference runs: C on and C off (Python columnar twin)
+    cfg = parse_config(yaml.safe_load(CHURN_DOC), {
+        "general.data_directory": "/tmp/st-faults-ckc-full",
+        "experimental.scheduler_policy": "tpu_batch", **over})
+    ctl = Controller(cfg, mirror_log=False)
+    assert ctl.engine._c is not None
+    ctl.run()
+    full_tree = tree("ckc-full")
+    full_digests = Path(
+        "/tmp/st-faults-ckc-full/state_digests.jsonl").read_bytes()
+    assert full_digests.count(b"\n") >= 3
+
+    cfg = parse_config(yaml.safe_load(CHURN_DOC), {
+        "general.data_directory": "/tmp/st-faults-ckc-py",
+        "experimental.scheduler_policy": "tpu_batch",
+        "experimental.native_colcore": False, **over})
+    Controller(cfg, mirror_log=False).run()
+    assert Path("/tmp/st-faults-ckc-py/state_digests.jsonl").read_bytes() \
+        == full_digests
+    assert tree("ckc-py") == full_tree
+
+    # checkpointing run (C on): transparent, and its checkpoints carry
+    # the colcore ABI fingerprint
+    cfg = parse_config(yaml.safe_load(CHURN_DOC), {
+        "general.data_directory": "/tmp/st-faults-ckc-src",
+        "experimental.scheduler_policy": "tpu_batch",
+        "general.checkpoint_every": "8s", **over})
+    Controller(cfg, mirror_log=False).run()
+    assert tree("ckc-src") == full_tree
+    paths = sorted(Path("/tmp/st-faults-ckc-src/checkpoints").glob("*.ckpt"))
+    assert paths
+    from shadow_tpu.native import _colcore
+    assert ckpt.read_header(paths[0])["colcore"] == _colcore.ABI
+
+    # resume from a mid-churn checkpoint: the churn timeline has already
+    # downed/rebooted hosts by 8s (mean_uptime 8s from t=3s)
+    cfg = parse_config(yaml.safe_load(CHURN_DOC), {
+        "general.data_directory": "/tmp/st-faults-ckc-res",
+        "experimental.scheduler_policy": "tpu_batch",
+        "general.checkpoint_every": "8s", **over})
+    ctl, resume_at = ckpt.load_checkpoint(paths[0], cfg, mirror_log=False)
+    assert ctl.engine._c is not None  # the C core was rebuilt on resume
+    assert ctl.faults is not None and ctl.faults.applied > 0
+    ctl.run(resume_at=resume_at)
+    assert tree("ckc-res") == full_tree
+    res_digests = Path(
+        "/tmp/st-faults-ckc-res/state_digests.jsonl").read_bytes()
+    assert res_digests and full_digests.endswith(res_digests)
+
+
+def test_c_checkpoint_refuses_python_plane_resume():
+    """A checkpoint carrying C-engine state names the problem when the
+    resume disables the C engine (instead of diverging or crashing deep
+    in the run). Self-contained: writes its own C-state checkpoint."""
+    import shutil
+
+    import pytest as _pytest
+
+    from shadow_tpu import checkpoint as ckpt
+    from shadow_tpu.native import _colcore
+
+    shutil.rmtree("/tmp/st-faults-refuse-src", ignore_errors=True)
+    d = yaml.safe_load(TWO_NODE)
+    d["general"]["stop_time"] = "12s"
+    cfg = parse_config(d, {
+        "general.data_directory": "/tmp/st-faults-refuse-src",
+        "experimental.scheduler_policy": "tpu_batch",
+        "general.checkpoint_every": "1s"})
+    ctl = Controller(cfg, mirror_log=False)
+    assert ctl.engine._c is not None
+    ctl.run()
+    paths = sorted(
+        Path("/tmp/st-faults-refuse-src/checkpoints").glob("*.ckpt"))
+    assert paths
+    assert ckpt.read_header(paths[0])["colcore"] == _colcore.ABI
+    d2 = yaml.safe_load(TWO_NODE)
+    d2["general"]["stop_time"] = "12s"
+    cfg = parse_config(d2, {
+        "general.data_directory": "/tmp/st-faults-refuse-res",
+        "experimental.scheduler_policy": "tpu_batch",
+        "experimental.native_colcore": False,
+        "general.checkpoint_every": "1s"})
+    with _pytest.raises(ckpt.CheckpointError, match="C-engine state"):
+        ckpt.load_checkpoint(paths[0], cfg, mirror_log=False)
 
 
 # -- schema / validation ----------------------------------------------------

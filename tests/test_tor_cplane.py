@@ -116,8 +116,9 @@ def test_tor_identity_across_policies_and_planes(tmp_path):
 
 def test_tor_digest_stream_identical_across_policies(tmp_path):
     """The determinism-sentinel digest stream on a tor config is
-    policy-independent (digest runs force the Python planes, which the
-    cross-plane test above pins to the C control plane)."""
+    policy-independent — including tpu_batch with the C engine (and its
+    tor control plane) attached: the digest walk reads plane-independent
+    observables the C endpoint twin exposes via fingerprint()."""
     streams = {}
     for pol in ("tpu_batch", "thread_per_core", "thread_per_host"):
         dd = tmp_path / f"dig-{pol}"

@@ -105,6 +105,37 @@ print(f"fault smoke OK: {trans} transitions, {crashes} crashes/"
       f"{boots} reboots, {bh} blackholed, {rto} RTO retransmits")
 '
 
+echo "== fast+robust smoke (gossip_churn: faults + checkpoints + digests with the C engine ON vs the Python plane) =="
+frrun() {
+    rm -rf "/tmp/ci-fr-$1"
+    python -m shadow_tpu examples/gossip_churn.yaml --quiet --json-summary \
+        --data-directory "/tmp/ci-fr-$1" \
+        --scheduler-policy tpu_batch \
+        --set "experimental.native_colcore=$2" \
+        --checkpoint-every 10s --state-digest-every 100 --sample-every 5s \
+        | python -c 'import json,sys; from shadow_tpu.core.controller import VOLATILE_SUMMARY_KEYS as V; d=json.load(sys.stdin); [d.pop(k, None) for k in V]; print(json.dumps(d,sort_keys=True))' \
+        > "/tmp/ci-fr-$1.json"
+    (cd "/tmp/ci-fr-$1" && find hosts -type f | sort | xargs sha256sum && \
+     sha256sum flows.jsonl metrics.jsonl state_digests.jsonl) \
+        > "/tmp/ci-fr-$1.hashes"
+}
+frrun c true
+frrun py false
+diff /tmp/ci-fr-c.json /tmp/ci-fr-py.json
+diff /tmp/ci-fr-c.hashes /tmp/ci-fr-py.hashes
+python - <<'EOF'
+from pathlib import Path
+from shadow_tpu import checkpoint as ckpt
+from shadow_tpu.native import _colcore
+paths = sorted(Path('/tmp/ci-fr-c/checkpoints').glob('*.ckpt'))
+assert paths, 'C run wrote no checkpoints'
+h = ckpt.read_header(paths[0])
+assert h['colcore'] == _colcore.ABI, f"checkpoint missing colcore ABI: {h}"
+print(f"fast+robust smoke OK: churned+checkpointed+digested C run "
+      f"bit-identical to the Python plane ({len(paths)} C-state "
+      f"checkpoints, colcore ABI {h['colcore']})")
+EOF
+
 echo "== telemetry smoke (gossip_churn: cross-policy stream hashes + report parse) =="
 telrun() {
     python -m shadow_tpu examples/gossip_churn.yaml --quiet \
