@@ -752,6 +752,239 @@ def tor_churned_ckpt(base_ratio=None) -> dict:
     return out
 
 
+#: per-shard busy-wall imbalance (max/min) above which the sharded row
+#: carries a straggler advisory: id-modulo placement assumes statistically
+#: uniform load, and a config that concentrates hot hosts on one shard
+#: shows up here first
+STRAGGLER_ADVISORY = 1.5
+
+
+def _shard_busy_walls(summary: dict) -> list:
+    """Per-shard busy wall (phase_wall sum excluding the exchange and
+    barrier-sync walls — waiting on peers is the SYMPTOM of imbalance,
+    not the cause)."""
+    out = []
+    for s in summary.get("shards", {}).get("per_shard", []):
+        pw = s.get("phase_wall", {})
+        out.append(sum(v for k, v in pw.items()
+                       if k not in ("exchange", "sync")))
+    return out
+
+
+def tor_sharded(shard_counts=(1, 2, 4), stop_s: int = 8) -> dict:
+    """The scale-out row (sim_shards PR acceptance): the tor 1/10-scale
+    config at shards=1/2/4, interleaved median-of-3 subprocess rows like
+    the other tor small-scale rows. shards=1 is the unchanged
+    single-process controller; every repetition at every shard count
+    must agree on all result fields (the byte-identity contract,
+    summary-level here — tests/test_shards.py carries the stream-level
+    gates). Publishes per-shard phase_wall (including the exchange wall)
+    and a straggler advisory when the busy-wall imbalance exceeds
+    {STRAGGLER_ADVISORY}x."""
+    import os
+    import subprocess
+    import time as _t
+
+    import yaml
+
+    doc = _tor_doc(700, 10_000, stop_s)
+    ypath = "/tmp/shadow-bench-tor10k-sharded.yaml"
+    with open(ypath, "w") as f:
+        yaml.safe_dump(doc, f, default_style=None)
+
+    def sub(shards, tag):
+        t0 = _t.perf_counter()
+        r = subprocess.run(
+            [sys.executable, "-m", "shadow_tpu", ypath,
+             "--scheduler-policy", "tpu_batch",
+             "--shards", str(shards),
+             "--data-directory", f"/tmp/shadow-bench-{tag}",
+             "--json-summary", "--quiet"],
+            capture_output=True, text=True, timeout=3600,
+            env=dict(os.environ), cwd=str(ROOT))
+        assert r.returncode == 0, (tag, r.stderr[-500:])
+        s = json.loads(r.stdout)
+        s["subprocess_wall_s"] = round(_t.perf_counter() - t0, 1)
+        return s
+
+    N = 3
+    reps = {n: [] for n in shard_counts}
+    for i in range(N):
+        for n in shard_counts:
+            reps[n].append(sub(n, f"torshard-{n}-{i}"))
+    ref = reps[shard_counts[0]][0]
+    for n, rs in reps.items():
+        for s in rs:
+            for k in ("events", "units_sent", "units_dropped",
+                      "bytes_sent", "rounds", "counters"):
+                assert s[k] == ref[k], \
+                    f"sharded tor determinism: {k} diverged at shards={n}"
+    log(f"tor_sharded determinism OK: shards={list(shard_counts)} x {N} "
+        f"reps agree ({ref['events']} events)")
+    out = {}
+    base_rate = None
+    for n in shard_counts:
+        s = _median_run(reps[n])
+        busy = _shard_busy_walls(s)
+        row = {
+            "sim_sec_per_wall_sec": round(s["sim_sec_per_wall_sec"], 3),
+            "wall_seconds": round(s["wall_seconds"], 2),
+            "max_rss_mb": s["max_rss_mb"],
+            "raw_rates": _run_rates(reps[n]),
+            "spread_rel": _spread_rel({n: reps[n]})[n],
+            "phase_wall_exchange_per_shard": [
+                ps["phase_wall"].get("exchange")
+                for ps in s.get("shards", {}).get("per_shard", [])],
+            "phase_wall_sync": s["phase_wall"].get("sync"),
+        }
+        if busy and min(busy) > 0:
+            imb = max(busy) / min(busy)
+            row["shard_busy_wall_imbalance"] = round(imb, 2)
+            if imb > STRAGGLER_ADVISORY:
+                row["straggler_advisory"] = (
+                    f"max/min shard busy wall {imb:.2f}x > "
+                    f"{STRAGGLER_ADVISORY}x — id-modulo placement is "
+                    f"unbalanced for this config")
+                log(f"WARNING tor_sharded shards={n}: "
+                    f"{row['straggler_advisory']}")
+        if base_rate is None:
+            base_rate = s["sim_sec_per_wall_sec"]
+        else:
+            row["speedup_vs_shards_1"] = round(
+                s["sim_sec_per_wall_sec"] / base_rate, 2)
+        out[f"shards_{n}"] = row
+    out["aggregation"] = (f"median-of-{N}, interleaved subprocess rows "
+                          f"across shard counts; all counts "
+                          f"result-identical (asserted)")
+    out["note"] = ("tor 1/10 scale, tpu_batch + C engine per shard; "
+                   "shards=1 is the unchanged single-process controller. "
+                   "The peer-to-peer edge barrier + row exchange is the "
+                   "published scale-out overhead "
+                   "(phase_wall_exchange_per_shard / coordinate).")
+    log("tor_sharded: " + ", ".join(
+        f"shards={n} {out[f'shards_{n}']['sim_sec_per_wall_sec']}"
+        for n in shard_counts))
+    return out
+
+
+def _parallel_scaling_probe() -> float:
+    """How much real CPU parallelism this box gives two processes: run
+    one CPU-bound task serial, then two in parallel, and report
+    2*serial/parallel. 2.0 = two real cores; ~1.3 = shared execution
+    resources (the ceiling any 2-shard speedup can reach here)."""
+    import multiprocessing as mp
+    import time as _t
+
+    n = 20_000_000
+    t0 = _t.perf_counter()
+    _burn(n)
+    serial = _t.perf_counter() - t0
+    ctx = mp.get_context("spawn")
+    t0 = _t.perf_counter()
+    ps = [ctx.Process(target=_burn, args=(n,)) for _ in range(2)]
+    for p in ps:
+        p.start()
+    for p in ps:
+        p.join()
+    par = _t.perf_counter() - t0
+    return round(2 * serial / par, 2)
+
+
+def _burn(n: int) -> int:
+    s = 0
+    for i in range(n):
+        s += i * i
+    return s
+
+
+def tor_100k_sharded(stop_s: int = 15, shards: int = 2,
+                     reps: int = 2) -> dict:
+    """Full-scale config #5 through the shard plane, measured HONESTLY:
+    interleaved (single-process, sharded) pairs under today's load, both
+    raw rate lists published, plus a measured parallel-scaling probe of
+    the box — the ceiling any local sharded speedup can reach. The small
+    twin carries the byte-identity gates; this row answers 'does
+    partitioning pay on THIS hardware at THIS scale'."""
+    import os
+    import subprocess
+    import time as _t
+
+    import shutil
+
+    from shadow_tpu.config import parse_config
+    from shadow_tpu.parallel.shards import run_sharded
+
+    doc = _tor_doc(7000, 100_000, stop_s)
+    singles = []
+    shardeds = []
+    last = None
+    for i in range(reps):
+        # single-process leg in a subprocess (per-run RSS/allocator)
+        r = subprocess.run(
+            [sys.executable, "-c", f"""
+import sys; sys.path.insert(0, {str(ROOT)!r})
+import json
+from bench import _tor_doc
+from shadow_tpu.config import parse_config
+from shadow_tpu.core.controller import Controller
+doc = _tor_doc(7000, 100_000, {stop_s})
+cfg = parse_config(doc, {{"general.data_directory":
+    "/tmp/shadow-bench-tor100k-single{i}",
+    "experimental.scheduler_policy": "tpu_batch"}})
+r = Controller(cfg, mirror_log=False).run()
+print(json.dumps([r["sim_sec_per_wall_sec"], r["events"]]))
+"""], capture_output=True, text=True, timeout=3600,
+            env=dict(os.environ), cwd=str(ROOT))
+        assert r.returncode == 0, r.stderr[-500:]
+        rate, events = json.loads(r.stdout.strip().splitlines()[-1])
+        singles.append(round(rate, 4))
+        tag = f"tor100k-sh{shards}-{i}"
+        shutil.rmtree(f"/tmp/shadow-bench-{tag}", ignore_errors=True)
+        cfg = parse_config(doc, {
+            "general.data_directory": f"/tmp/shadow-bench-{tag}",
+            "general.sim_shards": shards,
+            "experimental.scheduler_policy": "tpu_batch"})
+        rs = run_sharded(cfg, mirror_log=False)
+        assert rs["events"] == events, \
+            "sharded full-scale events diverged from single-process"
+        shardeds.append(round(rs["sim_sec_per_wall_sec"], 4))
+        last = rs
+    busy = _shard_busy_walls(last)
+    scaling = _parallel_scaling_probe()
+    out = {
+        "relays": 7000, "clients": 100_000, "sim_seconds": stop_s,
+        "sim_shards": shards,
+        "sim_sec_per_wall_sec": max(shardeds),
+        "raw_rates_sharded": shardeds,
+        "raw_rates_single_process_interleaved": singles,
+        "events": last["events"], "units_sent": last["units_sent"],
+        "max_rss_mb_max_shard": last["max_rss_mb"],
+        "errors": len(last["process_errors"]),
+        "phase_wall_per_shard": [
+            ps["phase_wall"]
+            for ps in last.get("shards", {}).get("per_shard", [])],
+        "shard_busy_wall_imbalance": (
+            round(max(busy) / min(busy), 2) if busy and min(busy) > 0
+            else None),
+        "box_parallel_scaling_2proc": scaling,
+        "verdict": (
+            "sharded BEATS the contemporaneous single-process rate"
+            if max(shardeds) > max(singles) else
+            f"sharded LOSES to the contemporaneous single-process rate "
+            f"on this box: two parallel CPU-bound processes measure only "
+            f"{scaling}x (shared execution resources), below the "
+            f"break-even for the barrier+exchange overhead at this "
+            f"scale; the byte-identity gates all hold, so the partition "
+            f"is a correctness-proven throughput knob awaiting real "
+            f"cores (or a second box)"),
+        "aggregation": f"interleaved (single, sharded) x{reps}; raw "
+                       f"rates published, best-of compared",
+    }
+    log(f"tor_100k_sharded (shards={shards}): sharded {shardeds} vs "
+        f"single {singles} sim-s/wall-s (box 2-proc scaling {scaling}x)")
+    return out
+
+
 def tor_100k(stop_s: int = 15) -> dict:
     """BASELINE config #5 as a real bench row (VERDICT r3 item #6, r4
     item #2): 7,000 relays + 100,000 clients through the columnar plane
@@ -1042,7 +1275,31 @@ def main() -> None:
                     help="measure ONLY the tor_1_10_churned_ckpt row and "
                          "merge it into BENCH_DETAIL.json (base ratio "
                          "taken from the published small_scale_1_10 row)")
+    ap.add_argument("--sharded", action="store_true",
+                    help="measure ONLY the scale-out rows (tor_1_10 at "
+                         "shards=1/2/4, interleaved median-of-3, plus the "
+                         "full-scale tor_100k at shards=2) and merge them "
+                         "into BENCH_DETAIL.json")
     args = ap.parse_args()
+
+    if args.sharded:
+        detail_path = ROOT / "BENCH_DETAIL.json"
+        detail = json.loads(detail_path.read_text())
+        row = tor_sharded()
+        detail.setdefault("tor_100k", {})["tor_1_10_sharded"] = row
+        full = tor_100k_sharded(shards=2)
+        detail["tor_100k"]["full_scale_sharded"] = full
+        detail_path.write_text(json.dumps(detail, indent=2))
+        log("wrote BENCH_DETAIL.json (tor_1_10_sharded + "
+            "full_scale_sharded)")
+        print(json.dumps({
+            "metric": "tor_100k_sharded_sim_sec_per_wall_sec",
+            "value": full["sim_sec_per_wall_sec"],
+            "sim_shards": full["sim_shards"],
+            "published_single_process": detail["tor_100k"].get(
+                "sim_sec_per_wall_sec"),
+        }), flush=True)
+        return
 
     if args.tor_churned:
         detail_path = ROOT / "BENCH_DETAIL.json"
@@ -1194,6 +1451,7 @@ def main() -> None:
         detail["real_curl"] = real_binary_bench()
         detail["real_curl_1k"] = real_curl_1k()
         detail["tor_100k"] = tor_100k()
+        detail["tor_100k"]["tor_1_10_sharded"] = tor_sharded()
         detail["tpu_mesh_scaling"] = mesh_scaling()
         detail["tpu_mesh_scaling_forced_collective"] = mesh_scaling(
             force_collective=True)

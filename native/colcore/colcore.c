@@ -37,6 +37,7 @@
  */
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <marshal.h>
 #define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
 #include <numpy/arrayobject.h>
 #include <stddef.h>
@@ -363,6 +364,14 @@ typedef struct {
    * per-host blackhole/teardown accounting and the stream-recovery
    * counters, exactly like the Python twins gate on host.faults_active */
   int faults_active;
+  /* multi-process sharding (parallel/shards.py): when shard_n > 1,
+   * resolved store rows whose destination host id is not congruent to
+   * shard_id (mod shard_n) divert into xout[dst % shard_n] — a Python
+   * list of per-shard lists the plane owns — as 13-field store tuples,
+   * instead of entering the local pending store. Counting (units_sent /
+   * bytes_sent) stays with the RESOLVING shard. */
+  int32_t shard_id, shard_n;
+  PyObject *xout; /* owned; NULL until bind_shard */
   CHost *hs;
   /* scratch buffers reused across barriers */
   struct BRow *brow;
@@ -1133,6 +1142,7 @@ static int store_build(CoreObject *c, BRow *rows, int n, int have_flags,
   ORow *out = malloc(sizeof(ORow) * (size_t)(n ? n : 1));
   if (!out) { PyErr_NoMemory(); return -1; }
   int m = 0;
+  int sh_n = c->shard_n;
   for (int i = 0; i < n; i++) {
     BRow *b = &rows[i];
     if (have_flags && b->drop) {
@@ -1142,6 +1152,23 @@ static int store_build(CoreObject *c, BRow *rows, int n, int have_flags,
       nbytes_total += b->size;
       int64_t t = b->arrival;
       if (t < round_end) t = round_end;
+      if (sh_n > 1 && b->dst % sh_n != c->shard_id) {
+        /* cross-shard destination: divert the fully resolved store row
+         * (13-field tuple) into the per-shard egress buffer the plane
+         * ships at the round edge (parallel/shards.py) */
+        SRec s;
+        s.t = t; s.key = b->key; s.tgt = b->dst; s.size = (int32_t)b->size;
+        s.peer = b->src; s.bport = b->dport; s.aport = b->sport;
+        s.nbytes = b->nbytes; s.seq = b->seq; s.kind = (int16_t)b->kind;
+        s.frag = b->frag; s.nfrags = b->nfrags;
+        PyObject *row_t = srec_tuple(&s, b->payload);
+        if (!row_t) { free(out); return -1; }
+        PyObject *lst = PyList_GET_ITEM(c->xout, b->dst % sh_n);
+        int rc3 = PyList_Append(lst, row_t);
+        Py_DECREF(row_t);
+        if (rc3 < 0) { free(out); return -1; }
+        continue;
+      }
       out[m].t = t; out[m].key = b->key; out[m].idx = i;
       m++;
     }
@@ -1429,7 +1456,7 @@ static PyObject *Core_spec_demand(CoreObject *c, PyObject *args) {
         return NULL;
       }
       /* the window starts at the host's NEXT uid: only future units */
-      pu[out_n] = ((uint64_t)hid << 40) | (uint64_t)ctr;
+      pu[out_n] = ((uint64_t)hid << 32) | (uint64_t)ctr;
     }
     ph[out_n] = hid;
     pn[out_n] = s->want;
@@ -1597,7 +1624,7 @@ static PyObject *Core_barrier(CoreObject *c, PyObject *args) {
     int64_t ctr;
     if (attr_i64(ems[e].host, S_uid_counter, &ctr) < 0) goto done;
     if (attr_set_i64(ems[e].host, S_uid_counter, ctr + k) < 0) goto done;
-    uint64_t base = ((uint64_t)hid << 40) | (uint64_t)ctr;
+    uint64_t base = ((uint64_t)hid << 32) | (uint64_t)ctr;
     for (Py_ssize_t i = 0; i < k; i++) {
       ERow *er = &hstate->erow[i];
       BRow *b = &c->brow[n++];
@@ -1651,7 +1678,10 @@ static PyObject *Core_barrier(CoreObject *c, PyObject *args) {
     }
     if (lat < mul) mul = lat;
     b->arrival = b->depart + lat;
-    b->key = key0 + keep;
+    /* canonical event key = the uid (placement-independent; the Python
+     * planes' twin — engine.py/colplane.py). _ev_key stays a resolved-
+     * units counter (hashed by the determinism sentinel). */
+    b->key = (int64_t)b->uid;
     b->th = c->thresh[(int64_t)sn * c->G + dn];
     if (b->th) any_live = 1;
     int64_t q = (b->size + MTU - 1) / MTU;
@@ -2114,6 +2144,7 @@ static int Core_traverse(CoreObject *c, visitproc visit, void *arg) {
   Py_VISIT(c->deferred);
   Py_VISIT(c->active);
   Py_VISIT(c->storebatch_cls);
+  Py_VISIT(c->xout);
   for (int i = 0; i < 11; i++) Py_VISIT(c->arrs[i]);
   if (c->hs) {
     for (int64_t i = 0; i < c->H; i++) {
@@ -2141,6 +2172,7 @@ static int Core_clear_gc(CoreObject *c) {
   Py_CLEAR(c->deferred);
   Py_CLEAR(c->active);
   Py_CLEAR(c->storebatch_cls);
+  Py_CLEAR(c->xout);
   for (int i = 0; i < 11; i++) Py_CLEAR(c->arrs[i]);
   if (c->hs) {
     for (int64_t i = 0; i < c->H; i++) {
@@ -2207,6 +2239,7 @@ static void Core_dealloc(CoreObject *c) {
   Py_XDECREF(c->deferred);
   Py_XDECREF(c->active);
   Py_XDECREF(c->storebatch_cls);
+  Py_XDECREF(c->xout);
   for (int i = 0; i < 11; i++) Py_XDECREF(c->arrs[i]);
   Py_TYPE(c)->tp_free((PyObject *)c);
 }
@@ -2489,6 +2522,29 @@ static PyObject *Core_tor_client_sink(CoreObject *c, PyObject *args);
 static PyObject *Core_adopt(CoreObject *c, PyObject *arg);
 
 /* -- fault lifecycle (shadow_tpu/faults.py) ------------------------------ */
+static PyObject *Core_bind_shard(CoreObject *c, PyObject *args) {
+  /* multi-process sharding: (shard_id, n_shards, xout) where xout is the
+   * plane's list of n_shards per-destination-shard row lists. Rebinding
+   * (e.g. after take_xout swaps fresh lists in) is the normal pattern. */
+  int sid, n;
+  PyObject *xout;
+  if (!PyArg_ParseTuple(args, "iiO", &sid, &n, &xout)) return NULL;
+  if (!PyList_Check(xout) || PyList_GET_SIZE(xout) != n) {
+    PyErr_SetString(PyExc_TypeError,
+                    "bind_shard expects xout as a list of n_shards lists");
+    return NULL;
+  }
+  if (n < 1 || sid < 0 || sid >= n) {
+    PyErr_SetString(PyExc_ValueError, "bind_shard: shard_id/n out of range");
+    return NULL;
+  }
+  c->shard_id = sid;
+  c->shard_n = n;
+  Py_INCREF(xout);
+  Py_XSETREF(c->xout, xout);
+  Py_RETURN_NONE;
+}
+
 static PyObject *Core_set_faults_active(CoreObject *c, PyObject *arg) {
   int v = PyObject_IsTrue(arg);
   if (v < 0) return NULL;
@@ -2578,6 +2634,9 @@ static PyMethodDef Core_methods[] = {
      "(hid, on_ctrl) -> Relay (C tor-relay data path)"},
     {"tor_client_sink", (PyCFunction)Core_tor_client_sink, METH_VARARGS,
      "(endpoint, on_cell) -> TorSink (C tor-client data path)"},
+    {"bind_shard", (PyCFunction)Core_bind_shard, METH_VARARGS,
+     "install the multi-process shard filter: (shard_id, n_shards, xout "
+     "per-shard row lists); cross-shard store rows divert into xout"},
     {"set_faults_active", (PyCFunction)Core_set_faults_active, METH_O,
      "(flag) -> enable the faults_active-gated accounting (blackhole/"
      "teardown per-host counts, stream recovery counters)"},
@@ -5909,7 +5968,75 @@ static PyObject *mod_perf_dump(PyObject *self, PyObject *noarg) {
   return d;
 }
 
+/* parse one packed cross-shard row block (parallel/shards.py wire
+ * format: [n u64][numeric cols (n, 12) i64][payload lens (n,) i64]
+ * [payload blobs]) straight into a CBatch — the packed ingest path that
+ * keeps cross-shard arrivals off the Python tuple path entirely
+ * (~26 us/row via tuples + _restore_state vs ~2 us here, measured at
+ * the 100k-host tor scale). Payload blobs are marshal (len > 0) with a
+ * pickle fallback (len < 0); len == 0 is None. */
+static PyObject *mod_cbatch_from_packed(PyObject *self, PyObject *arg) {
+  (void)self;
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return NULL;
+  const char *buf = view.buf;
+  Py_ssize_t len = view.len;
+  CBatch *cb = NULL;
+  int bad = 0, err = 0;
+  int64_t n = 0;
+  if (len < 8) { bad = 1; goto done; }
+  memcpy(&n, buf, 8);
+  if (n < 0 || n > (len - 8) / (13 * 8)) { bad = 1; goto done; }
+  cb = cbatch_new((int)n);
+  if (!cb) { err = 1; goto done; }
+  {
+    const char *cols = buf + 8;
+    const char *lens = buf + 8 + n * 12 * 8;
+    Py_ssize_t off = 8 + n * 13 * 8;
+    for (int64_t i = 0; i < n; i++) {
+      int64_t r[12], ln;
+      memcpy(r, cols + i * 12 * 8, 12 * 8);
+      memcpy(&ln, lens + i * 8, 8);
+      SRec *s = &cb->recs[i];
+      s->t = r[0]; s->key = r[1]; s->tgt = (int32_t)r[2];
+      s->kind = (int16_t)r[3]; s->peer = (int32_t)r[4];
+      s->aport = (int32_t)r[5]; s->bport = (int32_t)r[6];
+      s->nbytes = r[7]; s->seq = r[8]; s->frag = (int32_t)r[9];
+      s->nfrags = (int32_t)r[10]; s->size = (int32_t)r[11];
+      if (ln == 0) continue;
+      int64_t alen = ln > 0 ? ln : -ln;
+      if (off + alen > len) { bad = 1; goto done; }
+      PyObject *p;
+      if (ln > 0) {
+        p = PyMarshal_ReadObjectFromString(buf + off, (Py_ssize_t)alen);
+      } else {
+        PyObject *pickle = PyImport_ImportModule("pickle");
+        PyObject *blob = pickle ? PyBytes_FromStringAndSize(buf + off,
+                                                            (Py_ssize_t)alen)
+                                : NULL;
+        p = blob ? PyObject_CallMethod(pickle, "loads", "O", blob) : NULL;
+        Py_XDECREF(blob);
+        Py_XDECREF(pickle);
+      }
+      if (!p) { err = 1; goto done; }
+      cb->pay[i] = p; /* owned */
+      off += alen;
+    }
+  }
+done:
+  PyBuffer_Release(&view);
+  if (bad) {
+    Py_XDECREF(cb);
+    PyErr_SetString(PyExc_ValueError, "malformed packed batch");
+    return NULL;
+  }
+  if (err) { Py_XDECREF(cb); return NULL; }
+  return (PyObject *)cb;
+}
+
 static PyMethodDef module_methods[] = {
+    {"cbatch_from_packed", mod_cbatch_from_packed, METH_O,
+     "packed cross-shard row block (shards.py wire format) -> CBatch"},
     {"perf_dump", mod_perf_dump, METH_NOARGS, "drain section timers"},
     {"unit_dropped", mod_unit_dropped, METH_VARARGS,
      "(seed, uid, npk, thresh) -> bool  (test hook: fluid.loss_flags twin)"},
@@ -5986,7 +6113,10 @@ PyMODINIT_FUNC PyInit__colcore(void) {
    * checkpoint carrying C-engine state records this value in its header
    * and loading refuses a mismatch by name. Bump on ANY change to the
    * _export_state/_restore_state layouts. */
-  PyModule_AddIntConstant(m, "ABI", 1);
+  /* ABI 2: canonical event keys are uids (placement-independent ordering
+   * for multi-process sharding) — checkpoints carrying keyed state from
+   * ABI-1 builds order ties differently and must refuse by name */
+  PyModule_AddIntConstant(m, "ABI", 2);
   Py_INCREF(&Core_Type);
   PyModule_AddObject(m, "Core", (PyObject *)&Core_Type);
   Py_INCREF(&GossipState_Type);

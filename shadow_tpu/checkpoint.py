@@ -292,13 +292,21 @@ def config_digest(cfg) -> str:
     }
     for section, key in VOLATILE_CONFIG_KEYS:
         doc[section].pop(key, None)
+    # sim_shards is excluded from the digest but is NOT volatile: the
+    # header records it explicitly and load refuses a mismatched count BY
+    # NAME (a shard checkpoint is one piece of an N-way partition — it
+    # can only resume into the same partition)
+    doc["general"].pop("sim_shards", None)
     blob = json.dumps(doc, sort_keys=True, default=str).encode()
     return hashlib.sha256(blob).hexdigest()
 
 
 # -- save / load --------------------------------------------------------------
 
-def checkpoint_path(ckpt_dir: Path, sim_time: int) -> Path:
+def checkpoint_path(ckpt_dir: Path, sim_time: int,
+                    shard: int = None) -> Path:
+    if shard is not None:
+        return Path(ckpt_dir) / f"ckpt_t{sim_time:020d}.shard{shard}.ckpt"
     return Path(ckpt_dir) / f"ckpt_t{sim_time:020d}.ckpt"
 
 
@@ -315,7 +323,10 @@ def save_checkpoint(controller, now: int) -> Path:
             "engine still holds outstanding draw batches after flush_all()")
     ckpt_dir = Path(controller.ckpt_dir)
     ckpt_dir.mkdir(parents=True, exist_ok=True)
-    path = checkpoint_path(ckpt_dir, now)
+    n_shards = int(getattr(controller, "n_shards", 1))
+    path = checkpoint_path(
+        ckpt_dir, now,
+        shard=controller.shard_id if n_shards > 1 else None)
     # colcore build/ABI fingerprint: when the C engine is attached the
     # payload carries C-exported state, and resuming it on a mismatched
     # colcore build must fail fast by name instead of diverging silently
@@ -333,6 +344,11 @@ def save_checkpoint(controller, now: int) -> Path:
         "events": controller.events,
         "config_digest": config_digest(controller.cfg),
         "colcore": colcore_abi,
+        # multi-process sharding: the shard count is part of the state's
+        # identity — a shard checkpoint holds 1/N of the host partition
+        # and can only resume into an N-way run (load refuses by name)
+        "sim_shards": n_shards,
+        **({"shard": controller.shard_id} if n_shards > 1 else {}),
     }
     tmp = path.with_suffix(".tmp")
     try:
@@ -381,6 +397,18 @@ def load_checkpoint(path, cfg=None, mirror_log: bool = True):
             f"{'.'.join(map(str, header.get('python', ())))}, running "
             f"{sys.version_info[0]}.{sys.version_info[1]} — marshaled "
             f"closures are not portable across interpreter versions")
+    if cfg is not None:
+        have_sh = int(header.get("sim_shards", 1))
+        want_sh = int(getattr(cfg.general, "sim_shards", 1))
+        if have_sh != want_sh:
+            raise CheckpointError(
+                f"{path}: checkpoint written with sim_shards={have_sh} "
+                f"but this invocation has sim_shards={want_sh} — the host "
+                f"partition is part of the snapshot's identity; resume "
+                f"with general.sim_shards={have_sh} (results are "
+                f"byte-identical at any shard count, so re-running from "
+                f"scratch at the new count reproduces the same "
+                f"simulation)")
     want_abi = header.get("colcore")
     if want_abi is not None:
         # the payload carries C-engine state: the resume needs a colcore
@@ -574,6 +602,64 @@ def state_digest(controller, sim_now: int):
         # times, unit counters, and endpoint state it must perturb.
         "faults": ((controller.faults.idx, controller.faults.applied)
                    if controller.faults is not None else None),
+        "hosts": hosts,
+    }
+    return _digest(g), hosts
+
+
+def shard_digest_partial(controller, sim_now: int) -> dict:
+    """One shard worker's contribution to a sentinel record: fingerprints
+    of its OWNED hosts plus its slice of the global observables. The
+    parent merges partials (merge_shard_digests) into the byte-exact
+    single-process record — per-host state lives wholly on its owning
+    shard, the counters are disjoint sums, and the bucket/token arrays
+    are valid exactly at the owned indices."""
+    eng = controller.engine
+    eng.flush_all()
+    own = [h for h in controller.hosts if controller.owns(h.id)]
+    ids = [h.id for h in own]
+    return {
+        "hosts": {h.name: _digest(h.state_fingerprint()) for h in own},
+        "ids": ids,
+        "events": controller.events,
+        "units_sent": eng.units_sent,
+        "units_dropped": eng.units_dropped,
+        "units_blackholed": eng.units_blackholed,
+        "bytes_sent": eng.bytes_sent,
+        "ev_key": eng._ev_key,
+        "tokens_down": eng.tokens_down[ids],
+        "bucket_avail": eng.buckets.levels(sim_now)[ids],
+        "last_refill": eng._last_refill,
+        "faults": ((controller.faults.idx, controller.faults.applied)
+                   if controller.faults is not None else None),
+    }
+
+
+def merge_shard_digests(parts: list, sim_now: int, rounds: int,
+                        n_hosts: int):
+    """Combine per-shard partials into the exact state_digest() result of
+    the equivalent single-process run: ``(global_digest_hex, hosts)``."""
+    tokens = np.zeros(n_hosts, dtype=np.int64)
+    bucket = np.zeros(n_hosts, dtype=np.int64)
+    hosts: dict = {}
+    for p in parts:
+        ids = p["ids"]
+        tokens[ids] = p["tokens_down"]
+        bucket[ids] = p["bucket_avail"]
+        hosts.update(p["hosts"])
+    g = {
+        "t": sim_now,
+        "rounds": rounds,
+        "events": sum(p["events"] for p in parts),
+        "units_sent": sum(p["units_sent"] for p in parts),
+        "units_dropped": sum(p["units_dropped"] for p in parts),
+        "units_blackholed": sum(p["units_blackholed"] for p in parts),
+        "bytes_sent": sum(p["bytes_sent"] for p in parts),
+        "ev_key": sum(p["ev_key"] for p in parts),
+        "tokens_down": tokens,
+        "bucket_avail": bucket,
+        "last_refill": parts[0]["last_refill"],
+        "faults": parts[0]["faults"],
         "hosts": hosts,
     }
     return _digest(g), hosts

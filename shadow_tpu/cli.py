@@ -35,6 +35,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="override experimental.scheduler_policy",
     )
     p.add_argument(
+        "--shards", type=int, metavar="N",
+        help="partition the host set across N worker processes "
+        "(general.sim_shards): static id-modulo placement, conservative "
+        "cross-shard windows, byte-identical results at any shard count",
+    )
+    p.add_argument(
         "--checkpoint-every", metavar="SIMTIME",
         help="write a full-state checkpoint every SIMTIME of simulated "
         "time (general.checkpoint_every); resumed runs are byte-identical "
@@ -98,6 +104,7 @@ def overrides_from_args(args: argparse.Namespace) -> dict:
         "log_level": "general.log_level",
         "data_directory": "general.data_directory",
         "scheduler_policy": "experimental.scheduler_policy",
+        "shards": "general.sim_shards",
         "checkpoint_every": "general.checkpoint_every",
         "checkpoint_dir": "general.checkpoint_dir",
         "state_digest_every": "general.state_digest_every",
@@ -156,7 +163,24 @@ def main(argv=None) -> int:
         ))
         return 0
 
-    if args.resume_from:
+    if cfg.general.sim_shards > 1:
+        # multi-process host partitioning (shadow_tpu/parallel/shards.py):
+        # the parent coordinator replaces the single-process controller;
+        # results are byte-identical at any shard count
+        from shadow_tpu.checkpoint import CheckpointError
+        from shadow_tpu.parallel.shards import run_sharded
+
+        try:
+            result = run_sharded(cfg, mirror_log=not args.quiet,
+                                 resume_from=args.resume_from or None)
+        except FileNotFoundError as exc:
+            print(f"shadow_tpu: checkpoint not found: {exc}",
+                  file=sys.stderr)
+            return 2
+        except (ValueError, CheckpointError) as exc:
+            print(f"shadow_tpu: {exc}", file=sys.stderr)
+            return 2
+    elif args.resume_from:
         from shadow_tpu.checkpoint import CheckpointError, load_checkpoint
 
         try:

@@ -78,6 +78,14 @@ class GeneralOptions:
     #: are comparable across scheduler policies and data planes; diff two
     #: with tools/bisect_divergence.py.
     state_digest_every: int = 0
+    #: multi-process host partitioning (shadow_tpu/parallel/shards.py):
+    #: partition the host set across N worker processes (static id-modulo
+    #: placement), each running its own scheduler + engine over its
+    #: subset, coordinated by a parent running the conservative
+    #: min-latency lookahead barrier across shards. Results are
+    #: byte-identical at ANY shard count (tests/test_shards.py); 1 = the
+    #: single-process controller, unchanged.
+    sim_shards: int = 1
 
 
 @dataclass
@@ -400,6 +408,9 @@ def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
     g.state_digest_every = int(gen.get("state_digest_every", 0))
     _require(g.state_digest_every >= 0,
              "general.state_digest_every must be >= 0")
+    g.sim_shards = int(gen.get("sim_shards", 1))
+    _require(1 <= g.sim_shards <= 64,
+             "general.sim_shards must be in [1, 64]")
 
     if doc.get("network"):
         cfg.network = doc["network"]
@@ -464,12 +475,26 @@ def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
     for name in hosts_doc:  # dict preserves YAML order -> deterministic host ids
         h = hosts_doc[name] or {}
         qty = int(h.pop("quantity", 1)) if isinstance(h, dict) else 1
-        if qty == 1:
+        # quantity templates may cycle placement: copy i lands on
+        # network_node_ids[i % len] — what keeps a 1M-host generated
+        # config (examples/tor_1m.yaml) at O(templates) YAML instead of
+        # one stanza per host while still spreading a contiguous-named
+        # population (relay0..relayN-1) across the whole graph
+        node_cycle = h.pop("network_node_ids", None) \
+            if isinstance(h, dict) else None
+        if node_cycle is not None:
+            _require(isinstance(node_cycle, list) and len(node_cycle) > 0,
+                     f"host {name!r} network_node_ids must be a non-empty "
+                     f"list")
+        if qty == 1 and node_cycle is None:
             cfg.hosts.append(_parse_host(str(name), h))
         else:
-            _require(qty > 1, f"host {name!r} quantity must be >= 1")
+            _require(qty >= 1, f"host {name!r} quantity must be >= 1")
             for i in range(qty):
-                cfg.hosts.append(_parse_host(f"{name}{i}", h))
+                ho = _parse_host(f"{name}{i}", h)
+                if node_cycle is not None:
+                    ho.network_node_id = int(node_cycle[i % len(node_cycle)])
+                cfg.hosts.append(ho)
     names = [h.name for h in cfg.hosts]
     _require(len(set(names)) == len(names), "duplicate host names after expansion")
     return cfg

@@ -52,12 +52,39 @@ _GC_EVERY_ROUNDS = 5000
 #: (the single source of truth for tests and tools/ci.sh; WHICH windows
 #: the device served legitimately varies run to run while output trees
 #: stay bit-identical)
+#: "sim_shards"/"shards" are the scale-out plane's run-shape telemetry
+#: (parallel/shards.py): which partition executed a simulation is as
+#: immaterial to its results as which windows the device served
 VOLATILE_SUMMARY_KEYS = ("wall_seconds", "sim_sec_per_wall_sec",
                          "phase_wall", "max_rss_mb", "device",
-                         "device_windows_dispatched")
+                         "device_windows_dispatched", "sim_shards",
+                         "shards")
 
 
 class Controller:
+    #: multi-process sharding (shadow_tpu/parallel/shards.py): the shard
+    #: worker subclass overrides these INSTANCE attrs before calling
+    #: __init__; the base controller owns every host. owns() gates which
+    #: hosts get processes, scheduler slots, fault lifecycle transitions,
+    #: telemetry columns, and digest fingerprints.
+    shard_id = 0
+    n_shards = 1
+
+    def owns(self, hid: int) -> bool:
+        return self.n_shards == 1 or hid % self.n_shards == self.shard_id
+
+    def _sched_hosts(self) -> list:
+        """The hosts this controller's scheduler executes: all of them,
+        or the owned subset on a shard worker (a scheduler policy's
+        host→thread placement cannot change results, so neither can the
+        shard partition — same argument, one level up)."""
+        if self.n_shards == 1:
+            return self.hosts
+        return [h for h in self.hosts if self.owns(h.id)]
+
+    def _log_name(self) -> str:
+        return "shadow.log"
+
     def __init__(self, cfg: ConfigOptions, mirror_log: bool = True) -> None:
         self.cfg = cfg
         if cfg.general.checkpoint_every:
@@ -67,7 +94,8 @@ class Controller:
 
             validate_config_checkpointable(cfg)
         self.data_dir = Path(cfg.general.data_directory)
-        self.log = SimLogger(cfg.general.log_level, self.data_dir / "shadow.log",
+        self.log = SimLogger(cfg.general.log_level,
+                             self.data_dir / self._log_name(),
                              mirror_stderr=mirror_log)
         self.graph = load_graph(cfg.network["graph"])
 
@@ -176,7 +204,8 @@ class Controller:
         for h in self.hosts:
             h.engine = self.engine
             h.equeue.on_first = partial(self._active.add, h.id)
-        self.scheduler = make_scheduler(policy, self.hosts, cfg.general.parallelism)
+        self.scheduler = make_scheduler(policy, self._sched_hosts(),
+                                        cfg.general.parallelism)
         # C engine (native colcore): owns the per-round host loop and
         # maintains the active set directly
         self._c_core = getattr(self.engine, "_c", None)
@@ -192,8 +221,13 @@ class Controller:
 
         # processes: pyapp: plugins run in-process; any other path is a real
         # executable run under the native preload shim (SURVEY.md §7 phase 4)
+        # (sharded workers build processes only for their OWNED hosts — a
+        # non-owned host is pure topology here, its simulation lives on
+        # the owning shard)
         self.processes: list = []
         for host, hopts in zip(self.hosts, cfg.hosts):
+            if not self.owns(host.id):
+                continue
             for i, popts in enumerate(hopts.processes):
                 if PluginProcess.is_plugin_path(popts.path):
                     proc = PluginProcess(host, popts, i)
@@ -294,7 +328,7 @@ class Controller:
 
         cfg = self.cfg
         self.data_dir = Path(cfg.general.data_directory)
-        self.log.path = self.data_dir / "shadow.log"
+        self.log.path = self.data_dir / self._log_name()
         self.log.mirror = mirror_log
         # log_level is a volatile config key: honor the resume invocation's
         # value on the main log and on hosts without a per-host override
@@ -307,7 +341,7 @@ class Controller:
                          else self.data_dir / "checkpoints")
         self.digest_every = cfg.general.state_digest_every
         self.scheduler = make_scheduler(
-            cfg.experimental.scheduler_policy, self.hosts,
+            cfg.experimental.scheduler_policy, self._sched_hosts(),
             cfg.general.parallelism)
         self.engine.reattach_device(cfg.experimental)
         # C engine: rebuild over the restored structures and REWIRE the

@@ -15,7 +15,13 @@ Correctness note: within a round, a host's events touch only that host's
 state; cross-host effects flow exclusively through the engine at the round
 barrier. So any assignment of hosts to threads yields identical results —
 the determinism tests (tests/test_e2e_phase1.py) assert this across
-policies.
+policies. Multi-process sharding (shadow_tpu/parallel/shards.py) is the
+same argument one level up: each shard worker builds its scheduler over
+its OWNED host subset only (Controller._sched_hosts), and the id-modulo
+partition of hosts across processes can no more change results than the
+id-modulo partition across threads below — tests/test_shards.py asserts
+byte-identity at any shard count, including under thread_per_core
+inside the workers.
 
 CPython's GIL means thread policies don't add real CPU parallelism for pure-
 Python workloads; they exist for structural parity with the reference and

@@ -301,7 +301,7 @@ class Host:
 
     # -- units ------------------------------------------------------------
     def next_uid(self) -> int:
-        uid = (self.id << 40) | self._uid_counter
+        uid = (self.id << 32) | self._uid_counter
         self._uid_counter += 1
         return uid
 

@@ -157,6 +157,12 @@ class ColumnarPlane(DeviceRoutedPlane):
         self.emitters: list = []  # hosts with egress rows this round
         self.ack_hosts: list = []  # hosts owing coalesced barrier acks
         self._deferred: set = set()  # hosts with ingress backlog
+        #: multi-process sharding (parallel/shards.py): resolved rows for
+        #: hosts owned by another shard divert into xout[dst_shard]
+        #: (13-field store rows) instead of the local pending store
+        self.shard_id = 0
+        self.shard_n = 1
+        self.xout = None
         #: controller hook: called with a host id when extraction flags it
         #: runnable (keeps the active-host set correct)
         self.activate = None
@@ -209,6 +215,10 @@ class ColumnarPlane(DeviceRoutedPlane):
                 cb._restore_state((b.pos, list(b.rows)))
                 self.pending[i] = cb
         self._c = _colcore.Core(self)
+        if self.shard_n > 1:
+            if self.xout is None:
+                self.xout = [[] for _ in range(self.shard_n)]
+            self._c.bind_shard(self.shard_id, self.shard_n, self.xout)
         return self._c
 
     # state queries (controller) -------------------------------------------
@@ -375,7 +385,7 @@ class ColumnarPlane(DeviceRoutedPlane):
             hr = h.egress_rows[:]
             h.egress_rows.clear()
             k = len(hr)
-            base = (h.id << 40) | h._uid_counter
+            base = (h.id << 32) | h._uid_counter
             if rr and k > 1:
                 # uids follow EMISSION order (the per-unit plane mints
                 # them before the qdisc reorders), so carry each row's
@@ -468,7 +478,6 @@ class ColumnarPlane(DeviceRoutedPlane):
             depart = self.buckets.depart_times_scalar(
                 src_all, [r[E_SIZE] for r in rows],
                 [r[E_TEMIT] for r in rows], round_start)
-        key0 = self._ev_key
         keep_rows: list = []
         src_l: list = []
         arrival_l: list = []
@@ -492,9 +501,10 @@ class ColumnarPlane(DeviceRoutedPlane):
             if lat < mul:
                 mul = lat
             arrival_l.append(depart[i] + lat)
-            # keys are dense over the POST-blackhole batch, matching the
-            # per-unit plane's arange after its reach filter
-            keys_l.append(key0 + len(keys_l))
+            # the canonical event key IS the uid (placement-independent;
+            # see engine.py _schedule_batch) — _ev_key stays a resolved-
+            # units counter for the determinism sentinel
+            keys_l.append(uids[i])
             uid_keep.append(uids[i])
             th = int(thresh_t[sn, dn])
             thresh_l.append(th)
@@ -585,7 +595,8 @@ class ColumnarPlane(DeviceRoutedPlane):
         if ml < self.min_used_latency:
             self.min_used_latency = ml
         thresh = p.drop_thresh[sn, dn]
-        keys_l = list(range(self._ev_key, self._ev_key + n))
+        # canonical keys = uids (placement-independent; engine.py twin)
+        keys_l = uid.astype(np.int64).tolist()
         self._ev_key += n
 
         src_l = src.tolist()
@@ -1055,7 +1066,9 @@ class ColumnarPlane(DeviceRoutedPlane):
     def _store_resolved(self, rows, src_l, arrival, keys, flags,
                         round_end: SimTime) -> None:
         """Flags known (None = all survive): build one sorted StoreBatch
-        of arrival rows for the surviving units."""
+        of arrival rows for the surviving units. Under multi-process
+        sharding, rows for hosts owned by another shard divert into the
+        per-shard xout buffers instead (shipped at the round edge)."""
         if self._c is not None:
             self._c.store_resolved(rows, src_l, arrival, keys, flags,
                                    round_end)
@@ -1064,37 +1077,66 @@ class ColumnarPlane(DeviceRoutedPlane):
         nbytes_total = 0
         sent = 0
         dropped = 0
-        if flags is None:
-            for i, r in enumerate(rows):
-                nbytes_total += r[E_SIZE]
-                t = arrival[i]
-                if t < round_end:
-                    t = round_end
-                out.append((t, keys[i], r[E_DST], r[E_KIND], src_l[i],
-                            r[E_SPORT], r[E_DPORT], r[E_NBYTES], r[E_SEQ],
-                            r[E_FRAG], r[E_NFRAGS], r[E_SIZE],
-                            r[E_PAYLOAD]))
-            sent = len(rows)
-        else:
-            for i, r in enumerate(rows):
-                if flags[i]:
-                    dropped += 1
-                else:
-                    sent += 1
-                    nbytes_total += r[E_SIZE]
-                    t = arrival[i]
-                    if t < round_end:
-                        t = round_end
-                    out.append((t, keys[i], r[E_DST], r[E_KIND], src_l[i],
-                                r[E_SPORT], r[E_DPORT], r[E_NBYTES],
-                                r[E_SEQ], r[E_FRAG], r[E_NFRAGS],
-                                r[E_SIZE], r[E_PAYLOAD]))
+        sh_n, sh_id, xout = self.shard_n, self.shard_id, self.xout
+        for i, r in enumerate(rows):
+            if flags is not None and flags[i]:
+                dropped += 1
+                continue
+            sent += 1
+            nbytes_total += r[E_SIZE]
+            t = arrival[i]
+            if t < round_end:
+                t = round_end
+            row = (t, keys[i], r[E_DST], r[E_KIND], src_l[i],
+                   r[E_SPORT], r[E_DPORT], r[E_NBYTES], r[E_SEQ],
+                   r[E_FRAG], r[E_NFRAGS], r[E_SIZE], r[E_PAYLOAD])
+            if sh_n > 1 and r[E_DST] % sh_n != sh_id:
+                xout[r[E_DST] % sh_n].append(row)
+            else:
+                out.append(row)
         self.units_sent += sent
         self.units_dropped += dropped
         self.bytes_sent += nbytes_total
         if out:
             out.sort(key=_row_tk)
             self.pending.append(StoreBatch(out))
+
+    # -- multi-process sharding (parallel/shards.py) ------------------------
+    def bind_shard(self, shard_id: int, shard_n: int) -> None:
+        """Install the shard filter on this plane (and the C core when
+        attached): resolved rows for non-owned destinations divert into
+        xout[dst_shard] instead of the local pending store."""
+        self.shard_id = shard_id
+        self.shard_n = shard_n
+        self.xout = [[] for _ in range(shard_n)]
+        if self._c is not None:
+            self._c.bind_shard(shard_id, shard_n, self.xout)
+
+    def take_xout(self) -> list:
+        """Drain the per-shard cross-shard buffers, each sorted by the
+        unique (t, key) prefix."""
+        out, self.xout = self.xout, [[] for _ in range(self.shard_n)]
+        if self._c is not None:
+            self._c.bind_shard(self.shard_id, self.shard_n, self.xout)
+        for rows in out:
+            rows.sort(key=_row_tk)
+        return out
+
+    def ingest_remote(self, rows: list) -> None:
+        """Arrival rows shipped from another shard (already (t, key)
+        sorted): they join the pending store as one more resolved batch —
+        extraction merges them with local batches per destination host in
+        canonical order, exactly like any other overlapping StoreBatch."""
+        if not rows:
+            return
+        if self._c is not None:
+            from shadow_tpu.native import _colcore
+
+            cb = _colcore.shell("CBatch")
+            cb._restore_state((0, rows))
+            self.pending.append(cb)
+        else:
+            self.pending.append(StoreBatch(rows))
 
 
 class _WindowHandle:

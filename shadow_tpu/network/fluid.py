@@ -58,7 +58,10 @@ MAX_UNIT = 10 * MTU  # DEFAULT max wire bytes per transmission unit
 MAX_PKTS = 10  # = MAX_UNIT / MTU, loss draws per unit (default quantum)
 #: experimental.unit_mtus can widen the fluid quantum up to this bound;
 #: the per-packet counter packing (PKT_SHIFT) reserves 6 bits, and uid
-#: packing then caps host ids at 2**18 (enforced in NetParams.build)
+#: packing ((hid << 32) | ctr: host id sits in uid_hi, the 32-bit
+#: per-host counter in uid_lo) then caps host ids at 2**26 (enforced in
+#: NetParams.build — the bound that admits the 1M-host topologies;
+#: a host would need 2**32 lifetime emissions to overflow its counter)
 HARD_MAX_PKTS = 64
 PKT_SHIFT = 26  # packet-lane index position inside the threefry counter
 MIN_CAP = 16384  # token bucket capacity floor: one default MAX_UNIT + room
@@ -96,10 +99,11 @@ class NetParams:
         rate_down = np.asarray(rate_down, dtype=np.int64)
         if (rate_up <= 0).any() or (rate_down <= 0).any():
             raise ValueError("host bandwidths must be > 0")
-        if len(host_node) >= (1 << 18):
-            # uid packing: host id occupies uid_hi bits 8.., the packet
-            # lane occupies bits PKT_SHIFT.. — they must not overlap
-            raise ValueError("host count exceeds 2**18 (uid packing bound)")
+        if len(host_node) >= (1 << PKT_SHIFT):
+            # uid packing: uid_hi IS the host id, the packet lane
+            # occupies uid_hi bits PKT_SHIFT.. — they must not overlap
+            raise ValueError(
+                f"host count exceeds 2**{PKT_SHIFT} (uid packing bound)")
         if (rate_up > MAX_RATE).any() or (rate_down > MAX_RATE).any():
             raise ValueError(
                 f"host bandwidth exceeds {MAX_RATE} B/s "

@@ -9,9 +9,12 @@ transport; datagrams are fragmented into units by the socket layer. This
 keeps per-round batches small enough for Python assembly while the math
 stays per-packet-faithful.
 
-uid layout: (host_id << 40) | per-host counter — globally unique and
-assignable without cross-thread coordination, so unit creation is
-deterministic under every scheduler policy.
+uid layout: (host_id << 32) | per-host counter — globally unique and
+assignable without cross-thread/cross-process coordination, so unit
+creation is deterministic under every scheduler policy AND every
+sim_shards partition (the uid doubles as the canonical BAND_NET event
+key). The 32-bit counter keeps host ids inside uid_hi below the
+threefry packet lane (fluid.PKT_SHIFT), admitting 2**26 hosts.
 """
 
 from __future__ import annotations

@@ -77,6 +77,85 @@ def _dumps(obj) -> str:
     return json.dumps(obj, sort_keys=True, separators=(",", ":"))
 
 
+def _arr(v) -> str:
+    return "[%s]" % ",".join(map(str, v))
+
+
+def format_sample_line(g: dict, cols: dict, rounds: int, t: int) -> str:
+    """THE canonical sample-record serialization (hand-rolled sorted-key
+    JSON, byte-identical to json.dumps of the same mapping). Module-level
+    so the sharded parent can assemble the exact line a single-process
+    run would have written from merged per-shard columns."""
+    return (
+        '{"global":{"bucket_up":%s,"bytes_sent":%d,"events":%d,'
+        '"tokens_down":%s,"units_blackholed":%d,"units_dropped":%d,'
+        '"units_sent":%d},'
+        '"hosts":{"blackholed":%s,"conns":%s,"cwnd":%s,"deferred":%s,'
+        '"delivered":%s,"down":%s,"emitted":%s,"inflight":%s,'
+        '"retx":%s,"rto_backoff_max":%s,"rto_retries":%s,'
+        '"ssthresh_min":%s,"timers":%s},'
+        '"kind":"sample","round":%d,"t":%d}'
+        % (_arr(g["bucket_up"]), g["bytes_sent"], g["events"],
+           _arr(g["tokens_down"]), g["units_blackholed"],
+           g["units_dropped"], g["units_sent"],
+           _arr(cols["blackholed"]), _arr(cols["conns"]),
+           _arr(cols["cwnd"]), _arr(cols["deferred"]),
+           _arr(cols["delivered"]), _arr(cols["down"]),
+           _arr(cols["emitted"]), _arr(cols["inflight"]),
+           _arr(cols["retx"]), _arr(cols["rto_backoff_max"]),
+           _arr(cols["rto_retries"]), _arr(cols["ssthresh_min"]),
+           _arr(cols["timers"]),
+           rounds, t))
+
+
+def host_columns(hosts) -> dict:
+    """Per-host sampler columns for ``hosts`` (in the given order). The
+    single-process sampler passes all hosts in id order; a shard worker
+    passes its owned subset and the parent interleaves by host id."""
+    from shadow_tpu.core.events import BAND_NET
+
+    c_def, c_tmr, c_cn, c_inf, c_cwnd = [], [], [], [], []
+    c_ss, c_retx, c_rtr, c_bkf = [], [], [], []
+    c_em, c_dl, c_down, c_bh = [], [], [], []
+    for h in hosts:
+        c_def.append(len(h.ingress_deferred)
+                     + len(h.ingress_deferred_rows))
+        c_tmr.append(h.equeue.live_count(exclude_band=BAND_NET))
+        conns = h._conns
+        inflight = cwnd = retx = retries = 0
+        backoff_max = 0
+        ss_min = 0
+        if conns:
+            for ep in conns.values():
+                s = ep.sender
+                inflight += int(s.snd_nxt) - int(s.snd_una)
+                cwnd += int(s.cwnd)
+                retx += int(s.loss_events)
+                retries += int(s.retries)
+                b = int(s.rto_backoff)
+                if b > backoff_max:
+                    backoff_max = b
+                ss = int(s.ssthresh)
+                if ss < _SSTHRESH_INF and (ss_min == 0 or ss < ss_min):
+                    ss_min = ss
+        c_cn.append(len(conns))
+        c_inf.append(inflight)
+        c_cwnd.append(cwnd)
+        c_ss.append(ss_min)
+        c_retx.append(retx)
+        c_rtr.append(retries)
+        c_bkf.append(backoff_max)
+        c_em.append(h._n_emitted)
+        c_dl.append(h._n_delivered)
+        c_down.append(1 if h.down else 0)
+        c_bh.append(h._n_blackholed)
+    return {"blackholed": c_bh, "conns": c_cn, "cwnd": c_cwnd,
+            "deferred": c_def, "delivered": c_dl, "down": c_down,
+            "emitted": c_em, "inflight": c_inf, "retx": c_retx,
+            "rto_backoff_max": c_bkf, "rto_retries": c_rtr,
+            "ssthresh_min": c_ss, "timers": c_tmr}
+
+
 class TelemetryCollector:
     """Owns the telemetry state of one run; hangs off the controller and
     rides its checkpoint pickle (histograms, sample cursor, flow counters
@@ -114,6 +193,14 @@ class TelemetryCollector:
         #: fixed at serialization time, so write batching cannot change
         #: the stream, only the syscall count)
         self._flow_lines: list = []
+        #: multi-process sharding (parallel/shards.py): (shard_id, N) on
+        #: a worker, else None. A sharded collector never writes
+        #: metrics.jsonl itself: fault records and sample partials queue
+        #: in _out_partials for the worker loop to ship to the parent,
+        #: and flow lines land in a per-shard flows.shard<k>.jsonl the
+        #: parent merges by (round, hid) at run end.
+        self.shard = None
+        self._out_partials: list = []
 
     # -- checkpoint/restore (shadow_tpu/checkpoint.py) ---------------------
     def __getstate__(self):
@@ -136,15 +223,33 @@ class TelemetryCollector:
             f = self._fh[name] = open(self._dir(controller) / name, "a")
         f.write("\n".join(lines) + "\n")
 
+    def _flows_name(self) -> str:
+        return (FLOWS_FILE if self.shard is None
+                else f"flows.shard{self.shard[0]}.jsonl")
+
     def sync(self, controller) -> None:
         """Flush buffered flow lines + cached handles to disk (checkpoint
         boundaries, samples, run end): the on-disk streams are complete
         at every graceful stop point."""
         if self._flow_lines:
             lines, self._flow_lines = self._flow_lines, []
-            self._append(controller, FLOWS_FILE, lines)
+            self._append(controller, self._flows_name(), lines)
         for f in self._fh.values():
             f.flush()
+
+    def drain_partials(self) -> list:
+        """Shard worker: pending fault-record lines + sample partials for
+        the parent (in production order)."""
+        out, self._out_partials = self._out_partials, []
+        return out
+
+    def export_merge_state(self) -> dict:
+        """Shard worker finalize: the mergeable reduction state (bucket
+        histograms + flow counts) the parent folds into the run summary."""
+        return {"samples": self.samples,
+                "flows_written": self.flows_written,
+                "hist": {k: h.state() for k, h in self.hist.items()},
+                "flow_counts": self.flow_counts}
 
     def close_files(self) -> None:
         for f in self._fh.values():
@@ -157,11 +262,23 @@ class TelemetryCollector:
         run into this directory and write the meta record readers key on
         (resumes append — the continuation of one stream)."""
         d = self._dir(controller)
+        if self.shard is not None:
+            # worker: own only the per-shard flow stream; the parent owns
+            # metrics.jsonl (meta record included — shard 0 ships the
+            # line, its params arrays are identical on every shard)
+            (d / self._flows_name()).unlink(missing_ok=True)
+            if self.shard[0] == 0:
+                self._out_partials.append(
+                    {"kind": "meta", "line": self._meta_line(controller)})
+            return
         (d / METRICS_FILE).unlink(missing_ok=True)
         (d / FLOWS_FILE).unlink(missing_ok=True)
-        eng = controller.engine
-        p = eng.params
-        self._append(controller, METRICS_FILE, [_dumps({
+        self._append(controller, METRICS_FILE,
+                     [self._meta_line(controller)])
+
+    def _meta_line(self, controller) -> str:
+        p = controller.engine.params
+        return _dumps({
             "kind": "meta",
             "version": 1,
             "sample_every": self.sample_every,
@@ -172,7 +289,7 @@ class TelemetryCollector:
             "rate_down": p.rate_down.tolist(),
             "cap_up": p.cap_up.tolist(),
             "cap_down": p.cap_down.tolist(),
-        })])
+        })
 
     # -- flow records (called from model code via Host.record_flow) --------
     def note_flow_host(self, host) -> None:
@@ -203,8 +320,17 @@ class TelemetryCollector:
         self.dirty = False
         if self._fault_pending:
             recs, self._fault_pending = self._fault_pending, []
-            self._append(controller, METRICS_FILE,
-                         [_dumps(r) for r in recs])
+            if self.shard is not None:
+                # fault application order is deterministic and identical
+                # on every shard; only shard 0's collector has the
+                # on_apply hook wired, and its records ship to the parent
+                # (which writes them before any same-round sample — the
+                # single-process on_round_end order)
+                self._out_partials.extend(
+                    {"kind": "fault", "line": _dumps(r)} for r in recs)
+            else:
+                self._append(controller, METRICS_FILE,
+                             [_dumps(r) for r in recs])
         if self.flow_hosts:
             self._flush_flows(controller)
         if round_end >= self.next_sample:
@@ -268,72 +394,35 @@ class TelemetryCollector:
         # non-sampling runs. Under the C engine this also folds the
         # C-side counter deltas into the Python attrs read below.
         eng.flush_all()
+        self.samples += 1
+        if self.shard is not None:
+            # shard worker: gather this shard's slice — owned hosts'
+            # columns + this engine's counter/bucket partials — and ship
+            # it to the parent, which interleaves the per-shard slices
+            # into the byte-exact single-process sample line
+            own = [h for h in controller.hosts if controller.owns(h.id)]
+            ids = [h.id for h in own]
+            levels = eng.buckets.levels(t)
+            self._out_partials.append({
+                "kind": "sample", "t": t, "ids": ids,
+                "cols": host_columns(own),
+                "g": {"units_sent": eng.units_sent,
+                      "units_dropped": eng.units_dropped,
+                      "units_blackholed": eng.units_blackholed,
+                      "bytes_sent": eng.bytes_sent,
+                      "events": controller.events,
+                      "bucket_up": levels[ids].tolist(),
+                      "tokens_down": eng.tokens_down[ids].tolist()},
+            })
+            self.sync(controller)  # flow lines land before the sample
+            return
         g = eng.telemetry_sample(t)
         g["events"] = controller.events
-        from shadow_tpu.core.events import BAND_NET
-
         # column-building stays a tight local-alias loop: the sampler runs
         # once per sample grid point over EVERY host, and its wall rides
         # the <=5% telemetry budget on the bench row
-        c_def, c_tmr, c_cn, c_inf, c_cwnd = [], [], [], [], []
-        c_ss, c_retx, c_rtr, c_bkf = [], [], [], []
-        c_em, c_dl, c_down, c_bh = [], [], [], []
-        for h in controller.hosts:
-            c_def.append(len(h.ingress_deferred)
-                         + len(h.ingress_deferred_rows))
-            c_tmr.append(h.equeue.live_count(exclude_band=BAND_NET))
-            conns = h._conns
-            inflight = cwnd = retx = retries = 0
-            backoff_max = 0
-            ss_min = 0
-            if conns:
-                for ep in conns.values():
-                    s = ep.sender
-                    inflight += int(s.snd_nxt) - int(s.snd_una)
-                    cwnd += int(s.cwnd)
-                    retx += int(s.loss_events)
-                    retries += int(s.retries)
-                    b = int(s.rto_backoff)
-                    if b > backoff_max:
-                        backoff_max = b
-                    ss = int(s.ssthresh)
-                    if ss < _SSTHRESH_INF and (ss_min == 0 or ss < ss_min):
-                        ss_min = ss
-            c_cn.append(len(conns))
-            c_inf.append(inflight)
-            c_cwnd.append(cwnd)
-            c_ss.append(ss_min)
-            c_retx.append(retx)
-            c_rtr.append(retries)
-            c_bkf.append(backoff_max)
-            c_em.append(h._n_emitted)
-            c_dl.append(h._n_delivered)
-            c_down.append(1 if h.down else 0)
-            c_bh.append(h._n_blackholed)
-        self.samples += 1
-
-        def arr(v):
-            return "[%s]" % ",".join(map(str, v))
-
-        # hand-rolled canonical JSON (sorted keys, _dumps separators —
-        # byte-identical to json.dumps of the same mapping; the sample
-        # record is ~14 x n_hosts integers and rides the wall budget)
-        line = (
-            '{"global":{"bucket_up":%s,"bytes_sent":%d,"events":%d,'
-            '"tokens_down":%s,"units_blackholed":%d,"units_dropped":%d,'
-            '"units_sent":%d},'
-            '"hosts":{"blackholed":%s,"conns":%s,"cwnd":%s,"deferred":%s,'
-            '"delivered":%s,"down":%s,"emitted":%s,"inflight":%s,'
-            '"retx":%s,"rto_backoff_max":%s,"rto_retries":%s,'
-            '"ssthresh_min":%s,"timers":%s},'
-            '"kind":"sample","round":%d,"t":%d}'
-            % (arr(g["bucket_up"]), g["bytes_sent"], g["events"],
-               arr(g["tokens_down"]), g["units_blackholed"],
-               g["units_dropped"], g["units_sent"],
-               arr(c_bh), arr(c_cn), arr(c_cwnd), arr(c_def), arr(c_dl),
-               arr(c_down), arr(c_em), arr(c_inf), arr(c_retx),
-               arr(c_bkf), arr(c_rtr), arr(c_ss), arr(c_tmr),
-               controller.rounds, t))
+        line = format_sample_line(g, host_columns(controller.hosts),
+                                  controller.rounds, t)
         self.sync(controller)  # flows land before the sample's write
         self._append(controller, METRICS_FILE, [line])
 
@@ -343,8 +432,12 @@ class TelemetryCollector:
         fault transitions) and close the stream handles."""
         if self._fault_pending:
             recs, self._fault_pending = self._fault_pending, []
-            self._append(controller, METRICS_FILE,
-                         [_dumps(r) for r in recs])
+            if self.shard is not None:
+                self._out_partials.extend(
+                    {"kind": "fault", "line": _dumps(r)} for r in recs)
+            else:
+                self._append(controller, METRICS_FILE,
+                             [_dumps(r) for r in recs])
         if self.flow_hosts:
             self._flush_flows(controller)
         self.sync(controller)

@@ -95,3 +95,13 @@ class LogHistogram:
         return {"total": self.total,
                 "counts": {str(k): self.counts[k]
                            for k in sorted(self.counts)}}
+
+    @classmethod
+    def from_state(cls, d: dict) -> "LogHistogram":
+        """Rebuild from state() output — the cross-process merge path
+        (shard workers ship their histogram states to the parent, which
+        merges them bucket-wise; mergeable by construction)."""
+        h = cls()
+        h.total = int(d["total"])
+        h.counts = {int(k): int(v) for k, v in d["counts"].items()}
+        return h

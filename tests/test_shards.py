@@ -1,0 +1,296 @@
+"""Multi-process shard determinism (shadow_tpu/parallel/shards.py).
+
+THE acceptance gate of the sharding PR: sim_shards=1/2/4 produce
+byte-identical output trees, flows.jsonl, metrics.jsonl, and digest
+streams on the fault-injection config (gossip flood + bulk stream under
+partition/degrade/churn), with the C engine on and off — shards=1 being
+the unchanged single-process controller. Plus: same-count checkpoint
+resume reproduces the uninterrupted tree, and a mismatched-count resume
+refuses by name.
+
+The wire/ring primitives get direct unit tests (payload round-trip,
+ring wrap, spill signaling) since a subtle packing bug would surface as
+a distant divergence otherwise.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import shutil
+from pathlib import Path
+
+import pytest
+import yaml
+
+from shadow_tpu.config.schema import parse_config
+from shadow_tpu.core.controller import VOLATILE_SUMMARY_KEYS, Controller
+from shadow_tpu.parallel import shards as sh
+
+ROOT = Path(__file__).resolve().parent.parent
+CHURN_YAML = ROOT / "examples" / "gossip_churn.yaml"
+
+#: shortened churn config: covers the partition (4s), its heal (9s is
+#: beyond), the degrade window start, and seeded churn from 2s
+STOP = "10s"
+
+
+def _cfg(tag: str, shards: int, colcore: bool = True, stop: str = STOP,
+         extra: dict = None):
+    doc = yaml.safe_load(CHURN_YAML.read_text())
+    over = {
+        "general.data_directory": f"/tmp/st-shards-{tag}",
+        "general.stop_time": stop,
+        "general.sim_shards": shards,
+        "general.state_digest_every": 50,
+        "telemetry.sample_every": "5s",
+        "experimental.scheduler_policy": "tpu_batch",
+        "experimental.native_colcore": colcore,
+        **(extra or {}),
+    }
+    # an extra of {key: None} removes the override (e.g. disable the
+    # telemetry section for the checkpoint legs)
+    over = {k: v for k, v in over.items() if v is not None}
+    shutil.rmtree(f"/tmp/st-shards-{tag}", ignore_errors=True)
+    return parse_config(doc, over)
+
+
+def _run(tag: str, shards: int, colcore: bool = True, stop: str = STOP,
+         extra: dict = None) -> dict:
+    cfg = _cfg(tag, shards, colcore, stop, extra)
+    if shards == 1:
+        return Controller(cfg, mirror_log=False).run()
+    return sh.run_sharded(cfg, mirror_log=False)
+
+
+def _tree(tag: str) -> dict:
+    out = {}
+    base = Path(f"/tmp/st-shards-{tag}")
+    for p in sorted((base / "hosts").rglob("*")):
+        if p.is_file():
+            out[str(p.relative_to(base))] = hashlib.sha256(
+                p.read_bytes()).hexdigest()
+    assert out
+    return out
+
+
+def _streams(tag: str) -> dict:
+    base = Path(f"/tmp/st-shards-{tag}")
+    out = {}
+    for name in ("flows.jsonl", "metrics.jsonl", "state_digests.jsonl"):
+        out[name] = hashlib.sha256((base / name).read_bytes()).hexdigest()
+    return out
+
+
+def _clean_summary(s: dict) -> dict:
+    s = dict(s)
+    for k in VOLATILE_SUMMARY_KEYS:
+        s.pop(k, None)
+    return s
+
+
+# -- the identity matrix ------------------------------------------------------
+
+def test_shard_identity_c_engine():
+    """shards=1 (plain controller) vs 2 vs 4 with the C engine: trees,
+    flow/metric/digest streams, and non-volatile summaries all byte-
+    identical under faults + churn + telemetry + the sentinel."""
+    s1 = _run("c1", 1)
+    t1, st1 = _tree("c1"), _streams("c1")
+    assert s1["counters"].get("host_crashes", 0) > 0  # adversity ran
+    assert s1["units_blackholed"] > 0
+    assert st1["flows.jsonl"] and st1["state_digests.jsonl"]
+    for n in (2, 4):
+        sn = _run(f"c{n}", n)
+        assert _tree(f"c{n}") == t1, f"tree diverged at shards={n}"
+        assert _streams(f"c{n}") == st1, f"streams diverged at shards={n}"
+        assert _clean_summary(sn) == _clean_summary(s1), \
+            f"summary diverged at shards={n}"
+        assert sn["sim_shards"] == n
+        assert len(sn["shards"]["per_shard"]) == n
+
+
+def test_shard_identity_python_plane():
+    """Same gate with the C engine OFF (pure-Python columnar plane):
+    shards=2 vs the single-process Python run — and the Python tree must
+    equal the C tree (the planes are twins, sharded or not)."""
+    s1 = _run("py1", 1, colcore=False)
+    t1, st1 = _tree("py1"), _streams("py1")
+    s2 = _run("py2", 2, colcore=False)
+    assert _tree("py2") == t1
+    assert _streams("py2") == st1
+    assert _clean_summary(s2) == _clean_summary(s1)
+
+
+@pytest.mark.slow
+def test_shard_identity_python_plane_4():
+    s1 = _run("py41", 1, colcore=False)
+    _run("py44", 4, colcore=False)
+    assert _tree("py44") == _tree("py41")
+    assert _streams("py44") == _streams("py41")
+
+
+def test_shard_identity_thread_policy():
+    """The per-unit plane (thread_per_core) shards too: same divert/
+    ingest contract, heap arrivals instead of a pending store."""
+    extra = {"experimental.scheduler_policy": "thread_per_core"}
+    _run("tp1", 1, extra=extra)
+    _run("tp2", 2, extra=extra)
+    assert _tree("tp2") == _tree("tp1")
+    assert _streams("tp2") == _streams("tp1")
+
+
+# -- checkpoint/resume --------------------------------------------------------
+
+def test_shard_checkpoint_resume_and_refusal():
+    """Same-count resume from a mid-churn shard manifest reproduces the
+    uninterrupted tree and continues the digest stream; a mismatched
+    shard count refuses by name; a single-process checkpoint refuses a
+    sharded resume (and vice versa)."""
+    from shadow_tpu import checkpoint as ckpt
+
+    full = _run("ckf", 2, extra={"telemetry.sample_every": None})
+    t_full = _tree("ckf")
+    dig_full = Path(
+        "/tmp/st-shards-ckf/state_digests.jsonl").read_text().splitlines()
+    _run("cks", 2, extra={"telemetry.sample_every": None,
+                          "general.checkpoint_every": "4s"})
+    manifests = sorted(Path("/tmp/st-shards-cks/checkpoints")
+                       .glob("*" + sh.MANIFEST_SUFFIX))
+    assert manifests, "sharded run wrote no manifest"
+    mani = manifests[0]
+    doc = json.loads(mani.read_text())
+    assert doc["sim_shards"] == 2
+    assert len(doc["files"]) == 2
+    for f in doc["files"]:
+        h = ckpt.read_header(mani.parent / f)
+        assert h["sim_shards"] == 2
+        assert h["shard"] in (0, 1)
+
+    # resume at the same count: tree identity + digest-stream suffix
+    cfg = _cfg("ckr", 2, extra={"telemetry.sample_every": None})
+    res = sh.run_sharded(cfg, mirror_log=False, resume_from=str(mani))
+    assert _tree("ckr") == t_full
+    dig_res = Path(
+        "/tmp/st-shards-ckr/state_digests.jsonl").read_text().splitlines()
+    assert dig_res == dig_full[-len(dig_res):]
+    assert _clean_summary(res)["counters"] == \
+        _clean_summary(full)["counters"]
+
+    # mismatched count refuses by name (manifest path and shard path)
+    cfg4 = _cfg("ckbad", 4, extra={"telemetry.sample_every": None})
+    with pytest.raises(ckpt.CheckpointError, match="sim_shards=2"):
+        sh.run_sharded(cfg4, mirror_log=False, resume_from=str(mani))
+    shard_file = mani.parent / doc["files"][0]
+    cfg4b = _cfg("ckbad2", 4, extra={"telemetry.sample_every": None})
+    with pytest.raises(ckpt.CheckpointError, match="sim_shards=2"):
+        sh.run_sharded(cfg4b, mirror_log=False,
+                       resume_from=str(shard_file))
+    # a shard checkpoint cannot resume into the single-process controller
+    cfg1 = _cfg("ckbad3", 1, extra={"telemetry.sample_every": None})
+    with pytest.raises(ckpt.CheckpointError, match="sim_shards"):
+        ckpt.load_checkpoint(str(shard_file), cfg1, mirror_log=False)
+
+
+# -- refusals -----------------------------------------------------------------
+
+def test_shard_config_refusals():
+    cfg = _cfg("ref1", 2)
+    cfg.experimental.scheduler_policy = "tpu_mesh"
+    with pytest.raises(ValueError, match="tpu_mesh"):
+        sh.validate_config_shardable(cfg)
+    cfg = _cfg("ref2", 2)
+    cfg.hosts[0].pcap_enabled = True
+    with pytest.raises(ValueError, match="pcap"):
+        sh.validate_config_shardable(cfg)
+    cfg = _cfg("ref3", 2)
+    cfg.hosts[0].processes[0].path = "/bin/true"
+    with pytest.raises(ValueError, match="managed"):
+        sh.validate_config_shardable(cfg)
+
+
+# -- wire format + rings ------------------------------------------------------
+
+def test_pack_unpack_roundtrip():
+    rows = [
+        (100, (3 << 40) | 7, 5, 2, 3, 4000, 80, 1234, 99, 0, 1, 1500,
+         b"payload-bytes"),
+        (200, (1 << 40) | 0, 2, 1, 1, 50000, 7000, 0, 7, 2, 3, 560,
+         ("inv", (1, 2, 3), "tx-id")),
+        (300, 42, 9, 4, 8, 1, 2, -5, -17, 0, 1, 40, None),
+        (2**62, 2**57, 11, 7, 10, 65535, 65535, 2**61, 2**60, 63, 64,
+         15000, "unicode-π"),
+    ]
+    assert sh.unpack_rows(sh.pack_rows(rows)) == rows
+    assert sh.unpack_rows(sh.pack_rows([])) == []
+
+
+class _OddPayload:
+    """Module-level so the pickle fallback can serialize it."""
+
+    def __init__(self, v):
+        self.v = v
+
+    def __eq__(self, other):
+        return isinstance(other, _OddPayload) and other.v == self.v
+
+
+def test_pack_pickle_fallback():
+    rows = [(1, 2, 3, 4, 5, 6, 7, 8, 9, 0, 1, 2, _OddPayload("x"))]
+    assert sh.unpack_rows(sh.pack_rows(rows)) == rows
+
+
+def test_shm_ring_wrap_and_spill():
+    import os
+
+    name = f"stpu_test_{os.getpid()}"
+    ring = sh.ShmRing(name, size=256, create=True)
+    try:
+        blocks = [bytes([i]) * (40 + i) for i in range(4)]
+        # fill/drain cycles force the wrap path several times
+        for cycle in range(10):
+            wrote = []
+            for b in blocks:
+                if ring.write(b):
+                    wrote.append(b)
+            assert wrote, "ring accepted nothing"
+            assert ring.read_all() == wrote
+        # a block larger than capacity signals a spill
+        assert not ring.write(b"x" * 300)
+        # writer-side blocks interleaved with partial drains
+        assert ring.write(b"a" * 100)
+        assert ring.read_all() == [b"a" * 100]
+        assert ring.write(b"b" * 100)
+        assert ring.write(b"c" * 100)
+        assert ring.read_all() == [b"b" * 100, b"c" * 100]
+    finally:
+        ring.close()
+        ring.unlink()
+
+
+def test_keys_are_uids():
+    """The canonical-key scheme the shard plane rests on: BAND_NET event
+    keys equal unit uids in every plane (placement-independent ordering).
+    Guarded here so a future key-scheme change cannot silently break
+    cross-shard ordering."""
+    from shadow_tpu.network import colplane as cp
+
+    doc = yaml.safe_load(CHURN_YAML.read_text())
+    cfg = parse_config(doc, {
+        "general.data_directory": "/tmp/st-shards-keys",
+        "general.stop_time": "4s",
+        "experimental.scheduler_policy": "tpu_batch"})
+    ctl = Controller(cfg, mirror_log=False)
+    eng = ctl.engine
+    assert isinstance(eng, cp.ColumnarPlane)
+    eng.bind_shard(0, 2)
+    ctl.run()
+    # every diverted row's key must be a well-formed uid of its src
+    moved = 0
+    for rows in eng.xout:
+        for r in rows:
+            assert r[1] >> 32 == r[4], (r[1], r[4])  # key's src == peer
+            moved += 1
+    # xout was drained nowhere (no parent): rows for shard-1 hosts stayed
+    assert moved > 0
+    assert eng.shard_n == 2

@@ -18,6 +18,15 @@ target instead of a haystack.
 Usage:
     python tools/bisect_divergence.py A/state_digests.jsonl B/state_digests.jsonl
     python tools/bisect_divergence.py --window-rounds K A.jsonl B.jsonl
+    python tools/bisect_divergence.py --shard K A_datadir B_datadir
+
+``--shard K`` (for runs made with ``general.sim_shards`` > 1) compares
+the shard-tagged sidecar streams ``state_digests.shard<K>.jsonl`` the
+sharded parent writes beside the merged stream: each covers one shard's
+OWNED hosts plus that shard's slice of the global observables, so a
+cross-shard divergence is localized to a round AND a shard. Pass the two
+data directories (or the sidecar files directly). Without --shard, a
+record carrying a "shard" tag still gets it printed in the report.
 
 ``--window-rounds K`` (for runs made with a fixed
 ``experimental.device_window_rounds``) additionally names which fused
@@ -107,33 +116,62 @@ def window_of(round_no: int, window_rounds: int) -> tuple[int, int, int]:
     return w, w * window_rounds + 1, (w + 1) * window_rounds
 
 
+def _shard_path(path: str, shard: int) -> str:
+    """Resolve a --shard argument: a data directory maps to its sidecar
+    stream; an explicit file path is taken as-is."""
+    import os
+
+    if os.path.isdir(path):
+        return os.path.join(path, f"state_digests.shard{shard}.jsonl")
+    return path
+
+
 def main(argv) -> int:
     window_rounds = 0
-    if argv and argv[0] == "--window-rounds":
+    shard = None
+    while argv and argv[0] in ("--window-rounds", "--shard"):
+        flag = argv[0]
         if len(argv) < 2:
             print(__doc__, file=sys.stderr)
             return 2
         try:
-            window_rounds = int(argv[1])
+            val = int(argv[1])
         except ValueError:
-            _die(f"--window-rounds expects an integer, got {argv[1]!r}")
-        if window_rounds < 1:
-            _die("--window-rounds must be >= 1 (the fixed K of the run)")
+            _die(f"{flag} expects an integer, got {argv[1]!r}")
+        if flag == "--window-rounds":
+            if val < 1:
+                _die("--window-rounds must be >= 1 (the fixed K of the "
+                     "run)")
+            window_rounds = val
+        else:
+            if val < 0:
+                _die("--shard must be >= 0")
+            shard = val
         argv = argv[2:]
     if len(argv) != 2:
         print(__doc__, file=sys.stderr)
         return 2
+    if shard is not None:
+        argv = [_shard_path(argv[0], shard), _shard_path(argv[1], shard)]
     recs_a, recs_b = load_stream(argv[0]), load_stream(argv[1])
     d = compare(recs_a, recs_b)
     if d is None:
         print(f"identical: {len(recs_a)} sentinel records agree "
               f"(through round {recs_a[-1]['round']})")
         return 0
+    # shard-tagged streams (sim_shards sidecars): name the shard in the
+    # report — the first divergent round AND shard, not just the round
+    tag = ""
+    if shard is not None:
+        tag = f" [shard {shard}]"
+    elif recs_a and "shard" in recs_a[0]:
+        tag = f" [shard {recs_a[0]['shard']}]"
     if d["kind"] == "digest":
         hosts = d["hosts"]
         where = (f"hosts: {', '.join(hosts)}" if hosts
                  else "global engine state only (no per-host divergence)")
-        print(f"FIRST DIVERGENT ROUND: {d['round']} (sim t={d['t']} ns)")
+        print(f"FIRST DIVERGENT ROUND: {d['round']}{tag} "
+              f"(sim t={d['t']} ns)")
         print(f"  last matching round: {d['last_match']}")
         print(f"  divergent {where}")
         if window_rounds:

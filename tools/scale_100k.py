@@ -1,15 +1,28 @@
-"""100k-host scale demonstration (BASELINE.md config #5's host count).
+"""100k-to-1M-host scale demonstration (BASELINE.md config #5's range).
 
-Builds a 100,000-host gossip network in memory (64-node random graph,
-quantity-templated hosts, 2 originators) and floods 2 transactions to
-every host. Exercises SURVEY.md §7 "Hard parts" #5: nothing in the
-engine materializes host² state — hosts index into (G×G) node tables.
+Builds a large gossip network (64-node random graph, quantity-templated
+hosts, 2 originators) — or, with ``--tor``, the tornettools-shaped
+relay/client config at the requested host count — and runs it, single
+process or partitioned across ``--shards`` worker processes
+(shadow_tpu/parallel/shards.py; byte-identical results at any count).
 
-Measured on one CPU core (2026-07-30): build ~6 s, run ~146 s for 8
-simulated seconds, 2.66M units, 199,919 tx deliveries (full coverage),
-1.1 GB peak RSS.
+Generation is streamed: the config is a handful of quantity templates
+(O(graph nodes), never O(hosts)) and expansion is one linear pass —
+nothing materializes host^2 state at ANY count (hosts index into (G, G)
+node tables). 1,000,000 hosts is in range: the uid/key packing admits
+2**26 hosts (network/unit.py).
 
-    python tools/scale_100k.py [--hosts 100000] [--stop 8]
+``--emit-yaml PATH`` writes the generated config as YAML instead of
+running it — ``examples/tor_1m.yaml`` is the committed 1M-host stub:
+
+    python tools/scale_100k.py --tor --hosts 1000000 \\
+        --emit-yaml examples/tor_1m.yaml
+
+Measured on one CPU core (2026-07-30, gossip 100k): build ~6 s, run
+~146 s for 8 simulated seconds, 2.66M units, 199,919 tx deliveries
+(full coverage), 1.1 GB peak RSS.
+
+    python tools/scale_100k.py [--hosts 100000] [--stop 8] [--shards N]
 """
 
 from __future__ import annotations
@@ -20,33 +33,15 @@ import time
 
 import numpy as np
 
+MAX_HOSTS = 1 << 26  # uid/key packing bound (network/unit.py)
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--hosts", type=int, default=100_000)
-    ap.add_argument("--stop", type=int, default=8, help="sim seconds")
-    ap.add_argument("--data-directory", default="/tmp/shadow-scale-100k")
-    args = ap.parse_args()
-    if args.hosts < 2 + 64:
-        ap.error("--hosts must be at least 66 (64 node templates + 2 "
-                 "originators)")
 
-    import sys
-    from pathlib import Path
-
-    here = Path(__file__).resolve().parent
-    sys.path.insert(0, str(here.parent))  # repo root: shadow_tpu package
-    sys.path.insert(0, str(here))
+def gossip_doc(n: int, stop_s: int, rng) -> dict:
     from gen_benchmarks import random_gml
 
-    from shadow_tpu.config import parse_config
-    from shadow_tpu.core.controller import Controller
-
-    rng = np.random.default_rng(20260730)
     g = 64
     gml = random_gml(rng, g, min_lat_ms=10, max_lat_ms=120, max_loss=0.002,
                      bw_choices=("50 Mbit", "100 Mbit"))
-    n = args.hosts
     hosts = {"origin_": {
         "network_node_id": 0, "quantity": 2,
         "processes": [{"path": "pyapp:shadow_tpu.models.gossip:GossipNode",
@@ -59,26 +54,153 @@ def main() -> None:
             "processes": [{
                 "path": "pyapp:shadow_tpu.models.gossip:GossipNode",
                 "args": ["7000", str(n), "8", "0", "2.0"]}]}
-    doc = {
-        "general": {"stop_time": f"{args.stop}s", "seed": 5,
+    return {
+        "general": {"stop_time": f"{stop_s}s", "seed": 5,
                     "heartbeat_interval": "4s"},
         "network": {"graph": {"type": "gml", "inline": gml}},
         "hosts": hosts,
     }
+
+
+def tor_doc(n: int, stop_s: int, rng) -> dict:
+    """The tornettools-shaped config (bench.py's _tor_doc shape) scaled
+    to ``n`` total hosts at the published relay:client ratio (~1:15,
+    like config #5's 7,000 relays per 107k hosts) — but generated as
+    O(templates) YAML: the contiguous relay0..relayN-1 population is ONE
+    quantity template whose ``network_node_ids`` cycle spreads it across
+    the graph (config/schema.py), and clients are per-node templates.
+    Nothing here is O(hosts), so the 1M-host stub stays a few hundred KB
+    and expansion is one linear pass at load."""
+    from gen_benchmarks import random_gml
+
+    g = 64
+    gml = random_gml(rng, g, min_lat_ms=10, max_lat_ms=120, max_loss=0.002,
+                     bw_choices=("50 Mbit", "100 Mbit", "1 Gbit"))
+    n_relays = max(16, n // 15)
+    n_clients = n - n_relays - 20
+    n_exits = max(1, n_relays // 8)  # exits first (TorClient's n_exits)
+    hosts = {
+        # relay placement cycles a seeded node permutation: round-robin
+        # across every graph node, names stay relay0..relayN-1
+        "relay": {
+            "quantity": n_relays,
+            "network_node_ids": [int(x) for x in rng.permutation(g)],
+            "processes": [{"path": "pyapp:shadow_tpu.models.tor:TorExit",
+                           "args": ["9001"]}]},
+        }
+    # exit capability is positional (relay0..relay{n_exits-1}), but the
+    # template stamps ONE process class — run TorExit everywhere: a
+    # TorExit behaves exactly like TorRelay for non-exit circuit
+    # positions (BEGIN cells only ever reach it as the last hop)
+    for i in range(20):
+        hosts[f"web{i}"] = {
+            "network_node_id": int(rng.integers(0, g)),
+            "processes": [{"path": "pyapp:shadow_tpu.models.tgen:TGenServer",
+                           "args": ["80"]}]}
+    per = n_clients // g
+    for i in range(g):
+        q = per + (n_clients - per * g if i == g - 1 else 0)
+        if q < 1:
+            continue  # tiny --hosts: skip empty per-node templates
+        hosts[f"u{i}_"] = {
+            "network_node_id": i, "quantity": q,
+            "processes": [{"path": "pyapp:shadow_tpu.models.tor:TorClient",
+                           "args": [str(n_relays), "9001", f"web{i % 20}",
+                                    "80", "20 kB", "1", str(n_exits)],
+                           "start_time": f"{2000 + i * 150} ms"}]}
+    return {"general": {"stop_time": f"{stop_s}s", "seed": 6,
+                        "heartbeat_interval": "4s"},
+            "network": {"graph": {"type": "gml", "inline": gml}},
+            "hosts": hosts}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hosts", type=int, default=100_000)
+    ap.add_argument("--stop", type=int, default=8, help="sim seconds")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="partition across N worker processes "
+                         "(general.sim_shards; results byte-identical "
+                         "at any count)")
+    ap.add_argument("--tor", action="store_true",
+                    help="generate the tornettools-shaped relay/client "
+                         "config instead of the gossip flood")
+    ap.add_argument("--emit-yaml", metavar="PATH",
+                    help="write the generated config as YAML and exit "
+                         "(how examples/tor_1m.yaml is produced)")
+    ap.add_argument("--data-directory", default="/tmp/shadow-scale-100k")
+    args = ap.parse_args()
+    if args.hosts < 2 + 64:
+        ap.error("--hosts must be at least 66 (64 node templates + 2 "
+                 "originators)")
+    if args.hosts >= MAX_HOSTS:
+        ap.error(f"--hosts must be below {MAX_HOSTS} (the uid/key "
+                 f"packing bound, network/unit.py)")
+
+    import sys
+    from pathlib import Path
+
+    here = Path(__file__).resolve().parent
+    sys.path.insert(0, str(here.parent))  # repo root: shadow_tpu package
+    sys.path.insert(0, str(here))
+
+    from shadow_tpu.config import parse_config
+
+    rng = np.random.default_rng(20260730)
+    n = args.hosts
+    doc = tor_doc(n, args.stop, rng) if args.tor \
+        else gossip_doc(n, args.stop, rng)
+    if args.shards > 1:
+        doc["general"]["sim_shards"] = args.shards
+
+    if args.emit_yaml:
+        import yaml
+
+        kind = "tor" if args.tor else "gossip"
+        header = (
+            f"# {n}-host {kind} scale config — GENERATED, do not "
+            f"hand-edit.\n"
+            f"# Regenerate: python tools/scale_100k.py "
+            f"{'--tor ' if args.tor else ''}--hosts {n} "
+            f"--stop {args.stop} --emit-yaml <path>\n"
+            f"# Run it sharded (shadow_tpu/parallel/shards.py):\n"
+            f"#   python -m shadow_tpu <path> --shards 4 "
+            f"--scheduler-policy tpu_batch\n")
+        with open(args.emit_yaml, "w") as f:
+            f.write(header)
+            yaml.safe_dump(doc, f, default_style=None)
+        print(f"wrote {args.emit_yaml} ({n} hosts, "
+              f"{len(doc['hosts'])} templates)")
+        return
+
     t0 = time.perf_counter()
     cfg = parse_config(doc, {"general.data_directory": args.data_directory})
-    c = Controller(cfg, mirror_log=False)
-    build_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    r = c.run()
+    if args.shards > 1:
+        from shadow_tpu.parallel.shards import ShardedRun
+
+        runner = ShardedRun(cfg, mirror_log=False)
+        build_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        r = runner.run()
+        rx = None  # processes live in the workers
+    else:
+        from shadow_tpu.core.controller import Controller
+
+        c = Controller(cfg, mirror_log=False)
+        build_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        r = c.run()
+        rx = (sum(p.app.received_tx for h in c.hosts for p in h.processes)
+              if not args.tor else None)
     run_s = time.perf_counter() - t0
     rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
-    rx = sum(p.app.received_tx for h in c.hosts for p in h.processes)
-    print(f"{n} hosts: build={build_s:.1f}s run={run_s:.1f}s "
+    rss = max(rss, r.get("max_rss_mb", 0) / 1024)
+    print(f"{n} hosts (shards={args.shards}): build={build_s:.1f}s "
+          f"run={run_s:.1f}s "
           f"sim-s/wall-s={r['sim_sec_per_wall_sec']:.3f} "
           f"events={r['events']} units={r['units_sent']} "
-          f"dropped={r['units_dropped']} rss={rss:.2f}GB "
-          f"tx_deliveries={rx}")
+          f"dropped={r['units_dropped']} rss={rss:.2f}GB"
+          + (f" tx_deliveries={rx}" if rx is not None else ""))
 
 
 if __name__ == "__main__":
