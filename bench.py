@@ -752,6 +752,168 @@ def tor_churned_ckpt(base_ratio=None) -> dict:
     return out
 
 
+def tor_400_sweep(n_seeds: int = 10, jobs: int = 2) -> dict:
+    """Fleet-mode row (ROADMAP item 5 acceptance): the 10-seed tor_400
+    sweep in ONE command vs standalone single runs, interleaved.
+
+    Protocol: 3x (standalone single, 10-seed sweep at jobs=2 with the
+    shared draw service) interleaved, plus one no-service sweep (the
+    shared-attach ablation) and one jobs=1 sweep (the jobs-efficiency
+    leg — on this box's 2 HT vCPUs, packing gains little; amortization
+    is the win). Identity evidence rides along at zero extra cost: the
+    service and no-service sweeps must agree on every per-seed tree
+    hash, and the base seed's in-sweep tree must equal the standalone
+    run's tree."""
+    import os
+    import shutil
+    import subprocess
+    import time as _t
+
+    from shadow_tpu import fleet as _fleet
+
+    cfg = "examples/tor_400relay.yaml"
+    env = dict(os.environ)
+
+    def single(tag):
+        d = f"/tmp/shadow-bench-sw-single-{tag}"
+        shutil.rmtree(d, ignore_errors=True)
+        t0 = _t.perf_counter()
+        r = subprocess.run(
+            [sys.executable, "-m", "shadow_tpu", cfg, "--quiet",
+             "--data-directory", d, "--scheduler-policy", "tpu_batch",
+             "--sample-every", "10s"],
+            capture_output=True, text=True, timeout=3600, env=env,
+            cwd=str(ROOT))
+        assert r.returncode == 0, (tag, r.stderr[-500:])
+        return round(_t.perf_counter() - t0, 2), d
+
+    def sweep(tag, extra):
+        d = f"/tmp/shadow-bench-sw-{tag}"
+        shutil.rmtree(d, ignore_errors=True)
+        t0 = _t.perf_counter()
+        r = subprocess.run(
+            [sys.executable, "-m", "shadow_tpu.fleet", "sweep", cfg,
+             "--seeds", str(n_seeds), "--sweep-dir", d,
+             "--set", "experimental.scheduler_policy=tpu_batch",
+             "--quiet", "--json"] + extra,
+            capture_output=True, text=True, timeout=3600, env=env,
+            cwd=str(ROOT))
+        assert r.returncode == 0, (tag, r.stderr[-800:])
+        s = json.loads(r.stdout)
+        assert len(s["completed"]) == n_seeds, (tag, s["failed"])
+        return round(_t.perf_counter() - t0, 2), d, s
+
+    # every leg rides the same interleaved median-of-3 discipline: this
+    # box's wall noise is +-20% on runs of this length, so a single-shot
+    # ablation leg would publish noise as a finding
+    singles = []
+    sweeps = []
+    nosvcs = []
+    j1s = []
+    for i in range(3):
+        singles.append(single(f"i{i}"))
+        sweeps.append(sweep(f"svc{i}", ["--jobs", str(jobs)]))
+        nosvcs.append(sweep(
+            f"nosvc{i}", ["--jobs", str(jobs), "--no-device-service"]))
+        j1s.append(sweep(f"j1-{i}", ["--jobs", "1"]))
+        log(f"tor_400_sweep rep {i}: single {singles[-1][0]}s, "
+            f"sweep {sweeps[-1][0]}s, no-service {nosvcs[-1][0]}s, "
+            f"jobs=1 {j1s[-1][0]}s")
+
+    def _med(runs):
+        return sorted(w for w, *_ in runs)[len(runs) // 2]
+
+    med_single = _med(singles)
+    med_sweep = _med(sweeps)
+    nosvc_wall = _med(nosvcs)
+    j1_wall = _med(j1s)
+    nosvc_dir = nosvcs[0][1]
+    med_i = [w for w, _d, _s in sweeps].index(med_sweep)
+    med_sum = sweeps[med_i][2]
+
+    # identity evidence: per-seed trees agree between the shared-service
+    # and local-attach sweeps (device routing can never change results),
+    # and the base seed in-sweep equals the standalone run
+    svc_dir = sweeps[0][1]
+    base_seed = med_sum["seeds"][0]
+    for seed in med_sum["seeds"]:
+        a = _fleet.output_tree_digest(_fleet.seed_dir(svc_dir, seed))
+        b = _fleet.output_tree_digest(_fleet.seed_dir(nosvc_dir, seed))
+        assert a == b, f"sweep seed {seed}: svc vs no-svc tree diverged"
+    solo_tree = _fleet.output_tree_digest(singles[0][1])
+    fleet_tree = _fleet.output_tree_digest(
+        _fleet.seed_dir(svc_dir, base_seed))
+    assert solo_tree == fleet_tree, \
+        "base seed: in-sweep tree != standalone tree"
+
+    # the statistics the sweep exists for
+    flows = med_sum["flows"]
+    assert flows, "sweep produced no flow groups"
+    k0 = sorted(flows)[0]
+    assert flows[k0]["ci95"]["p50_ms"]["n"] == n_seeds
+
+    ratio = med_sweep / med_single
+    serial_est = round(n_seeds * med_single, 1)
+    out = {
+        "n_seeds": n_seeds,
+        "jobs": jobs,
+        "single_run_wall_s": {"median": med_single,
+                              "raw": [w for w, _ in singles]},
+        "sweep_wall_s": {"median": med_sweep,
+                         "raw": [w for w, _d, _s in sweeps]},
+        "sweep_wall_no_service_s": {
+            "median": nosvc_wall, "raw": [w for w, *_ in nosvcs]},
+        "sweep_wall_jobs1_s": {
+            "median": j1_wall, "raw": [w for w, *_ in j1s]},
+        "ratio_sweep_vs_single": round(ratio, 2),
+        "target_3x_single": round(3 * med_single, 1),
+        "target_3x_met": bool(med_sweep < 3 * med_single),
+        "serial_10x_estimate_s": serial_est,
+        "speedup_vs_serial": round(serial_est / med_sweep, 2),
+        "marginal_wall_per_seed_s": round(
+            (med_sweep - med_single) / (n_seeds - 1), 2),
+        "shared_attach_savings_rel": round(
+            1 - med_sweep / nosvc_wall, 3),
+        "jobs_efficiency_note": (
+            f"jobs=1 {j1_wall}s vs jobs={jobs} {med_sweep}s: this box's "
+            f"2 vCPUs are HT siblings (box_parallel_scaling_2proc in "
+            f"tor_100k row), so packing adds little over the "
+            f"amortization wins (persistent workers, cached config "
+            f"parse, ONE shared device attach)"),
+        "per_seed_wall_s": med_sum["per_seed_wall_seconds"],
+        "draw_service": med_sum.get("draw_service"),
+        "identity": {
+            "svc_vs_nosvc_trees": "all seeds byte-identical",
+            "base_seed_vs_standalone": "byte-identical",
+            "full_per-seed standalone matrix":
+                "tests/test_fleet.py + ci.sh fleet gate",
+        },
+        "flow_ci_sample": {k0: flows[k0]["ci95"]},
+        "aggregation": "median-of-3 interleaved (single, sweep) "
+                       "subprocess pairs; ablations single-shot",
+        "note": (
+            "The sweep amortizes the single-run fixed wall "
+            f"(~{round(med_single - (med_sweep - med_single) / (n_seeds - 1), 1)}s "
+            f"of imports/attach/build per standalone run) down to "
+            f"~{round((med_sweep - med_single) / (n_seeds - 1), 2)}s "
+            f"marginal per seed. The <3x-single target needs ~2x real "
+            f"parallel capacity on top of that; this container's two "
+            f"HT-sibling vCPUs provide ~1.1-1.3x (published probe), "
+            f"which is also why sim_shards=2 is throughput-parity "
+            f"here. On a box with two real cores the same command "
+            f"meets the target arithmetically: "
+            f"{n_seeds}x{round((med_sweep - med_single) / (n_seeds - 1), 2)}s"
+            f"/2 + startup << 3x single."),
+    }
+    log(f"tor_400_sweep_{n_seeds}seed: sweep {med_sweep}s vs single "
+        f"{med_single}s = {ratio:.2f}x single ({out['speedup_vs_serial']}x "
+        f"faster than {n_seeds}x serial; 3x target "
+        f"{'MET' if out['target_3x_met'] else 'MISSED — 2-HT-vCPU box'}; "
+        f"shared attach saves {out['shared_attach_savings_rel']:.0%} vs "
+        f"per-member attach)")
+    return out
+
+
 #: per-shard busy-wall imbalance (max/min) above which the sharded row
 #: carries a straggler advisory: id-modulo placement assumes statistically
 #: uniform load, and a config that concentrates hot hosts on one shard
@@ -1280,7 +1442,29 @@ def main() -> None:
                          "shards=1/2/4, interleaved median-of-3, plus the "
                          "full-scale tor_100k at shards=2) and merge them "
                          "into BENCH_DETAIL.json")
+    ap.add_argument("--fleet", action="store_true",
+                    help="measure ONLY the fleet-mode row (10-seed "
+                         "tor_400 sweep vs standalone singles, "
+                         "interleaved, with shared-attach and jobs "
+                         "ablations) and merge it into BENCH_DETAIL.json")
     args = ap.parse_args()
+
+    if args.fleet:
+        detail_path = ROOT / "BENCH_DETAIL.json"
+        detail = json.loads(detail_path.read_text())
+        row = tor_400_sweep()
+        detail["tor_400_sweep_10seed"] = row
+        detail_path.write_text(json.dumps(detail, indent=2))
+        log("wrote BENCH_DETAIL.json (tor_400_sweep_10seed)")
+        print(json.dumps({
+            "metric": "tor_400_sweep_10seed_ratio_vs_single",
+            "value": row["ratio_sweep_vs_single"],
+            "speedup_vs_serial": row["speedup_vs_serial"],
+            "target_3x_met": row["target_3x_met"],
+            "shared_attach_savings_rel":
+                row["shared_attach_savings_rel"],
+        }), flush=True)
+        return
 
     if args.sharded:
         detail_path = ROOT / "BENCH_DETAIL.json"
@@ -1452,6 +1636,7 @@ def main() -> None:
         detail["real_curl_1k"] = real_curl_1k()
         detail["tor_100k"] = tor_100k()
         detail["tor_100k"]["tor_1_10_sharded"] = tor_sharded()
+        detail["tor_400_sweep_10seed"] = tor_400_sweep()
         detail["tpu_mesh_scaling"] = mesh_scaling()
         detail["tpu_mesh_scaling_forced_collective"] = mesh_scaling(
             force_collective=True)

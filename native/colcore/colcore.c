@@ -372,6 +372,17 @@ typedef struct {
    * bytes_sent) stays with the RESOLVING shard. */
   int32_t shard_id, shard_n;
   PyObject *xout; /* owned; NULL until bind_shard */
+  /* send-side packer (Core_take_xout_packed): when bind_shard receives
+   * xout=None, diverted rows accumulate HERE as packed SRec + payload
+   * refs and leave as shards.py wire-format byte blocks at the round
+   * edge — no 13-field Python tuples on the cross-shard send path
+   * (receive side was already packed via cbatch_from_packed). Buffers
+   * are drained every round edge, so they are empty at every snapshot
+   * boundary. Payload refs are owned (NULL = None). */
+  int xpacked;
+  SRec **xrecs;     /* [shard_n] growable per-destination-shard arrays */
+  PyObject ***xpay; /* [shard_n] parallel payload refs */
+  int *xn, *xcap;
   CHost *hs;
   /* scratch buffers reused across barriers */
   struct BRow *brow;
@@ -1154,16 +1165,36 @@ static int store_build(CoreObject *c, BRow *rows, int n, int have_flags,
       if (t < round_end) t = round_end;
       if (sh_n > 1 && b->dst % sh_n != c->shard_id) {
         /* cross-shard destination: divert the fully resolved store row
-         * (13-field tuple) into the per-shard egress buffer the plane
-         * ships at the round edge (parallel/shards.py) */
+         * into the per-shard egress buffer the plane ships at the round
+         * edge (parallel/shards.py) — packed SRec when the plane bound
+         * the packed send path, 13-field tuple otherwise */
         SRec s;
         s.t = t; s.key = b->key; s.tgt = b->dst; s.size = (int32_t)b->size;
         s.peer = b->src; s.bport = b->dport; s.aport = b->sport;
         s.nbytes = b->nbytes; s.seq = b->seq; s.kind = (int16_t)b->kind;
         s.frag = b->frag; s.nfrags = b->nfrags;
+        int j = b->dst % sh_n;
+        if (c->xpacked) {
+          if (c->xn[j] == c->xcap[j]) {
+            int nc = c->xcap[j] ? c->xcap[j] * 2 : 256;
+            SRec *nr = realloc(c->xrecs[j], sizeof(SRec) * (size_t)nc);
+            if (!nr) { free(out); PyErr_NoMemory(); return -1; }
+            c->xrecs[j] = nr;
+            PyObject **npp =
+                realloc(c->xpay[j], sizeof(PyObject *) * (size_t)nc);
+            if (!npp) { free(out); PyErr_NoMemory(); return -1; }
+            c->xpay[j] = npp;
+            c->xcap[j] = nc;
+          }
+          c->xrecs[j][c->xn[j]] = s;
+          Py_XINCREF(b->payload);
+          c->xpay[j][c->xn[j]] = b->payload;
+          c->xn[j]++;
+          continue;
+        }
         PyObject *row_t = srec_tuple(&s, b->payload);
         if (!row_t) { free(out); return -1; }
-        PyObject *lst = PyList_GET_ITEM(c->xout, b->dst % sh_n);
+        PyObject *lst = PyList_GET_ITEM(c->xout, j);
         int rc3 = PyList_Append(lst, row_t);
         Py_DECREF(row_t);
         if (rc3 < 0) { free(out); return -1; }
@@ -2234,6 +2265,17 @@ static void Core_dealloc(CoreObject *c) {
     free(c->spec);
   }
   free(c->spec_dq);
+  if (c->xrecs) {
+    for (int j = 0; j < c->shard_n; j++) {
+      for (int i = 0; i < c->xn[j]; i++) Py_XDECREF(c->xpay[j][i]);
+      free(c->xrecs[j]);
+      free(c->xpay[j]);
+    }
+    free(c->xrecs);
+    free(c->xpay);
+    free(c->xn);
+    free(c->xcap);
+  }
   Py_XDECREF(c->hosts);
   Py_XDECREF(c->pending);
   Py_XDECREF(c->deferred);
@@ -2524,25 +2566,184 @@ static PyObject *Core_adopt(CoreObject *c, PyObject *arg);
 /* -- fault lifecycle (shadow_tpu/faults.py) ------------------------------ */
 static PyObject *Core_bind_shard(CoreObject *c, PyObject *args) {
   /* multi-process sharding: (shard_id, n_shards, xout) where xout is the
-   * plane's list of n_shards per-destination-shard row lists. Rebinding
-   * (e.g. after take_xout swaps fresh lists in) is the normal pattern. */
+   * plane's list of n_shards per-destination-shard row lists — or None,
+   * which selects the PACKED send path: diverted rows accumulate in the
+   * core's SRec buffers and drain as wire-format blocks via
+   * take_xout_packed (no per-row Python tuples). Rebinding (e.g. after
+   * take_xout swaps fresh lists in) is the normal pattern. */
   int sid, n;
   PyObject *xout;
   if (!PyArg_ParseTuple(args, "iiO", &sid, &n, &xout)) return NULL;
-  if (!PyList_Check(xout) || PyList_GET_SIZE(xout) != n) {
-    PyErr_SetString(PyExc_TypeError,
-                    "bind_shard expects xout as a list of n_shards lists");
-    return NULL;
-  }
   if (n < 1 || sid < 0 || sid >= n) {
     PyErr_SetString(PyExc_ValueError, "bind_shard: shard_id/n out of range");
     return NULL;
   }
+  if (xout == Py_None) {
+    if (!c->xrecs || c->shard_n != n) {
+      if (c->xrecs) { /* shard count changed: drop the old buffers */
+        for (int j = 0; j < c->shard_n; j++) {
+          for (int i = 0; i < c->xn[j]; i++) Py_XDECREF(c->xpay[j][i]);
+          free(c->xrecs[j]);
+          free(c->xpay[j]);
+        }
+        free(c->xrecs); free(c->xpay); free(c->xn); free(c->xcap);
+      }
+      c->xrecs = calloc((size_t)n, sizeof(SRec *));
+      c->xpay = calloc((size_t)n, sizeof(PyObject **));
+      c->xn = calloc((size_t)n, sizeof(int));
+      c->xcap = calloc((size_t)n, sizeof(int));
+      if (!c->xrecs || !c->xpay || !c->xn || !c->xcap) {
+        free(c->xrecs); free(c->xpay); free(c->xn); free(c->xcap);
+        c->xrecs = NULL; c->xpay = NULL; c->xn = NULL; c->xcap = NULL;
+        return PyErr_NoMemory();
+      }
+    }
+    c->xpacked = 1;
+    c->shard_id = sid;
+    c->shard_n = n;
+    Py_CLEAR(c->xout);
+    Py_RETURN_NONE;
+  }
+  if (!PyList_Check(xout) || PyList_GET_SIZE(xout) != n) {
+    PyErr_SetString(PyExc_TypeError,
+                    "bind_shard expects xout as a list of n_shards lists "
+                    "or None (packed mode)");
+    return NULL;
+  }
+  c->xpacked = 0;
   c->shard_id = sid;
   c->shard_n = n;
   Py_INCREF(xout);
   Py_XSETREF(c->xout, xout);
   Py_RETURN_NONE;
+}
+
+/* drain the packed cross-shard egress buffers (bind_shard(.., None)
+ * mode) as a list of per-destination-shard lists of wire-format byte
+ * blocks — the exact parallel/shards.py pack_rows layout
+ * ([n u64][numeric cols (n,12) i64][payload lens i64][blobs], rows
+ * (t,key)-sorted, marshal payloads with negative-length pickle
+ * fallback), chunked so no block exceeds max_bytes (a single giant row
+ * still forms one block; the worker's ring-capacity guard names it).
+ * This closes the send-side half of the packed wire path: the receiver
+ * already parses these bytes straight into a CBatch
+ * (cbatch_from_packed), and now the sender never materializes 13-field
+ * Python tuples either. */
+static PyObject *Core_take_xout_packed(CoreObject *c, PyObject *args) {
+  long long max_bytes;
+  if (!PyArg_ParseTuple(args, "L", &max_bytes)) return NULL;
+  if (!c->xpacked || !c->xrecs) {
+    PyErr_SetString(PyExc_RuntimeError,
+                    "take_xout_packed: packed mode not bound "
+                    "(bind_shard(sid, n, None) first)");
+    return NULL;
+  }
+  if (max_bytes < 4096) max_bytes = 4096;
+  PyObject *outer = PyList_New(c->shard_n);
+  if (!outer) return NULL;
+  for (int j = 0; j < c->shard_n; j++) {
+    PyObject *blocks = PyList_New(0);
+    if (!blocks) { Py_DECREF(outer); return NULL; }
+    PyList_SET_ITEM(outer, j, blocks);
+  }
+  for (int j = 0; j < c->shard_n; j++) {
+    int n = c->xn[j];
+    if (!n) continue;
+    PyObject *blocks = PyList_GET_ITEM(outer, j);
+    SRec *recs = c->xrecs[j];
+    PyObject **pay = c->xpay[j];
+    ORow *ord = malloc(sizeof(ORow) * (size_t)n);
+    PyObject **blobs = calloc((size_t)n, sizeof(PyObject *));
+    int64_t *lens = malloc(sizeof(int64_t) * (size_t)n);
+    int fail = !ord || !blobs || !lens;
+    if (!fail) {
+      for (int i = 0; i < n; i++) {
+        ord[i].t = recs[i].t;
+        ord[i].key = recs[i].key;
+        ord[i].idx = i;
+      }
+      qsort(ord, (size_t)n, sizeof(ORow), cmp_orow);
+      /* serialize payloads in sorted order (blobs[i] pairs with ord[i]) */
+      for (int i = 0; i < n && !fail; i++) {
+        PyObject *p = pay[ord[i].idx];
+        if (!p) { lens[i] = 0; continue; }
+        PyObject *b = PyMarshal_WriteObjectToString(p, Py_MARSHAL_VERSION);
+        if (b) {
+          lens[i] = (int64_t)PyBytes_GET_SIZE(b);
+        } else {
+          PyErr_Clear(); /* unmarshallable payload: pickle fallback */
+          PyObject *pickle = PyImport_ImportModule("pickle");
+          b = pickle ? PyObject_CallMethod(pickle, "dumps", "Oi", p, 4)
+                     : NULL;
+          Py_XDECREF(pickle);
+          if (!b) { fail = 1; break; }
+          lens[i] = -(int64_t)PyBytes_GET_SIZE(b);
+        }
+        blobs[i] = b;
+      }
+    }
+    /* emit chunks of the sorted rows (chunks of a sorted list stay
+     * sorted; each becomes its own pending batch at the receiver) */
+    int start = 0;
+    while (!fail && start < n) {
+      int64_t sz = 8;
+      int end = start;
+      while (end < n) {
+        int64_t row = 13 * 8 +
+                      (blobs[end] ? (int64_t)PyBytes_GET_SIZE(blobs[end])
+                                  : 0);
+        if (end > start && sz + row > max_bytes) break;
+        sz += row;
+        end++;
+      }
+      int64_t m = end - start;
+      PyObject *blk = PyBytes_FromStringAndSize(NULL, (Py_ssize_t)sz);
+      if (!blk) { fail = 1; break; }
+      char *w = PyBytes_AS_STRING(blk);
+      memcpy(w, &m, 8);
+      w += 8;
+      for (int i = start; i < end; i++) {
+        const SRec *s = &recs[ord[i].idx];
+        /* pack_rows column order (= the 13-tuple store-row prefix) */
+        int64_t cols[12] = {s->t,      s->key,   (int64_t)s->tgt,
+                            (int64_t)s->kind,    (int64_t)s->peer,
+                            (int64_t)s->aport,   (int64_t)s->bport,
+                            s->nbytes, s->seq,   (int64_t)s->frag,
+                            (int64_t)s->nfrags,  (int64_t)s->size};
+        memcpy(w, cols, 12 * 8);
+        w += 12 * 8;
+      }
+      memcpy(w, lens + start, (size_t)m * 8);
+      w += m * 8;
+      for (int i = start; i < end; i++) {
+        if (blobs[i]) {
+          Py_ssize_t bl = PyBytes_GET_SIZE(blobs[i]);
+          memcpy(w, PyBytes_AS_STRING(blobs[i]), (size_t)bl);
+          w += bl;
+        }
+      }
+      if (PyList_Append(blocks, blk) < 0) { Py_DECREF(blk); fail = 1; }
+      else Py_DECREF(blk);
+      start = end;
+    }
+    if (blobs)
+      for (int i = 0; i < n; i++) Py_XDECREF(blobs[i]);
+    free(blobs);
+    free(lens);
+    free(ord);
+    if (fail) {
+      /* fatal, not retryable: shards drained in EARLIER iterations ride
+       * the dropped `outer` blocks, so a caller must abort the run (the
+       * shard worker does — the error propagates as a worker failure) */
+      Py_DECREF(outer);
+      if (!PyErr_Occurred()) PyErr_NoMemory();
+      return NULL;
+    }
+    /* drained: release payload refs, reset the buffer */
+    for (int i = 0; i < n; i++) Py_XDECREF(pay[i]);
+    c->xn[j] = 0;
+  }
+  return outer;
 }
 
 static PyObject *Core_set_faults_active(CoreObject *c, PyObject *arg) {
@@ -2636,7 +2837,12 @@ static PyMethodDef Core_methods[] = {
      "(endpoint, on_cell) -> TorSink (C tor-client data path)"},
     {"bind_shard", (PyCFunction)Core_bind_shard, METH_VARARGS,
      "install the multi-process shard filter: (shard_id, n_shards, xout "
-     "per-shard row lists); cross-shard store rows divert into xout"},
+     "per-shard row lists — or None for the packed send path); "
+     "cross-shard store rows divert into xout / the packed buffers"},
+    {"take_xout_packed", (PyCFunction)Core_take_xout_packed, METH_VARARGS,
+     "(max_bytes) -> [[bytes blocks] per shard]: drain the packed "
+     "cross-shard egress as (t,key)-sorted shards.py wire-format blocks "
+     "(the send-side twin of cbatch_from_packed)"},
     {"set_faults_active", (PyCFunction)Core_set_faults_active, METH_O,
      "(flag) -> enable the faults_active-gated accounting (blackhole/"
      "teardown per-host counts, stream recovery counters)"},

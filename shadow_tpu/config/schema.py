@@ -500,11 +500,39 @@ def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
     return cfg
 
 
-def load_config(path: str, overrides: Optional[dict] = None) -> ConfigOptions:
+#: composed-YAML memo for load_yaml_doc(cache=True): a fleet worker runs
+#: many seeds of ONE config in one interpreter, and composing a
+#: multi-hundred-host document costs ~1.7 s (tor_400) — by far the
+#: biggest per-seed fixed cost once the round loop is subsecond. Keyed
+#: on (abspath, mtime_ns, size) so an edited file re-parses.
+_DOC_CACHE: dict = {}
+
+
+def load_yaml_doc(path: str, cache: bool = False) -> dict:
+    """Read + compose the YAML document at ``path``. ``cache=True``
+    memoizes the composed doc (callers must not mutate it —
+    parse_config deep-copies before applying overrides)."""
     import os
 
-    with open(path, "r") as f:
-        doc = yaml.safe_load(f)
+    if not cache:
+        with open(path, "r") as f:
+            return yaml.safe_load(f)
+    st = os.stat(path)
+    key = (os.path.abspath(path), st.st_mtime_ns, st.st_size)
+    doc = _DOC_CACHE.get(key)
+    if doc is None:
+        with open(path, "r") as f:
+            doc = yaml.safe_load(f)
+        _DOC_CACHE.clear()  # one config per process is the fleet shape
+        _DOC_CACHE[key] = doc
+    return doc
+
+
+def load_config(path: str, overrides: Optional[dict] = None,
+                cache_doc: bool = False) -> ConfigOptions:
+    import os
+
+    doc = load_yaml_doc(path, cache=cache_doc)
     cfg = parse_config(doc, overrides)
     # a network.graph file reference resolves relative to the CONFIG file
     # (the reference convention; lets committed configs carry committed

@@ -216,10 +216,19 @@ class ColumnarPlane(DeviceRoutedPlane):
                 self.pending[i] = cb
         self._c = _colcore.Core(self)
         if self.shard_n > 1:
+            self._bind_shard_core()
+        return self._c
+
+    def _bind_shard_core(self) -> None:
+        """Install the shard filter on the C core: the packed send path
+        (SRec buffers drained as wire bytes by take_xout_packed) when
+        the build has it, else the legacy per-row tuple divert."""
+        if hasattr(self._c, "take_xout_packed"):
+            self._c.bind_shard(self.shard_id, self.shard_n, None)
+        else:
             if self.xout is None:
                 self.xout = [[] for _ in range(self.shard_n)]
             self._c.bind_shard(self.shard_id, self.shard_n, self.xout)
-        return self._c
 
     # state queries (controller) -------------------------------------------
     def pending_head(self) -> SimTime:
@@ -1105,22 +1114,38 @@ class ColumnarPlane(DeviceRoutedPlane):
     def bind_shard(self, shard_id: int, shard_n: int) -> None:
         """Install the shard filter on this plane (and the C core when
         attached): resolved rows for non-owned destinations divert into
-        xout[dst_shard] instead of the local pending store."""
+        xout[dst_shard] (or the C core's packed buffers) instead of the
+        local pending store."""
         self.shard_id = shard_id
         self.shard_n = shard_n
         self.xout = [[] for _ in range(shard_n)]
         if self._c is not None:
-            self._c.bind_shard(shard_id, shard_n, self.xout)
+            self._bind_shard_core()
 
     def take_xout(self) -> list:
         """Drain the per-shard cross-shard buffers, each sorted by the
-        unique (t, key) prefix."""
+        unique (t, key) prefix. (With the packed C send path bound, rows
+        live in the core's buffers instead — take_xout_packed is the
+        drain; these Python lists stay empty.)"""
         out, self.xout = self.xout, [[] for _ in range(self.shard_n)]
-        if self._c is not None:
+        if self._c is not None and not hasattr(self._c,
+                                              "take_xout_packed"):
             self._c.bind_shard(self.shard_id, self.shard_n, self.xout)
         for rows in out:
             rows.sort(key=_row_tk)
         return out
+
+    def take_xout_packed(self, max_bytes: int):
+        """C send-side packer (parallel/shards.py): drain the diverted
+        cross-shard rows as ready-to-ship wire-format byte blocks —
+        (t, key)-sorted, chunked at ``max_bytes`` — without ever
+        materializing per-row Python tuples. Returns None when the C
+        core (or a build with the packer) is absent; callers fall back
+        to take_xout() + pack_rows."""
+        c = self._c
+        if c is None or not hasattr(c, "take_xout_packed"):
+            return None
+        return c.take_xout_packed(int(max_bytes))
 
     def ingest_remote(self, rows: list) -> None:
         """Arrival rows shipped from another shard (already (t, key)

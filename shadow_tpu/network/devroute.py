@@ -126,6 +126,27 @@ class DeviceRoutedPlane:
                 self.device_floor = floor
                 self._floor_forced = True
             else:
+                # fleet mode (shadow_tpu/fleet.py): a sweep member routes
+                # its draw windows to the fleet parent's ONE shared
+                # attach instead of attaching its own — the proxy quacks
+                # like the device plane and its results are bit-identical
+                # to the local twins, so this is pure wall-clock policy.
+                # Connection happens on the background thread (the
+                # parent's attach may still be warming); the member runs
+                # the numpy twin until the proxy publishes — exactly the
+                # background-attach discipline below.
+                import os as _os
+
+                svc = _os.environ.get("SHADOW_TPU_DRAW_SERVICE")
+                if svc:
+                    import threading
+
+                    self._svc_abort = False
+                    self._bg_thread = threading.Thread(
+                        target=self._bg_connect_service,
+                        args=(svc, params.seed, n_shards), daemon=True)
+                    self._bg_thread.start()
+                    return
                 # auto mode: device attach, kernel compile, and floor
                 # calibration run on a background thread — except when a
                 # previous run of this process already attached this
@@ -172,11 +193,38 @@ class DeviceRoutedPlane:
         except Exception:
             pass  # no usable device: the numpy twin serves everything
 
+    def _bg_connect_service(self, address: str, seed: int,
+                            n_shards: int) -> None:
+        """Fleet member: connect to the parent's shared draw service and
+        publish the proxy as this run's device plane. An unreachable
+        service degrades to the normal local attach path (which itself
+        degrades to the numpy twin) — never an error, never a result
+        change."""
+        try:
+            from shadow_tpu.fleet import FleetDrawClient
+
+            proxy = FleetDrawClient.connect(
+                address, seed, self.max_batch, self.max_pkts,
+                abort=lambda: self._svc_abort)
+        except Exception:
+            if getattr(self, "_svc_abort", False):
+                return  # run already over; nothing to publish
+            self._bg_init_device(seed, n_shards)
+            return
+        self._publish_device(proxy, proxy.dev_s, proxy.np_per_unit)
+
     def close(self) -> None:
-        """Join the background device-init thread (if any)."""
+        """Join the background device-init thread (if any) and release a
+        fleet draw-service proxy connection. A connect still waiting on
+        the service (short member run, slow parent attach) is aborted
+        rather than waited out."""
+        self._svc_abort = True
         t = getattr(self, "_bg_thread", None)
         if t is not None and t.is_alive():
             t.join()
+        d = getattr(self, "device", None)
+        if d is not None and hasattr(d, "close_client"):
+            d.close_client()
 
     # -- checkpoint/restore (shadow_tpu/checkpoint.py) ----------------------
     def __getstate__(self):

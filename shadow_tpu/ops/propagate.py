@@ -55,15 +55,20 @@ def _bucket(n: int, cap: int) -> int:
     return b
 
 
-@functools.partial(jax.jit, static_argnames=("seed", "width"))
-def _min_draw_kernel(packed, seed, width=MAX_PKTS):
+@functools.partial(jax.jit, static_argnames=("width",))
+def _min_draw_kernel(packed, seed_key, width=MAX_PKTS):
     """packed: (3, P) uint32 rows [uid_lo, uid_hi, npkts]; returns (P,)
     uint32: the MINIMUM 24-bit draw over each unit's first npkts packet
     lanes (0xFFFFFFFF for npkts == 0, which no threshold can undercut).
     This is the threshold-independent sufficient statistic behind the
     speculative forward windows: ``dropped == (min_draw < thresh)`` for
     ANY thresh, so one speculated row serves every destination a host
-    later picks — same integer math as _draw_kernel/fluid.loss_flags."""
+    later picks — same integer math as _draw_kernel/fluid.loss_flags.
+    ``seed_key`` is the (2,) uint32 threefry key, passed as DATA (not a
+    static arg) so ONE compiled program per shape serves EVERY seed —
+    fleet mode (shadow_tpu/fleet.py) packs M seeded simulations behind
+    one shared device plane, and a baked-in seed would recompile every
+    bucket shape per member."""
     from shadow_tpu.ops.prng import threefry2x32
 
     uid_lo, uid_hi, npkts = packed
@@ -71,20 +76,20 @@ def _min_draw_kernel(packed, seed, width=MAX_PKTS):
     pkt = jnp.arange(width, dtype=jnp.uint32)[None, :]
     c0 = jnp.broadcast_to(uid_lo[:, None], (p, width))
     c1 = uid_hi[:, None] | (pkt << jnp.uint32(PKT_SHIFT))
-    k0 = jnp.uint32(seed & 0xFFFFFFFF)
-    k1 = jnp.uint32((seed >> 32) & 0xFFFFFFFF)
-    draws, _ = threefry2x32(k0, k1, c0, c1, xp=jnp)
+    draws, _ = threefry2x32(seed_key[0], seed_key[1], c0, c1, xp=jnp)
     draws = (draws >> jnp.uint32(8)).astype(jnp.uint32)
     sentinel = jnp.uint32(0xFFFFFFFF)
     return jnp.min(jnp.where(pkt < npkts[:, None], draws, sentinel), axis=1)
 
 
-@functools.partial(jax.jit, static_argnames=("seed", "width"))
-def _draw_kernel(packed, seed, width=MAX_PKTS):
+@functools.partial(jax.jit, static_argnames=("width",))
+def _draw_kernel(packed, seed_key, width=MAX_PKTS):
     """packed: (4, P) uint32 rows [uid_lo, uid_hi, npkts, thresh]; returns
     (P,) bool dropped flags. Mirrors fluid.loss_flags exactly: a unit drops
     iff any of its first npkts threefry draws is below its q24 threshold.
-    (Padded entries carry thresh == 0, which can never hit.)"""
+    (Padded entries carry thresh == 0, which can never hit.) ``seed_key``
+    is traced data like in _min_draw_kernel: one program per shape, any
+    seed."""
     from shadow_tpu.ops.prng import threefry2x32
 
     uid_lo, uid_hi, npkts, thresh = packed
@@ -92,9 +97,7 @@ def _draw_kernel(packed, seed, width=MAX_PKTS):
     pkt = jnp.arange(width, dtype=jnp.uint32)[None, :]
     c0 = jnp.broadcast_to(uid_lo[:, None], (p, width))
     c1 = uid_hi[:, None] | (pkt << jnp.uint32(PKT_SHIFT))
-    k0 = jnp.uint32(seed & 0xFFFFFFFF)
-    k1 = jnp.uint32((seed >> 32) & 0xFFFFFFFF)
-    draws, _ = threefry2x32(k0, k1, c0, c1, xp=jnp)
+    draws, _ = threefry2x32(seed_key[0], seed_key[1], c0, c1, xp=jnp)
     draws = (draws >> jnp.uint32(8)).astype(jnp.uint32)
     hit = (draws < thresh[:, None]) & (pkt < npkts[:, None])
     # bit-pack the flags: the device->host readback is the scarce resource
@@ -173,10 +176,21 @@ class DeviceDrawPlane:
             self._sharding = NamedSharding(mesh, PartitionSpec(None, "d"))
             self._n_shards = n
 
+    def _seed_key(self, seed) -> np.ndarray:
+        """(2,) uint32 threefry key for ``seed`` (None = the plane's own).
+        Shipped to the kernels as data, so serving another simulation's
+        seed (fleet mode) reuses the already-compiled programs."""
+        s = self.seed if seed is None else int(seed)
+        return np.array([s & 0xFFFFFFFF, (s >> 32) & 0xFFFFFFFF],
+                        dtype=np.uint32)
+
     def dispatch(self, uid_lo: np.ndarray, uid_hi: np.ndarray,
-                 npkts: np.ndarray, thresh: np.ndarray) -> DrawHandle:
+                 npkts: np.ndarray, thresh: np.ndarray,
+                 seed: int = None) -> DrawHandle:
         """Launch one batch (any length <= max_batch) and start the async
-        device->host copy; returns a handle to read when due."""
+        device->host copy; returns a handle to read when due. ``seed``
+        overrides the plane's seed for this batch (the fleet draw server
+        serves many member sims' windows from one attach)."""
         n = uid_lo.shape[0]
         p = _bucket(n, self.max_batch)
         if self._sharding is not None:
@@ -189,7 +203,7 @@ class DeviceDrawPlane:
         packed[3, :n] = thresh
         dev_in = (jax.device_put(packed, self._sharding)
                   if self._sharding is not None else jnp.asarray(packed))
-        out = _draw_kernel(dev_in, seed=self.seed, width=self.max_pkts)
+        out = _draw_kernel(dev_in, self._seed_key(seed), width=self.max_pkts)
         try:
             out.copy_to_host_async()
         except AttributeError:  # some backends lack the hint; read() suffices
@@ -202,12 +216,13 @@ class DeviceDrawPlane:
     SPEC_BUCKET = 16384
 
     def dispatch_min(self, uid_lo: np.ndarray, uid_hi: np.ndarray,
-                     npkts: np.ndarray,
-                     min_bucket: int = 0) -> MinDrawHandle:
+                     npkts: np.ndarray, min_bucket: int = 0,
+                     seed: int = None) -> MinDrawHandle:
         """Launch one speculative min-draw batch (threshold-independent;
         see _min_draw_kernel) with the async device->host copy started.
         ``min_bucket`` pins the padded shape (shape stability = no
-        mid-run compiles; padded rows carry npkts 0 and can never hit)."""
+        mid-run compiles; padded rows carry npkts 0 and can never hit);
+        ``seed`` overrides the plane's seed (fleet draw server)."""
         n = uid_lo.shape[0]
         p = max(_bucket(n, self.max_batch), min_bucket)
         if self._sharding is not None:
@@ -219,7 +234,8 @@ class DeviceDrawPlane:
         packed[2, :n] = npkts
         dev_in = (jax.device_put(packed, self._sharding)
                   if self._sharding is not None else jnp.asarray(packed))
-        out = _min_draw_kernel(dev_in, seed=self.seed, width=self.max_pkts)
+        out = _min_draw_kernel(dev_in, self._seed_key(seed),
+                               width=self.max_pkts)
         try:
             out.copy_to_host_async()
         except AttributeError:
@@ -294,3 +310,199 @@ class DeviceDrawPlane:
         loss_flags(self.seed, lo, hi, npk, th)
         np_per_unit = (_walltime.perf_counter() - t0) / n_probe
         return dev_s, np_per_unit
+
+
+#: authkey for the fleet draw-service socket (local AF_UNIX only; the
+#: socket path lives in a mode-0700 directory — the key is a protocol
+#: sanity check, not the access control)
+DRAW_SERVICE_AUTHKEY = b"shadow-tpu-draw-service-v1"
+
+
+class DrawServer:
+    """The fleet parent's shared device plane: ONE process-group attach
+    (DeviceDrawPlane.attach_cached — compile, calibrate, warm_shapes paid
+    once) serving every member simulation's draw windows over an AF_UNIX
+    socket (shadow_tpu/fleet.py owns the member-side proxy). Because the
+    kernels take the threefry key as data, M members with M different
+    seeds share the same compiled programs — the batch-amortized regime
+    the 118 ms-round-trip device needs, without M redundant attaches.
+
+    Protocol (multiprocessing.connection, one serving thread per member):
+      member -> ("hello", seed)
+      server -> ("ok", dev_s, np_per_unit, SPEC_BUCKET, max_batch)
+      member -> ("draw", rid, seed, lo, hi, npk, th)
+               | ("min", rid, seed, lo, hi, npk, min_bucket) | ("bye",)
+      server -> (rid, result_array)   # FIFO per member; member demuxes
+
+    Routing is pure wall-clock policy (both paths are bit-identical), so
+    a dead or slow server can never change results — the member proxy
+    falls back to the in-process numpy twin on any transport error."""
+
+    def __init__(self, seed: int, max_batch: int = 65536,
+                 n_shards: int = 0, max_pkts: int = MAX_PKTS,
+                 address: str = None) -> None:
+        import os
+        import tempfile
+        import threading
+        from multiprocessing.connection import Listener
+
+        if address is None:
+            d = tempfile.mkdtemp(prefix="stpu_draw_")
+            os.chmod(d, 0o700)
+            address = os.path.join(d, "sock")
+        self.address = address
+        # the listener accepts IMMEDIATELY while the (multi-second)
+        # attach runs on a sibling thread: members connect and complete
+        # the socket handshake at once, then their hello waits (with an
+        # abortable poll on their side) for the plane to publish — so no
+        # member ever blocks uninterruptibly on a server that is still
+        # compiling (members run the numpy twin meanwhile, exactly like
+        # the background-attach path of a standalone run)
+        self._listener = Listener(address, family="AF_UNIX",
+                                  backlog=64, authkey=DRAW_SERVICE_AUTHKEY)
+        self._attach_args = (int(seed), int(max_batch), int(n_shards),
+                             int(max_pkts))
+        self.plane = None
+        self.dev_s = 0.0
+        self.np_per_unit = 0.0
+        self.attach_wall = 0.0
+        self._closing = False
+        self._ready = threading.Event()
+        self.served_batches = 0
+        self.served_units = 0
+        self._attach_thread = threading.Thread(
+            target=self._attach, name="draw-server-attach", daemon=True)
+        self._attach_thread.start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="draw-server-accept",
+            daemon=True)
+        self._accept_thread.start()
+
+    def _attach(self) -> None:
+        import os
+        import threading
+
+        try:
+            # mildly deprioritize the attach/compile against the fleet's
+            # pinned member processes: the shared plane is background
+            # amortization. Mild (nice 5), NOT SCHED_IDLE: the XLA host
+            # threads created during attach inherit this priority and
+            # later serve live member readbacks — starving them turns
+            # member window flushes into stalls (measured: SCHED_IDLE
+            # here made the whole sweep slower).
+            os.setpriority(os.PRIO_PROCESS, threading.get_native_id(), 5)
+        except (AttributeError, OSError, PermissionError):
+            pass
+        t0 = _walltime.perf_counter()
+        try:
+            self.plane, self.dev_s, self.np_per_unit = \
+                DeviceDrawPlane.attach_cached(*self._attach_args)
+        except Exception:
+            # no usable device: close the listener so member proxies get
+            # a clean connection error and fall back to local routing
+            self._closing = True
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            return
+        self.attach_wall = _walltime.perf_counter() - t0
+        self._ready.set()
+
+    def _accept_loop(self) -> None:
+        import os
+        import threading
+
+        try:
+            # the accept/serve path answers live member requests: keep it
+            # at normal priority (threads spawned here inherit it), while
+            # the attach thread — and the XLA pool it creates — idles
+            os.sched_setscheduler(0, os.SCHED_OTHER, os.sched_param(0))
+        except (AttributeError, OSError, PermissionError):
+            pass
+        while not self._closing:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                break  # listener closed
+            except Exception:
+                continue  # failed handshake from one member; keep serving
+            threading.Thread(target=self._serve, args=(conn,),
+                             name="draw-server-member",
+                             daemon=True).start()
+
+    def _serve(self, conn) -> None:
+        """Serve one member connection: dispatch requests on the shared
+        plane immediately (the device queues programs; concurrent member
+        threads interleave naturally under the GIL), answer in FIFO
+        order. The blocking read at the bottom only happens when no new
+        request is waiting in the pipe — the member that sent it is
+        either already blocked on exactly this response or still running
+        its rounds, so serving the oldest handle first is always
+        progress."""
+        from collections import deque
+
+        pending: deque = deque()
+        try:
+            msg = conn.recv()
+            if not (isinstance(msg, tuple) and msg and msg[0] == "hello"):
+                return
+            # the hello reply waits for the attach (member side polls
+            # with its own abort, so a member tearing down mid-attach
+            # just disconnects)
+            while not self._ready.wait(0.25):
+                if self._closing:
+                    return
+            plane = self.plane
+            conn.send(("ok", self.dev_s, self.np_per_unit,
+                       plane.SPEC_BUCKET, plane.max_batch))
+            while not self._closing:
+                while pending and pending[0][1].is_ready():
+                    rid, h = pending.popleft()
+                    conn.send((rid, h.read()))
+                if conn.poll(0.001 if pending else 0.25):
+                    msg = conn.recv()
+                    op = msg[0]
+                    if op == "bye":
+                        break
+                    rid, seed, lo, hi, npk, arg = msg[1:7]
+                    if op == "draw":
+                        h = plane.dispatch(lo, hi, npk, arg, seed=seed)
+                    else:  # "min"
+                        h = plane.dispatch_min(lo, hi, npk,
+                                               min_bucket=arg, seed=seed)
+                    pending.append((rid, h))
+                    self.served_batches += 1
+                    self.served_units += len(lo)
+                elif pending:
+                    rid, h = pending.popleft()
+                    conn.send((rid, h.read()))
+        except (EOFError, OSError, BrokenPipeError):
+            pass  # member exited; its fallback twin is bit-identical
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        import os
+        import shutil
+
+        self._closing = True
+        # closing the listener does NOT wake a thread blocked in
+        # accept(): poke it with a throwaway connection so the accept
+        # loop observes _closing and exits promptly
+        try:
+            from multiprocessing.connection import Client
+
+            Client(self.address, family="AF_UNIX",
+                   authkey=DRAW_SERVICE_AUTHKEY).close()
+        except Exception:
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self._accept_thread.join(timeout=2)
+        shutil.rmtree(os.path.dirname(self.address), ignore_errors=True)

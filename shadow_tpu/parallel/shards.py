@@ -369,6 +369,13 @@ class _ShardWorker:
 
             if hasattr(_colcore, "cbatch_from_packed"):
                 self._packed_ingest = _colcore.cbatch_from_packed
+        #: packed SEND (C engine with the send-side packer): the core
+        #: hands back ready wire blocks — BRow -> ring bytes in C, no
+        #: 13-field tuples before the wire. None falls back to
+        #: take_xout() + pack_rows (Python plane, older builds).
+        self._take_packed = getattr(ctl.engine, "take_xout_packed", None)
+        self._max_block = (min(r.cap for r in self.rings_out.values())
+                           // 2 - 64) if self.rings_out else 1 << 20
         #: markers received but not yet consumed: round -> {shard: dict}
         self._markers: dict = {}
         #: row blocks received but not yet ingested: (round, rows). A
@@ -493,13 +500,28 @@ class _ShardWorker:
             t1 = _walltime.perf_counter()
             eng.flush_due(T_NEVER + 1)
             xmin = T_NEVER
-            xout = eng.take_xout()
-            for j, rows in enumerate(xout):
-                if j == self.k or not rows:
-                    continue
-                if rows[0][0] < xmin:
-                    xmin = rows[0][0]  # (t, key)-sorted: [0] is min t
-                self._write_rows(j, rows)
+            packed = (self._take_packed(self._max_block)
+                      if self._take_packed is not None else None)
+            if packed is not None:
+                # C send-side packer: blocks are already (t, key)-sorted
+                # wire bytes chunked to fit the ring; the first numeric
+                # column of row 0 (offset 8) is the block's min t
+                for j, blocks in enumerate(packed):
+                    if j == self.k:
+                        continue
+                    for data in blocks:
+                        (bt,) = struct.unpack_from("<q", data, 8)
+                        if bt < xmin:
+                            xmin = bt
+                        self._write_packed(j, data)
+            else:
+                xout = eng.take_xout()
+                for j, rows in enumerate(xout):
+                    if j == self.k or not rows:
+                        continue
+                    if rows[0][0] < xmin:
+                        xmin = rows[0][0]  # (t, key)-sorted: [0] is min t
+                    self._write_rows(j, rows)
             # the next-event minimum is only consumed by the global
             # skip-ahead reduction, which requires EVERY shard to have
             # executed zero events — so a shard that executed anything
@@ -661,6 +683,21 @@ class _ShardWorker:
             # would only see a 3600 s barrier timeout)
             raise _PeerDied(
                 f"shard {self.k}: one cross-shard row packs to "
+                f"{len(data)} bytes, larger than the "
+                f"{self.rings_out[j].cap}-byte ring — raise "
+                f"SHADOW_TPU_RING_BYTES")
+        self._write_block(
+            j, b"R" + struct.pack("<q", self.ctl.rounds) + data)
+
+    def _write_packed(self, j: int, data: bytes) -> None:
+        """Ship one C-packed wire block (sorted + chunked at the packer)
+        tagged with the emitting round."""
+        if 9 + len(data) + 8 > self.rings_out[j].cap:
+            # the packer chunks at half the ring, so only a SINGLE row
+            # bigger than the ring lands here: fail by name (the
+            # _write_rows discipline)
+            raise _PeerDied(
+                f"shard {self.k}: one packed cross-shard block is "
                 f"{len(data)} bytes, larger than the "
                 f"{self.rings_out[j].cap}-byte ring — raise "
                 f"SHADOW_TPU_RING_BYTES")

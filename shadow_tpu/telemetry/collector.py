@@ -251,6 +251,13 @@ class TelemetryCollector:
                 "hist": {k: h.state() for k, h in self.hist.items()},
                 "flow_counts": self.flow_counts}
 
+    def export_state_json(self) -> str:
+        """Canonical JSON of export_merge_state() — the per-seed sidecar
+        fleet mode writes (shadow_tpu/fleet.py telemetry_state.json) so a
+        sweep reducer can k-way merge histogram states across seeds
+        without re-parsing flows.jsonl."""
+        return _dumps(self.export_merge_state())
+
     def close_files(self) -> None:
         for f in self._fh.values():
             f.close()

@@ -105,3 +105,15 @@ class LogHistogram:
         h.total = int(d["total"])
         h.counts = {int(k): int(v) for k, v in d["counts"].items()}
         return h
+
+    @classmethod
+    def merged(cls, states: list) -> "LogHistogram":
+        """K-way merge of many state() dicts into one histogram — the
+        cross-SEED reduction (shadow_tpu/fleet.py): pooled percentiles
+        over every seed's samples. Bucket-wise addition is commutative
+        and associative, so the merge order cannot change the result
+        (tests/test_fleet.py asserts shuffled orders byte-identical)."""
+        h = cls()
+        for st in states:
+            h.merge(cls.from_state(st))
+        return h

@@ -285,9 +285,22 @@ def test_keys_are_uids():
     assert isinstance(eng, cp.ColumnarPlane)
     eng.bind_shard(0, 2)
     ctl.run()
-    # every diverted row's key must be a well-formed uid of its src
+    # every diverted row's key must be a well-formed uid of its src.
+    # With the C engine the rows sit in the core's packed send buffers:
+    # drain them as wire blocks and parse them back with the Python
+    # unpacker — which also round-trips the C packer against the wire
+    # format the receive side (cbatch_from_packed) expects.
+    packed = eng.take_xout_packed(1 << 30)
+    if packed is not None:
+        rows_by_shard = [
+            [r for blob in blocks for r in sh.unpack_rows(blob)]
+            for blocks in packed]
+    else:
+        rows_by_shard = eng.xout
     moved = 0
-    for rows in eng.xout:
+    for rows in rows_by_shard:
+        tks = [(r[0], r[1]) for r in rows]
+        assert tks == sorted(tks)  # the packer ships (t, key)-sorted
         for r in rows:
             assert r[1] >> 32 == r[4], (r[1], r[4])  # key's src == peer
             moved += 1

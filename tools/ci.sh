@@ -125,6 +125,47 @@ diff /tmp/ci-shard-one.json /tmp/ci-shard-two.json
 diff /tmp/ci-shard-one.hashes /tmp/ci-shard-two.hashes
 echo "multi-shard smoke OK: shards=2 byte-identical to the single-process run (trees + flows + metrics + digests)"
 
+echo "== fleet smoke (3-seed gossip_churn sweep at jobs=2: per-seed identity vs standalone + CIs in sweep_summary) =="
+rm -rf /tmp/ci-fleet /tmp/ci-fleet-solo-*
+python -m shadow_tpu.fleet sweep examples/gossip_churn.yaml \
+    --seeds 3 --seed-base 120 --jobs 2 --sweep-dir /tmp/ci-fleet \
+    --set general.stop_time=25s --quiet --json > /tmp/ci-fleet.json
+for s in 120 121 122; do
+    # standalone twin of each sweep member (same stop + telemetry); the
+    # workload may legitimately exit nonzero on process_errors at this
+    # truncated stop time — the hash comparison below is the gate
+    python -m shadow_tpu examples/gossip_churn.yaml --quiet --seed "$s" \
+        --data-directory "/tmp/ci-fleet-solo-$s" \
+        --set general.stop_time=25s --sample-every 10s || true
+done
+python - <<'EOF'
+import json
+from shadow_tpu import fleet
+
+summary = json.load(open("/tmp/ci-fleet.json"))
+assert summary["completed"] == [120, 121, 122], summary["failed"]
+for s in (120, 121, 122):
+    d = fleet.seed_dir("/tmp/ci-fleet", s)
+    man = json.loads((d / fleet.SEED_MANIFEST).read_text())
+    solo = f"/tmp/ci-fleet-solo-{s}"
+    assert fleet.output_tree_digest(d) == fleet.output_tree_digest(solo), \
+        f"seed {s}: in-fleet tree != standalone tree"
+    assert fleet._stream_digests(d) == fleet._stream_digests(solo), \
+        f"seed {s}: streams diverged"
+    assert man["tree_sha256"] == fleet.output_tree_digest(solo)
+doc = json.loads((fleet.Path("/tmp/ci-fleet") / fleet.SWEEP_SUMMARY)
+                 .read_text())
+assert doc["format"] == "shadow_tpu-sweep-summary"
+assert doc["flows"], "sweep summary has no flow groups"
+for kind, row in doc["flows"].items():
+    ci = row["ci95"]["p50_ms"]
+    assert ci["n"] == 3 and ci["lo"] <= ci["mean"] <= ci["hi"], (kind, ci)
+    assert set(row["pooled"]) >= {"p50_ms", "p99_ms"}
+print(f"fleet smoke OK: 3 seeds byte-identical to standalone, "
+      f"{len(doc['flows'])} flow group(s) with t-based CI95 in "
+      f"sweep_summary.json")
+EOF
+
 echo "== fast+robust smoke (gossip_churn: faults + checkpoints + digests with the C engine ON vs the Python plane) =="
 frrun() {
     rm -rf "/tmp/ci-fr-$1"

@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""N-seed simulation sweeps with mergeable cross-run statistics.
+
+Thin CLI over :mod:`shadow_tpu.fleet` (the "Once is Never Enough"
+workflow — PAPERS.md):
+
+    # run a 10-seed sweep, 2 concurrent members, shared device attach
+    python tools/sweep.py config.yaml --seeds 10 --jobs 2
+
+    # continue a partially-completed sweep (per-seed manifests decide)
+    python tools/sweep.py config.yaml --seeds 10 --jobs 2 \
+        --sweep-dir my.sweep --resume
+
+    # re-reduce + render an existing sweep directory
+    python tools/sweep.py --report my.sweep
+
+Equivalent to ``python -m shadow_tpu.fleet sweep ...`` / ``... report``;
+see README "Fleet mode" for the output layout and CI semantics.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from shadow_tpu import fleet  # noqa: E402
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--report" in argv:
+        # tools/sweep.py --report <dir>  ==  fleet report <dir>
+        argv.remove("--report")
+        return fleet.main(["report"] + argv)
+    return fleet.main(["sweep"] + argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
