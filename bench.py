@@ -1300,6 +1300,54 @@ def tor_100k(stop_s: int = 15) -> dict:
     return out
 
 
+def web_cdn_row(reps: int = 3) -> dict:
+    """The modern-web family enters the perf trajectory (PR 9): the
+    committed examples/web_cdn.yaml (clients -> edge caches -> origin
+    over a DNS chain, with a partition + lossy degrade window driving
+    SACK recovery) measured with the same interleaved median-of-N
+    discipline as the headline rows — (tpu, tpc) pairs so both sides
+    share each noise window — plus the standard ablation legs for
+    device_engaged. Result fields are asserted identical across every
+    leg (the row doubles as a cross-policy identity gate under faults),
+    and the flow-latency roll-up (web.fetch/web.origin/dns.resolve
+    percentiles) rides along so regressions in the workload itself — not
+    just the simulator — show up in BENCH_DETAIL."""
+    path = "examples/web_cdn.yaml"
+    tpus, tpcs = [], []
+    for i in range(reps):
+        tpus.append(run_config(path, "tpu_batch", f"webcdn-tpu{i}"))
+        tpcs.append(run_config(path, "thread_per_core", f"webcdn-tpc{i}"))
+    tpu, tpc = _median_run(tpus), _median_run(tpcs)
+    for k in ("events", "units_sent", "units_dropped", "bytes_sent"):
+        assert tpu[k] == tpc[k], ("web_cdn", k)
+    flows = {
+        kind: {k: v[k] for k in ("count", "ok", "failed", "p50_ms",
+                                 "p99_ms") if k in v}
+        for kind, v in tpu.get("telemetry", {}).get("flows", {}).items()}
+    d = {
+        "thread_per_core": tpc,
+        "tpu_batch": tpu,
+        "ratio_tpu_vs_tpc": round(
+            tpu["sim_sec_per_wall_sec"] / tpc["sim_sec_per_wall_sec"], 2),
+        "raw_rates": {"tpu_batch": _run_rates(tpus),
+                      "thread_per_core": _run_rates(tpcs)},
+        "spread_rel": _spread_rel({"tpu_batch": tpus,
+                                   "thread_per_core": tpcs}),
+        "flows": flows,
+        "stream_recovery": {
+            k: tpu.get("counters", {}).get(k, 0)
+            for k in ("stream_fast_retransmits", "stream_sack_retransmits",
+                      "stream_rto_retransmits", "stream_timeouts")},
+        "aggregation": f"median-of-{reps}, interleaved (tpu, tpc) pairs",
+    }
+    d.update(ablation(path, "web_cdn", tpc, tpu))
+    log(f"web_cdn: tpu {d['raw_rates']['tpu_batch']} vs tpc "
+        f"{d['raw_rates']['thread_per_core']} sim-s/wall-s "
+        f"(ratio {d['ratio_tpu_vs_tpc']}x, "
+        f"device_engaged={d['device_engaged']})")
+    return d
+
+
 def mesh_scaling(config: str = "examples/tgen_100host.yaml",
                  force_collective: bool = False) -> dict:
     """tpu_mesh scaling table (VERDICT r2 item #2): the whole-round
@@ -1629,6 +1677,7 @@ def main() -> None:
             d.update(ablation(path, tag, d["thread_per_core"],
                               d["tpu_batch"]))
             detail[tag] = d
+        detail["web_cdn"] = web_cdn_row()
         detail["managed_50"] = managed_bench()
         detail["managed_dense"] = managed_dense_bench()
         detail["managed_dense_contended"] = managed_dense_contended()
@@ -1648,7 +1697,8 @@ def main() -> None:
                 for k in ("units_sent", "events"):
                     assert a[k] == b[k], ("mesh_floor divergence", sh, k)
         detail["draw_plane"] = draw_plane_throughput()
-        for tag in ("tgen_1k", "tgen_100", "tor_400", "gossip_10k"):
+        for tag in ("tgen_1k", "tgen_100", "tor_400", "gossip_10k",
+                    "web_cdn"):
             for pol in detail[tag]:
                 if isinstance(detail[tag][pol], dict):
                     detail[tag][pol].pop("counters", None)

@@ -225,7 +225,8 @@ static PyObject *S_id, *S_now, *S_inbox, *S_egress_rows, *S_uid_counter,
     *S_device_floor, *S_rows, *S_pos, *S_dispatch_row, *S_run_events,
     *S_popleft, *S_append, *S_ingress_deferred_rows, *S_pcap,
     *S_n_emitted, *S_n_delivered, *S_n_dgrams, *S_n_dgrams_recv,
-    *S_n_events, *S_dispatch, *S_n_teardown, *S_n_blackholed, *S_down;
+    *S_n_events, *S_dispatch, *S_n_teardown, *S_n_blackholed, *S_down,
+    *S_cc_id;
 
 /* cached small objects */
 static PyObject *O_zero, *O_one, *O_false, *O_kind_dgram;
@@ -337,7 +338,11 @@ typedef struct {
    * Python twin maintains: _n_teardown/_n_blackholed and the
    * faults_active-gated stream recovery counters) */
   int64_t d_teardown, d_blackholed;
-  int64_t d_fast_retx, d_rto_retx, d_timeouts;
+  int64_t d_fast_retx, d_rto_retx, d_timeouts, d_sack_retx;
+  /* per-host congestion control (Host.cc_id, read at bind): dispatch
+   * integer for the CongestionControl twin — endpoints the C SYN accept
+   * creates must pick the same algorithm the Python accept would */
+  int cc_kind;
 } CHost;
 
 typedef struct {
@@ -2407,6 +2412,12 @@ static int Core_init(CoreObject *c, PyObject *args, PyObject *kwds) {
     h->listeners = PyObject_GetAttrString(host, "_listeners");
     h->ack_eps = PyObject_GetAttrString(host, "_ack_eps");
     if (!h->conns || !h->listeners || !h->ack_eps) return -1;
+    {
+      int64_t cc;
+      if (attr_i64(host, S_cc_id, &cc) < 0)
+        return -1;
+      h->cc_kind = (int)cc;
+    }
     if (!PyDict_Check(h->ack_eps)) {
       PyErr_SetString(PyExc_TypeError, "host._ack_eps must be a dict");
       return -1;
@@ -2526,20 +2537,21 @@ static PyObject *Core_fold_counters(CoreObject *c, PyObject *noarg) {
     h->d_events = 0;
     h->d_teardown = h->d_blackholed = 0;
     /* stream/routing counters go through host.counters.add (key space
-     * shared with the Python transport; the last three are the
+     * shared with the Python transport; the last four are the
      * faults_active-gated recovery counters — the deltas are only ever
      * incremented with faults on, so the fold stays unconditional) */
-    static const char *names2[7] = {"stream_bytes_queued",
+    static const char *names2[8] = {"stream_bytes_queued",
                                     "stream_bytes_received",
                                     "stream_resets", "units_unroutable",
                                     "stream_fast_retransmits",
                                     "stream_rto_retransmits",
-                                    "stream_timeouts"};
-    int64_t *vals[7] = {&h->d_sbytes_q, &h->d_sbytes_recv, &h->d_resets,
+                                    "stream_timeouts",
+                                    "stream_sack_retransmits"};
+    int64_t *vals[8] = {&h->d_sbytes_q, &h->d_sbytes_recv, &h->d_resets,
                         &h->d_unroutable, &h->d_fast_retx,
-                        &h->d_rto_retx, &h->d_timeouts};
+                        &h->d_rto_retx, &h->d_timeouts, &h->d_sack_retx};
     PyObject *ctrs = NULL;
-    for (int j = 0; j < 7; j++) {
+    for (int j = 0; j < 8; j++) {
       if (!*vals[j]) continue;
       if (!ctrs) {
         ctrs = PyObject_GetAttrString(h->host, "counters");
@@ -2893,6 +2905,10 @@ static PyTypeObject Core_Type = {
 #define SYN_RETRIES_C 5
 #define FIN_RETRIES_C 5
 #define DATA_RETRIES_C 8
+#define SACK_MAX_BLOCKS_C 4
+/* congestion-control ids (transport.py CongestionControl.cc_id twins) */
+#define CC_NEWRENO 0
+#define CC_CUBIC 1
 /* endpoint states (transport.py order) */
 #define ST_CLOSED 0
 #define ST_SYN_SENT 1
@@ -2936,6 +2952,82 @@ static inline void ring_popleft(Ring *r) {
   r->count--;
 }
 
+/* int64 seq-set over a Ring (the StreamSender sacked/rtx_done set
+ * twins): membership is a linear scan — the sets hold at most a few
+ * dozen in-flight segment seqs during a loss episode and are empty on
+ * clean connections */
+static int i64set_has(Ring *r, int64_t v) {
+  for (int i = 0; i < r->count; i++)
+    if (*(int64_t *)ring_at(r, i) == v) return 1;
+  return 0;
+}
+
+static int i64set_add(Ring *r, int64_t v) {
+  if (i64set_has(r, v)) return 0;
+  int64_t *p = ring_push(r);
+  if (!p) return -1;
+  *p = v;
+  return 0;
+}
+
+/* drop every member < cum (the cumulative-ack prune of the Python
+ * set comprehension) — rebuilds in place, order irrelevant */
+static void i64set_prune_below(Ring *r, int64_t cum) {
+  int w = 0;
+  for (int i = 0; i < r->count; i++) {
+    int64_t v = *(int64_t *)ring_at(r, i);
+    if (v >= cum) {
+      *(int64_t *)ring_at(r, w) = v;
+      w++;
+    }
+  }
+  r->count = w;
+}
+
+/* tuple(sorted(set)) twin for fingerprint/export (cmp_i64 above) */
+static PyObject *i64set_sorted_tuple(Ring *r) {
+  int n = r->count;
+  int64_t *tmp = n ? malloc((size_t)n * sizeof(int64_t)) : NULL;
+  if (n && !tmp) return PyErr_NoMemory();
+  for (int i = 0; i < n; i++) tmp[i] = *(int64_t *)ring_at(r, i);
+  if (n) qsort(tmp, (size_t)n, sizeof(int64_t), cmp_i64);
+  PyObject *t = PyTuple_New(n);
+  if (!t) { free(tmp); return NULL; }
+  for (int i = 0; i < n; i++) {
+    PyObject *v = PyLong_FromLongLong(tmp[i]);
+    if (!v) { free(tmp); Py_DECREF(t); return NULL; }
+    PyTuple_SET_ITEM(t, i, v);
+  }
+  free(tmp);
+  return t;
+}
+
+/* restore from an exported tuple of ints */
+static int i64set_restore(Ring *r, PyObject *tup) {
+  if (!PyTuple_Check(tup)) {
+    PyErr_SetString(PyExc_TypeError, "seq-set restore: want a tuple");
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < PyTuple_GET_SIZE(tup); i++) {
+    int64_t v = PyLong_AsLongLong(PyTuple_GET_ITEM(tup, i));
+    if (v == -1 && PyErr_Occurred()) return -1;
+    if (i64set_add(r, v) < 0) return -1;
+  }
+  return 0;
+}
+
+/* floor integer cube root (transport.py _icbrt twin: same binary
+ * search, operands < 2**60 so int64 is exact) */
+static int64_t icbrt64(int64_t x) {
+  int64_t lo = 0, hi = 1LL << 20;
+  while (lo < hi) {
+    int64_t mid = (lo + hi + 1) >> 1;
+    if (mid * mid * mid <= x) lo = mid;
+    else hi = mid - 1;
+  }
+  return lo;
+}
+
 typedef struct CEp {
   PyObject_HEAD
   CoreObject *core; /* owned */
@@ -2960,6 +3052,15 @@ typedef struct CEp {
   /* receiver */
   int64_t recv_buffer, rcv_nxt, ooo_bytes, bytes_received, last_wnd;
   int dup_acks; /* consecutive duplicate acks (RFC 5681 counting) */
+  /* SACK scoreboard + congestion-control seam (StreamSender twins):
+   * sacked/rtx_done are int64 seq sets (tiny; linear membership),
+   * sack_high the highest SACKed byte since the last RTO, recover the
+   * recovery point, w_max/epoch_start the cubic epoch state */
+  int cc_kind;
+  int in_recovery;
+  int64_t recover, sack_high, w_max, epoch_start;
+  Ring sacked;   /* int64_t */
+  Ring rtx_done; /* int64_t */
   Ring ooo; /* RtxEnt, kept seq-sorted (insertion) */
   PyObject *app_unread; /* callable or NULL */
   /* app callbacks (None when unset) */
@@ -3192,17 +3293,124 @@ static int cs_pump(CEp *e, int64_t now) {
   return 0;
 }
 
-/* the fast-retransmit response (3rd consecutive duplicate ack):
- * multiplicative decrease + retransmit + RTO reset
- * (StreamSender._loss_response twin) */
-static int cs_loss_response(CEp *e, int64_t now, int64_t seq,
-                            int64_t nbytes, PyObject *payload) {
-  e->loss_events++;
-  if (e->core->faults_active) cep_h(e)->d_fast_retx++;
+/* ---- congestion control (transport.py CongestionControl twins) --------- */
+static void cc_on_ack(CEp *e, int64_t newly, int64_t now) {
+  if (e->cwnd < e->ssthresh) {
+    e->cwnd += newly < e->cwnd ? newly : e->cwnd; /* slow start (shared) */
+    return;
+  }
+  if (e->cc_kind == CC_CUBIC) {
+    if (e->epoch_start == 0) { /* first CA ack with no recorded epoch */
+      e->epoch_start = now;
+      e->w_max = e->cwnd;
+    }
+    int64_t t_ms = (now - e->epoch_start) / 1000000LL;
+    int64_t wmax_c = e->w_max < (1LL << 32) ? e->w_max : (1LL << 32);
+    int64_t k_ms = icbrt64((wmax_c * 3 / (4 * MSS_C)) * 1000000000LL);
+    int64_t d = t_ms - k_ms;
+    if (d > 200000) d = 200000;
+    else if (d < -200000) d = -200000;
+    int64_t a = d < 0 ? -d : d;
+    int64_t delta = (a * a * a / 1000000LL) * (4 * MSS_C) / 10000LL;
+    int64_t target = d < 0 ? e->w_max - delta : e->w_max + delta;
+    if (target < MIN_CWND_C) target = MIN_CWND_C;
+    else if (target > (1LL << 45)) target = 1LL << 45;
+    int64_t nn = newly < (1LL << 20) ? newly : (1LL << 20);
+    if (e->cwnd < target) {
+      int64_t dd = target - e->cwnd;
+      if (dd > (1LL << 40)) dd = 1LL << 40;
+      int64_t inc = dd * nn / e->cwnd;
+      int64_t nw = e->cwnd + (inc > 1 ? inc : 1);
+      e->cwnd = nw < target ? nw : target;
+    } else {
+      int64_t inc = MSS_C * nn / (100 * e->cwnd);
+      e->cwnd += inc > 1 ? inc : 1;
+    }
+    return;
+  }
+  int64_t add = MSS_C * newly / e->cwnd;
+  e->cwnd += add > 1 ? add : 1; /* newreno AIMD */
+}
+
+static void cc_on_loss(CEp *e, int64_t now) {
+  if (e->cc_kind == CC_CUBIC) {
+    e->w_max = e->cwnd;
+    e->epoch_start = now;
+    int64_t nc = e->cwnd * 7 / 10;
+    e->ssthresh = e->cwnd = nc > MIN_CWND_C ? nc : MIN_CWND_C;
+    return;
+  }
   int64_t inflight = e->snd_nxt - e->snd_una;
   e->ssthresh = inflight / 2 > MIN_CWND_C ? inflight / 2 : MIN_CWND_C;
   e->cwnd = e->cwnd / 2 > MIN_CWND_C ? e->cwnd / 2 : MIN_CWND_C;
-  if (cs_emit_data(e, now, seq, nbytes, payload) < 0) return -1;
+}
+
+static void cc_on_rto(CEp *e, int64_t now) {
+  if (e->cc_kind == CC_CUBIC) {
+    e->w_max = e->cwnd;
+    e->epoch_start = now;
+  }
+  int64_t inflight = e->snd_nxt - e->snd_una;
+  e->ssthresh = inflight / 2 > MIN_CWND_C ? inflight / 2 : MIN_CWND_C;
+  e->cwnd = MIN_CWND_C;
+}
+
+/* ---- SACK scoreboard (StreamSender twins) ------------------------------ */
+/* fold an ack's SACK blocks (big-endian u64 pairs in the payload) into
+ * the scoreboard (StreamSender._apply_sack twin) */
+static int cs_apply_sack(CEp *e, PyObject *payload) {
+  if (!payload || !PyBytes_Check(payload)) return 0;
+  const unsigned char *p = (const unsigned char *)PyBytes_AS_STRING(payload);
+  Py_ssize_t len = PyBytes_GET_SIZE(payload);
+  for (Py_ssize_t off = 0; off + 16 <= len; off += 16) {
+    int64_t a = 0, b = 0;
+    for (int i = 0; i < 8; i++) a = (a << 8) | p[off + i];
+    for (int i = 0; i < 8; i++) b = (b << 8) | p[off + 8 + i];
+    if (b > e->sack_high) e->sack_high = b;
+    for (int i = 0; i < e->rtx.count; i++) {
+      RtxEnt *re = ring_at(&e->rtx, i);
+      if (re->seq >= b) break; /* rtx is seq-ascending */
+      if (re->seq >= a && re->seq + re->n <= b) {
+        if (i64set_add(&e->sacked, re->seq) < 0) return -1;
+      }
+    }
+  }
+  return 0;
+}
+
+/* retransmit every un-SACKed, not-yet-retransmitted hole below the
+ * highest SACKed byte (StreamSender._retransmit_holes twin); returns
+ * the emission count or -1 */
+static int cs_retransmit_holes(CEp *e, int64_t now, int force_head) {
+  int64_t hi = e->sack_high;
+  int emitted = 0;
+  for (int i = 0; i < e->rtx.count; i++) {
+    RtxEnt *re = ring_at(&e->rtx, i);
+    if (re->seq >= hi && !(force_head && i == 0)) break;
+    if (i64set_has(&e->sacked, re->seq) ||
+        i64set_has(&e->rtx_done, re->seq))
+      continue;
+    if (i64set_add(&e->rtx_done, re->seq) < 0) return -1;
+    if (cs_emit_data(e, now, re->seq, re->n, re->payload) < 0) return -1;
+    emitted++;
+  }
+  return emitted;
+}
+
+/* the fast-retransmit response (3rd consecutive duplicate ack):
+ * multiplicative decrease + retransmit of every known hole + RTO reset
+ * (StreamSender._enter_recovery twin) */
+static int cs_enter_recovery(CEp *e, int64_t now) {
+  e->loss_events++;
+  if (e->core->faults_active) cep_h(e)->d_fast_retx++;
+  e->in_recovery = 1;
+  e->recover = e->snd_nxt;
+  e->rtx_done.count = 0;
+  cc_on_loss(e, now);
+  int emitted = cs_retransmit_holes(e, now, 1);
+  if (emitted < 0) return -1;
+  if (emitted > 1 && e->core->faults_active)
+    cep_h(e)->d_sack_retx += emitted - 1;
   return cs_arm_rto(e, 1);
 }
 
@@ -3217,18 +3425,23 @@ static int cs_on_rto(CEp *e, int64_t now) {
     return ce_reset(e, "connection timed out (ETIMEDOUT): data retransmission retries exhausted");
   }
   if (e->core->faults_active) cep_h(e)->d_rto_retx++;
-  int64_t inflight = e->snd_nxt - e->snd_una;
-  e->ssthresh = inflight / 2 > MIN_CWND_C ? inflight / 2 : MIN_CWND_C;
-  e->cwnd = MIN_CWND_C;
+  /* scoreboard discarded (renege safety, StreamSender._on_rto twin) */
+  e->sacked.count = 0;
+  e->rtx_done.count = 0;
+  e->sack_high = 0;
+  e->in_recovery = 0;
+  cc_on_rto(e, now);
   e->rto_backoff = e->rto_backoff * 2 > 64 ? 64 : e->rto_backoff * 2;
   RtxEnt *re = ring_at(&e->rtx, 0);
   if (cs_emit_data(e, now, re->seq, re->n, re->payload) < 0) return -1;
   return cs_arm_rto(e, 0);
 }
 
-static int cs_on_ack(CEp *e, int64_t now, int64_t cum_ack, int64_t wnd) {
+static int cs_on_ack(CEp *e, int64_t now, int64_t cum_ack, int64_t wnd,
+                     PyObject *sack) {
   int64_t prev_wnd = e->adv_wnd;
   e->adv_wnd = wnd;
+  if (sack && cs_apply_sack(e, sack) < 0) return -1;
   if (cum_ack > e->snd_una) {
     e->dup_acks = 0;
     int64_t newly = cum_ack - e->snd_una;
@@ -3240,18 +3453,26 @@ static int cs_on_ack(CEp *e, int64_t now, int64_t cum_ack, int64_t wnd) {
       Py_XDECREF(re->payload);
       ring_popleft(&e->rtx);
     }
+    if (e->sacked.count) i64set_prune_below(&e->sacked, cum_ack);
+    if (e->rtx_done.count) i64set_prune_below(&e->rtx_done, cum_ack);
     e->rto_backoff = 1;
     e->retries = 0;
     if (cep_cancel_timer(e, &e->rto_timer) < 0) return -1;
     if (e->snd_nxt - e->snd_una > 0) {
       if (cs_arm_rto(e, 0) < 0) return -1;
     }
-    if (e->cwnd < e->ssthresh) {
-      e->cwnd += newly < e->cwnd ? newly : e->cwnd; /* slow start */
-    } else {
-      int64_t add = MSS_C * newly / e->cwnd;
-      e->cwnd += add > 1 ? add : 1; /* AIMD */
+    if (e->in_recovery) {
+      if (e->snd_una >= e->recover) {
+        e->in_recovery = 0;
+        e->rtx_done.count = 0;
+      } else {
+        /* partial ack: NewReno head retransmit + newly exposed holes */
+        int n = cs_retransmit_holes(e, now, 1);
+        if (n < 0) return -1;
+        if (n && e->core->faults_active) cep_h(e)->d_sack_retx += n;
+      }
     }
+    cc_on_ack(e, newly, now);
     if (e->sink && e->buffered < e->send_buffer) {
       if (relay_drain(e->sink, now) < 0) return -1;
     } else if (e->tsink && e->buffered < e->send_buffer) {
@@ -3275,12 +3496,15 @@ static int cs_on_ack(CEp *e, int64_t now, int64_t cum_ack, int64_t wnd) {
              wnd == prev_wnd && e->snd_nxt - e->snd_una > 0 &&
              e->rtx.count) {
     /* duplicate ack (same cum, same window, data outstanding): 3rd
-     * CONSECUTIVE one triggers fast retransmit (StreamSender twin) */
+     * CONSECUTIVE one enters SACK recovery (StreamSender twin) */
     e->dup_acks++;
-    if (e->dup_acks == 3) {
-      RtxEnt *re = ring_at(&e->rtx, 0);
-      if (cs_loss_response(e, now, re->seq, re->n, re->payload) < 0)
-        return -1;
+    if (e->dup_acks == 3 && !e->in_recovery) {
+      if (cs_enter_recovery(e, now) < 0) return -1;
+    } else if (e->in_recovery && sack) {
+      /* later dup acks can expose new holes (higher sack_high) */
+      int n = cs_retransmit_holes(e, now, 0);
+      if (n < 0) return -1;
+      if (n && e->core->faults_active) cep_h(e)->d_sack_retx += n;
     }
   } else {
     e->dup_acks = 0; /* anything else breaks the consecutive run */
@@ -3351,6 +3575,52 @@ static int tgen_srv_data(CEp *e, int64_t now, PyObject *payload) {
   return tgen_push(e, now);
 }
 
+typedef struct { int64_t seq, n; } SackSeg;
+
+static int cmp_sackseg(const void *a, const void *b) {
+  int64_t x = ((const SackSeg *)a)->seq, y = ((const SackSeg *)b)->seq;
+  return (x > y) - (x < y);
+}
+
+/* the receiver's SACK report (StreamReceiver.sack_payload twin): the
+ * buffered OOO segments merged into contiguous [start, end) ranges, the
+ * lowest SACK_MAX_BLOCKS_C of them, as big-endian u64 pairs. Returns a
+ * new bytes ref, NULL with *err=0 when nothing is buffered (no
+ * payload — every ack of a loss-free connection), NULL with *err=1 on
+ * allocation failure. Byte-identical to the Python builder. */
+static PyObject *cr_sack_payload(CEp *e, int *err) {
+  *err = 0;
+  int n = e->ooo.count;
+  if (n == 0) return NULL;
+  SackSeg stack_segs[32];
+  SackSeg *segs = n <= 32 ? stack_segs
+                          : malloc((size_t)n * sizeof(SackSeg));
+  if (!segs) { PyErr_NoMemory(); *err = 1; return NULL; }
+  for (int i = 0; i < n; i++) {
+    RtxEnt *re = ring_at(&e->ooo, i);
+    segs[i].seq = re->seq;
+    segs[i].n = re->n;
+  }
+  qsort(segs, (size_t)n, sizeof(SackSeg), cmp_sackseg);
+  unsigned char buf[SACK_MAX_BLOCKS_C * 16];
+  int nb = 0, nblocks = 0;
+  int64_t cs = segs[0].seq, ce = segs[0].seq + segs[0].n;
+  for (int i = 1; i <= n && nblocks < SACK_MAX_BLOCKS_C; i++) {
+    if (i < n && segs[i].seq == ce) {
+      ce = segs[i].seq + segs[i].n;
+      continue;
+    }
+    for (int k = 7; k >= 0; k--) buf[nb++] = (cs >> (8 * k)) & 0xff;
+    for (int k = 7; k >= 0; k--) buf[nb++] = (ce >> (8 * k)) & 0xff;
+    nblocks++;
+    if (i < n) { cs = segs[i].seq; ce = segs[i].seq + segs[i].n; }
+  }
+  if (segs != stack_segs) free(segs);
+  PyObject *r = PyBytes_FromStringAndSize((const char *)buf, nb);
+  if (!r) *err = 1;
+  return r;
+}
+
 /* out-of-order / duplicate / out-of-window data: real TCP acks
  * IMMEDIATELY (RFC 5681 §4.2 — dup acks drive the sender's
  * fast-retransmit counter). Supersedes any coalesced ack queued this
@@ -3366,7 +3636,12 @@ static int cep_dup_ack(CEp *e, int64_t now) {
   /* re-advertise last_wnd (NOT the recomputed window): buffering the
    * OOO segment shrinks window() every time, which would defeat the
    * sender's same-window dup test — see StreamReceiver._dup_ack */
-  return cep_emit(e, now, TK_ACK, 0, NULL, 0, e->rcv_nxt, e->last_wnd);
+  int err;
+  PyObject *sp = cr_sack_payload(e, &err);
+  if (err) return -1;
+  int r = cep_emit(e, now, TK_ACK, 0, sp, 0, e->rcv_nxt, e->last_wnd);
+  Py_XDECREF(sp);
+  return r;
 }
 
 /* ---- receiver (StreamReceiver twin) ------------------------------------ */
@@ -3607,7 +3882,7 @@ static int ce_handle_fields(CEp *e, int64_t now, int k, int64_t nbytes,
   }
   if (k == TK_ACK) {
     if (e->state == ST_CLOSED || e->state == ST_TIME_WAIT) return 0;
-    return cs_on_ack(e, now, nbytes, seq);
+    return cs_on_ack(e, now, nbytes, seq, payload);
   }
   if (k == TK_FIN) {
     if (e->state == ST_SYN_SENT) {
@@ -3692,6 +3967,8 @@ static void CEp_dealloc(CEp *e) {
   free(e->sendbuf.buf);
   free(e->rtx.buf);
   free(e->ooo.buf);
+  free(e->sacked.buf);
+  free(e->rtx_done.buf);
   Py_XDECREF(e->app_unread);
   Py_XDECREF(e->on_connected);
   Py_XDECREF(e->on_data);
@@ -3783,8 +4060,11 @@ static PyObject *CEp_flush_ack(CEp *e, PyObject *noarg) {
   if (err) return NULL;
   int64_t now = cep_now(e, &err);
   if (err) return NULL;
-  if (cep_emit(e, now, TK_ACK, 0, NULL, 0, e->rcv_nxt, e->last_wnd) < 0)
-    return NULL;
+  PyObject *sp = cr_sack_payload(e, &err);
+  if (err) return NULL;
+  int r = cep_emit(e, now, TK_ACK, 0, sp, 0, e->rcv_nxt, e->last_wnd);
+  Py_XDECREF(sp);
+  if (r < 0) return NULL;
   Py_RETURN_NONE;
 }
 
@@ -3910,12 +4190,16 @@ static PyObject *CEp_cancel_rto_m(CEp *e, PyObject *noarg) {
 
 static PyObject *CEp_fingerprint(CEp *e, PyObject *noarg) {
   /* StreamEndpoint.fingerprint twin for the determinism sentinel: the
-   * SAME 20 fields in the same order with the same Python types (bools
+   * SAME 28 fields in the same order with the same Python types (bools
    * stay bools — checkpoint._feed encodes them differently from ints),
    * so digest streams are identical with the C engine on and off */
   (void)noarg;
+  PyObject *sk = i64set_sorted_tuple(&e->sacked);
+  if (!sk) return NULL;
+  PyObject *rd = i64set_sorted_tuple(&e->rtx_done);
+  if (!rd) { Py_DECREF(sk); return NULL; }
   return Py_BuildValue(
-      "(iOiiOLLLLLLiLiiLLLLL)", e->state,
+      "(iOiiOLLLLLLiLiiLLLLLiLLiLLNN)", e->state,
       e->initiator ? Py_True : Py_False, e->syn_tries, e->fin_tries,
       e->peer_fin ? Py_True : Py_False, (long long)e->snd_nxt,
       (long long)e->snd_una, (long long)e->cwnd, (long long)e->ssthresh,
@@ -3923,7 +4207,11 @@ static PyObject *CEp_fingerprint(CEp *e, PyObject *noarg) {
       (long long)e->rto_backoff, e->dup_acks, e->loss_events,
       (long long)e->bytes_acked, (long long)e->rcv_nxt,
       (long long)e->ooo_bytes, (long long)e->bytes_received,
-      (long long)e->last_wnd);
+      (long long)e->last_wnd,
+      /* PR 9: SACK scoreboard + congestion-control seam state */
+      e->cc_kind, (long long)e->w_max, (long long)e->epoch_start,
+      e->in_recovery ? 1 : 0, (long long)e->recover,
+      (long long)e->sack_high, sk, rd);
 }
 
 /* opt-in surface for the models/tgen.py fast path; Python-plane
@@ -4140,7 +4428,7 @@ static PyTypeObject CEp_Type = {
 
 /* factory shared by Python (Host._make_endpoint) and the C SYN accept */
 static CEp *cep_new(CoreObject *c, int hid, int lport, int rhost, int rport,
-                    int initiator, int64_t sbuf, int64_t rbuf) {
+                    int initiator, int64_t sbuf, int64_t rbuf, int cc) {
   CEp *e = PyObject_GC_New(CEp, &CEp_Type);
   if (!e) return NULL;
   memset(((char *)e) + sizeof(PyObject), 0, sizeof(CEp) - sizeof(PyObject));
@@ -4157,10 +4445,13 @@ static CEp *cep_new(CoreObject *c, int hid, int lport, int rhost, int rport,
   e->adv_wnd = INIT_CWND_C;
   e->rto_backoff = 1;
   e->tgen_t_first = -1;
+  e->cc_kind = cc;
   e->send_buffer = sbuf;
   e->recv_buffer = rbuf;
   e->last_wnd = rbuf;
   e->chunk = c->unit_chunk;
+  e->sacked.esz = sizeof(int64_t);
+  e->rtx_done.esz = sizeof(int64_t);
   e->sendbuf.esz = sizeof(SQEnt);
   e->rtx.esz = sizeof(RtxEnt);
   e->ooo.esz = sizeof(RtxEnt);
@@ -4176,17 +4467,17 @@ static CEp *cep_new(CoreObject *c, int hid, int lport, int rhost, int rport,
 }
 
 static PyObject *Core_make_endpoint(CoreObject *c, PyObject *args) {
-  long long hid, lport, rhost, rport, sbuf, rbuf;
+  long long hid, lport, rhost, rport, sbuf, rbuf, cc = 0;
   int initiator;
-  if (!PyArg_ParseTuple(args, "LLLLpLL", &hid, &lport, &rhost, &rport,
-                        &initiator, &sbuf, &rbuf))
+  if (!PyArg_ParseTuple(args, "LLLLpLL|L", &hid, &lport, &rhost, &rport,
+                        &initiator, &sbuf, &rbuf, &cc))
     return NULL;
   if (hid < 0 || hid >= c->H || rhost < 0 || rhost >= c->H) {
     PyErr_SetString(PyExc_ValueError, "host id out of range");
     return NULL;
   }
   return (PyObject *)cep_new(c, (int)hid, (int)lport, (int)rhost,
-                             (int)rport, initiator, sbuf, rbuf);
+                             (int)rport, initiator, sbuf, rbuf, (int)cc);
 }
 
 /* the barrier's coalesced-ack flush loop (colplane._barrier_round twin):
@@ -4226,8 +4517,12 @@ static PyObject *Core_flush_acks(CoreObject *c, PyObject *arg) {
           if (err) { Py_DECREF(keys); return NULL; }
           have_now = 1;
         }
-        if (cep_emit(e, now, TK_ACK, 0, NULL, 0, e->rcv_nxt,
-                     e->last_wnd) < 0) {
+        PyObject *sp = cr_sack_payload(e, &err);
+        if (err) { Py_DECREF(keys); return NULL; }
+        int remit = cep_emit(e, now, TK_ACK, 0, sp, 0, e->rcv_nxt,
+                             e->last_wnd);
+        Py_XDECREF(sp);
+        if (remit < 0) {
           Py_DECREF(keys);
           return NULL;
         }
@@ -4382,7 +4677,7 @@ static int dispatch_stream(CoreObject *c, CHost *h, int hid, IRow *ir,
       return 0;
     }
     CEp *ne = cep_new(c, hid, ir->bport, ir->peer, ir->aport, 0,
-                      c->sock_sbuf, c->sock_rbuf);
+                      c->sock_sbuf, c->sock_rbuf, h->cc_kind);
     if (!ne) { Py_DECREF(key); return -1; }
     ne->state = ST_ESTABLISHED;
     ne->adv_wnd = ir->seq; /* client window rides the SYN */
@@ -5536,6 +5831,8 @@ static CEp *cep_shell(void) {
   e->sendbuf.esz = sizeof(SQEnt);
   e->rtx.esz = sizeof(RtxEnt);
   e->ooo.esz = sizeof(RtxEnt);
+  e->sacked.esz = sizeof(int64_t);
+  e->rtx_done.esz = sizeof(int64_t);
   e->tgen_t_first = -1;
   PyObject_GC_Track((PyObject *)e);
   return e;
@@ -5586,19 +5883,23 @@ static GossipState *gossip_shell(void) {
   return g;
 }
 
-/* -- CEp export/restore (47 positional fields; ABI-guarded) -------------- */
+/* -- CEp export/restore (55 positional fields; ABI-guarded) -------------- */
 static PyObject *CEp_export_state(CEp *e, PyObject *noarg) {
   (void)noarg;
   PyObject *sb = export_sq(&e->sendbuf);
   PyObject *rt = sb ? export_rtx(&e->rtx) : NULL;
   PyObject *oo = rt ? export_rtx(&e->ooo) : NULL;
-  if (!oo) {
+  PyObject *sk = oo ? i64set_sorted_tuple(&e->sacked) : NULL;
+  PyObject *rd = sk ? i64set_sorted_tuple(&e->rtx_done) : NULL;
+  if (!rd) {
     Py_XDECREF(sb);
     Py_XDECREF(rt);
+    Py_XDECREF(oo);
+    Py_XDECREF(sk);
     return NULL;
   }
   return Py_BuildValue(
-      "(iiiiOiiiOLOLLLLLLLLLLiiONNLLLLLiNOOOOOOiLLOLOLO)",
+      "(iiiiOiiiOLOLLLLLLLLLLiiONNLLLLLiNOOOOOOiLLOLOLOiLLiLLNN)",
       e->hid, e->local_port, e->remote_host, e->remote_port,
       e->initiator ? Py_True : Py_False, e->state, e->syn_tries,
       e->fin_tries, e->peer_fin ? Py_True : Py_False,
@@ -5616,20 +5917,25 @@ static PyObject *CEp_export_state(CEp *e, PyObject *noarg) {
       ornone(e->on_error), e->tgen_mode, (long long)e->tgen_pending,
       (long long)e->tgen_want, ornone(e->tgen_cb),
       (long long)e->tgen_t_first, ornone(e->xsink),
-      (long long)e->idle_timeout_ns, ornone(e->idle_timer));
+      (long long)e->idle_timeout_ns, ornone(e->idle_timer),
+      e->cc_kind, (long long)e->w_max, (long long)e->epoch_start,
+      e->in_recovery ? 1 : 0, (long long)e->recover,
+      (long long)e->sack_high, sk, rd);
 }
 
 static PyObject *CEp_restore_state(CEp *e, PyObject *state) {
   int hid, lport, rhost, rport, initiator, st, syn_tries, fin_tries,
-      peer_fin, retries, loss_events, dup_acks, tgen_mode;
+      peer_fin, retries, loss_events, dup_acks, tgen_mode, cc_kind,
+      in_recovery;
   long long rto_ns, chunk, cwnd, ssthresh, sbuf, snd_nxt, snd_una,
       adv_wnd, buffered, bytes_acked, rto_backoff, rbuf, rcv_nxt,
       ooo_bytes, bytes_received, last_wnd, tgen_pending, tgen_want,
-      tgen_t_first, idle_ns;
+      tgen_t_first, idle_ns, w_max, epoch_start, recover, sack_high;
   PyObject *ctl_t, *rto_t, *sb, *rt, *oo, *app_unread, *on_connected,
-      *on_data, *on_drain, *on_close, *on_error, *tgen_cb, *xs, *idle_t;
+      *on_data, *on_drain, *on_close, *on_error, *tgen_cb, *xs, *idle_t,
+      *sk, *rd;
   if (!PyArg_ParseTuple(
-          state, "iiiiiiiiiLOLLLLLLLLLLiiOOOLLLLLiOOOOOOOiLLOLOLO",
+          state, "iiiiiiiiiLOLLLLLLLLLLiiOOOLLLLLiOOOOOOOiLLOLOLOiLLiLLOO",
           &hid, &lport, &rhost, &rport, &initiator, &st, &syn_tries,
           &fin_tries, &peer_fin, &rto_ns, &ctl_t, &chunk, &cwnd,
           &ssthresh, &sbuf, &snd_nxt, &snd_una, &adv_wnd, &buffered,
@@ -5638,7 +5944,8 @@ static PyObject *CEp_restore_state(CEp *e, PyObject *state) {
           &last_wnd, &dup_acks, &oo, &app_unread, &on_connected,
           &on_data, &on_drain, &on_close, &on_error, &tgen_mode,
           &tgen_pending, &tgen_want, &tgen_cb, &tgen_t_first, &xs,
-          &idle_ns, &idle_t))
+          &idle_ns, &idle_t, &cc_kind, &w_max, &epoch_start,
+          &in_recovery, &recover, &sack_high, &sk, &rd))
     return NULL;
   e->hid = hid;
   e->local_port = lport;
@@ -5673,6 +5980,14 @@ static PyObject *CEp_restore_state(CEp *e, PyObject *state) {
   e->tgen_want = tgen_want;
   e->tgen_t_first = tgen_t_first;
   e->idle_timeout_ns = idle_ns;
+  e->cc_kind = cc_kind;
+  e->w_max = w_max;
+  e->epoch_start = epoch_start;
+  e->in_recovery = in_recovery;
+  e->recover = recover;
+  e->sack_high = sack_high;
+  if (i64set_restore(&e->sacked, sk) < 0) return NULL;
+  if (i64set_restore(&e->rtx_done, rd) < 0) return NULL;
 #define EP_SLOT(slot, v)                                \
   do {                                                  \
     PyObject *nv = (v) == Py_None ? NULL : (v);         \
@@ -6290,6 +6605,7 @@ PyMODINIT_FUNC PyInit__colcore(void) {
   INTERN(S_n_teardown, "_n_teardown");
   INTERN(S_n_blackholed, "_n_blackholed");
   INTERN(S_down, "down");
+  INTERN(S_cc_id, "cc_id");
   INTERN(S_dispatch, "dispatch");
   INTERN(S_schedule_in, "schedule_in");
   INTERN(S_cancel_m, "cancel");
@@ -6319,10 +6635,12 @@ PyMODINIT_FUNC PyInit__colcore(void) {
    * checkpoint carrying C-engine state records this value in its header
    * and loading refuses a mismatch by name. Bump on ANY change to the
    * _export_state/_restore_state layouts. */
-  /* ABI 2: canonical event keys are uids (placement-independent ordering
-   * for multi-process sharding) — checkpoints carrying keyed state from
-   * ABI-1 builds order ties differently and must refuse by name */
-  PyModule_AddIntConstant(m, "ABI", 2);
+  /* ABI 3 (PR 9): CEp grew the SACK scoreboard + congestion-control
+   * seam (cc_kind, w_max/epoch_start, in_recovery/recover/sack_high,
+   * sacked/rtx_done seq sets) in _export_state and the fingerprint —
+   * ABI-2 checkpoints restore the wrong field count and must refuse by
+   * name. (ABI 2 was the uid canonical-event-key change.) */
+  PyModule_AddIntConstant(m, "ABI", 3);
   Py_INCREF(&Core_Type);
   PyModule_AddObject(m, "Core", (PyObject *)&Core_Type);
   Py_INCREF(&GossipState_Type);

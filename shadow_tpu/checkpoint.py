@@ -75,9 +75,13 @@ import numpy as np
 FORMAT = "shadow_tpu-checkpoint"
 #: version 2: the header gained the ``colcore`` build/ABI fingerprint and
 #: checkpoints may carry C-engine state (exported to plain structures by
-#: the reducers below). Version-1 checkpoints are refused by the version
-#: gate — see MIGRATION.md.
-VERSION = 2
+#: the reducers below). Version 3: the pickled StreamSender layout grew
+#: the SACK scoreboard + CongestionControl fields (the Python-plane twin
+#: of the colcore ABI 2 -> 3 bump), so version-2 checkpoints — whose
+#: senders lack those attributes and would crash on the first ack after
+#: resume — are refused by the version gate like version-1 before them.
+#: See MIGRATION.md.
+VERSION = 3
 #: config keys that may legitimately differ between the checkpointing run
 #: and the resuming invocation (run-location, snapshot policy, and the
 #: data-plane implementation toggle — never simulation semantics:

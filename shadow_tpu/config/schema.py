@@ -30,6 +30,19 @@ SCHEDULER_POLICIES = ("thread_per_core", "thread_per_host", "tpu_batch",
 LOG_LEVELS = ("error", "warning", "info", "debug", "trace")
 FAULT_KINDS = ("link_down", "link_up", "link_degrade", "host_down",
                "host_up")
+#: congestion-control algorithms (network/transport.py
+#: CONGESTION_CONTROLS keys, duplicated here so config validation does
+#: not import the transport module)
+CONGESTION_CONTROL_NAMES = ("newreno", "cubic")
+#: the registered Python-twin workload models (process path
+#: ``pyapp:<module>:<Class>``): every committed example and generated
+#: benchmark draws from this roster. Paths into the
+#: ``shadow_tpu.models`` namespace are validated against it at parse
+#: time (a typo'd model name fails at config load with the roster,
+#: not at process spawn time mid-build); pyapp paths OUTSIDE the
+#: namespace still load dynamically (user workloads stay free).
+MODEL_REGISTRY = ("tgen", "gossip", "tor", "echo", "httpd", "web",
+                  "dns", "abr")
 
 
 @dataclass
@@ -53,6 +66,9 @@ class HostOptions:
     log_level: Optional[str] = None
     pcap_enabled: bool = False
     pcap_capture_size: int = 65535
+    #: per-host congestion-control override (None = the
+    #: experimental.congestion_control default)
+    congestion_control: Optional[str] = None
     processes: list[ProcessOptions] = field(default_factory=list)
 
 
@@ -126,12 +142,22 @@ class ExperimentalOptions:
     #: C engine for the columnar plane (native/colcore). Bit-identical to
     #: the Python paths; off forces the pure-Python twin (test oracle).
     native_colcore: bool = True
-    #: stream loss recovery: "dupack" — RFC 5681-shaped 3-duplicate-ack
-    #: fast retransmit, the only model (the round 2-4 engine-notification
-    #: oracle was retired per COMPONENTS.md #13; any other value is a
-    #: config error). The knob survives so configs stay explicit about
-    #: which recovery model produced their results.
-    stream_loss_recovery: str = "dupack"
+    #: stream loss recovery: "sack" — RFC 2018-shaped block recovery over
+    #: the 3-duplicate-ack trigger (receiver reports its buffered ranges
+    #: on every out-of-order ack; the sender retransmits ALL holes per
+    #: RTT), the only model since PR 9. The pre-PR-9 "dupack"
+    #: one-retransmit-per-RTT model and the round 2-4 engine-notification
+    #: oracle are both retired; selecting either is a config error that
+    #: names the removal. The knob survives so configs stay explicit
+    #: about which recovery model produced their results.
+    stream_loss_recovery: str = "sack"
+    #: congestion control for stream endpoints: "newreno" (RFC 5681
+    #: slow start + AIMD, the extracted default) or "cubic" (integer
+    #: CUBIC-shaped variant). Overridable per host via
+    #: hosts.<name>.congestion_control — both run bit-identically on the
+    #: Python and C endpoint twins (network/transport.py
+    #: CongestionControl).
+    congestion_control: str = "newreno"
     #: guest watchdog (native/managed.py): wall-clock seconds a managed
     #: process may hold its turn without making a syscall before it is
     #: killed and converted to a host_down fault (0 = off). Catches the
@@ -234,6 +260,14 @@ def _parse_process(p: dict) -> ProcessOptions:
         opts.shutdown_time is None or opts.shutdown_time > opts.start_time,
         f"process shutdown_time must be after start_time: {p!r}",
     )
+    if opts.path.startswith("pyapp:shadow_tpu.models."):
+        parts = opts.path.split(":")
+        _require(len(parts) == 3,
+                 f"bad pyapp path {opts.path!r} (want pyapp:module:Class)")
+        mod = parts[1]
+        _require(mod.removeprefix("shadow_tpu.models.") in MODEL_REGISTRY,
+                 f"unknown workload model {mod!r} "
+                 f"(registered: {sorted(MODEL_REGISTRY)})")
     return opts
 
 
@@ -253,6 +287,12 @@ def _parse_host(name: str, h: dict) -> HostOptions:
         _require(opts.log_level in LOG_LEVELS, f"bad log_level {opts.log_level!r}")
     opts.pcap_enabled = bool(h.get("pcap_enabled", False))
     opts.pcap_capture_size = parse_size(h.get("pcap_capture_size", 65535))
+    if h.get("congestion_control") is not None:
+        opts.congestion_control = str(h["congestion_control"])
+        _require(opts.congestion_control in CONGESTION_CONTROL_NAMES,
+                 f"host {name!r} congestion_control must be one of "
+                 f"{CONGESTION_CONTROL_NAMES}, got "
+                 f"{opts.congestion_control!r}")
     procs = h.get("processes", [])
     _require(isinstance(procs, list), f"host {name!r} processes must be a list")
     opts.processes = [_parse_process(p) for p in procs]
@@ -453,12 +493,17 @@ def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
     e.tpu_mesh_shards = int(exp.get("tpu_mesh_shards", 0))
     e.tpu_mesh_floor = int(exp.get("tpu_mesh_floor", 2048))
     e.native_colcore = bool(exp.get("native_colcore", True))
-    e.stream_loss_recovery = str(exp.get("stream_loss_recovery", "dupack"))
-    _require(e.stream_loss_recovery == "dupack",
-             "experimental.stream_loss_recovery must be dupack (the "
-             "deprecated engine-notification oracle model was removed per "
-             "its COMPONENTS.md #13 retirement criterion), "
+    e.stream_loss_recovery = str(exp.get("stream_loss_recovery", "sack"))
+    _require(e.stream_loss_recovery == "sack",
+             "experimental.stream_loss_recovery must be sack (PR 9 "
+             "replaced the one-retransmit-per-RTT dupack model with "
+             "SACK-style block recovery; the engine-notification oracle "
+             "was removed earlier per COMPONENTS.md #13), "
              f"got {e.stream_loss_recovery!r}")
+    e.congestion_control = str(exp.get("congestion_control", "newreno"))
+    _require(e.congestion_control in CONGESTION_CONTROL_NAMES,
+             f"experimental.congestion_control must be one of "
+             f"{CONGESTION_CONTROL_NAMES}, got {e.congestion_control!r}")
     e.guest_turn_timeout = float(exp.get("guest_turn_timeout", 0.0))
     _require(e.guest_turn_timeout >= 0,
              "experimental.guest_turn_timeout must be >= 0")

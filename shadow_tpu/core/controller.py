@@ -132,7 +132,8 @@ class Controller:
                 up = up or DEFAULT_BANDWIDTH
                 down = down or DEFAULT_BANDWIDTH
             ip = hopts.ip_addr or _default_ip(hid)
-            host = Host(hid, hopts.name, ip, node, cfg.general.seed, self)
+            host = Host(hid, hopts.name, ip, node, cfg.general.seed, self,
+                        cc=hopts.congestion_control)
             host.log_level = hopts.log_level or cfg.general.log_level
             if hopts.pcap_enabled:
                 from shadow_tpu.utils.pcap import PcapWriter

@@ -918,12 +918,14 @@ def reduce_sweep(sweep_dir, extra: dict = None) -> dict:
              if kind in st["hist"]])
         per_seed = {lab: [] for lab in labels}
         seeds_with = []
-        ok = failed_n = 0
+        ok = failed_n = x_sum = x_n = 0
         for s, st in states:
             c = st["flow_counts"].get(kind)
             if c is not None:
                 ok += c["ok"]
                 failed_n += c["failed"]
+                x_sum += c.get("x_sum", 0)
+                x_n += c.get("x_n", 0)
             hs = st["hist"].get(kind)
             if hs is None:
                 continue
@@ -940,6 +942,8 @@ def reduce_sweep(sweep_dir, extra: dict = None) -> dict:
             "per_seed": per_seed,
             "ci95": {lab: t_ci95(per_seed[lab]) for lab in labels},
         }
+        if x_n:
+            flows[kind]["x_mean"] = x_sum // x_n
     doc = {
         "format": SUMMARY_FORMAT,
         "n_seeds": len(manifests),

@@ -17,7 +17,8 @@ from shadow_tpu.core.events import BAND_APP, EventQueue
 from shadow_tpu.core.rng import host_rng
 from shadow_tpu.core.time import SimTime
 from shadow_tpu.network import unit as U
-from shadow_tpu.network.transport import DatagramSocket, StreamEndpoint, ESTABLISHED
+from shadow_tpu.network.transport import (
+    CONGESTION_CONTROLS, DatagramSocket, StreamEndpoint, ESTABLISHED)
 from shadow_tpu.network.unit import Unit
 from shadow_tpu.utils.counters import Counters
 
@@ -27,7 +28,7 @@ LOG_LEVELS = ("error", "warning", "info", "debug", "trace")
 
 class Host:
     def __init__(self, host_id: int, name: str, ip: str, node_id: int,
-                 seed: int, controller) -> None:
+                 seed: int, controller, cc: Optional[str] = None) -> None:
         self.id = host_id
         self.name = name
         self.ip = ip
@@ -40,6 +41,12 @@ class Host:
         # per unit on this host's connections
         self.unit_chunk = (
             controller.cfg.experimental.unit_mtus * MTU - HEADER)
+        #: congestion control for this host's stream endpoints
+        #: (experimental.congestion_control, overridable per host via
+        #: hosts.<name>.congestion_control); cc_id is the C twin's
+        #: dispatch integer, read at core bind (colcore.c init_core)
+        self.cc_name = cc or controller.cfg.experimental.congestion_control
+        self.cc_id = CONGESTION_CONTROLS[self.cc_name].cc_id
         self.rng = host_rng(seed, host_id)
         self.equeue = EventQueue()
         self.counters = Counters()
@@ -275,11 +282,14 @@ class Host:
 
     def record_flow(self, kind: str, peer, t_open: SimTime,
                     ttfb: Optional[SimTime], nbytes: int, status: str,
-                    retx: int = 0) -> None:
+                    retx: int = 0, x: Optional[int] = None) -> None:
         """One application-flow lifecycle record (telemetry/collector.py),
         called at flow close from model code. ``ttfb`` is absolute sim
         time of the first payload byte (None if none arrived); close time
-        is the host clock now. No-op when telemetry is off."""
+        is the host clock now. ``x`` is an optional model-defined integer
+        riding the record (the ABR model stores the segment's selected
+        bitrate there; the summary and metrics_report reduce it to a
+        mean). No-op when telemetry is off."""
         tel = self.telemetry
         if tel is None:
             return
@@ -288,7 +298,7 @@ class Host:
             tel.note_flow_host(self)
         buf.append((kind, peer, t_open, self._now,
                     (ttfb - t_open if ttfb is not None else None),
-                    nbytes, status, retx))
+                    nbytes, status, retx, x))
 
     def mark_ack(self, ep) -> None:
         """Queue a coalesced barrier ack for this endpoint (transport's
@@ -538,11 +548,13 @@ class Host:
             # pcap hosts, whose dispatch stays on the Python path)
             return core.make_endpoint(
                 self.id, local_port, remote_host, remote_port,
-                initiator, exp.socket_send_buffer, exp.socket_recv_buffer)
+                initiator, exp.socket_send_buffer, exp.socket_recv_buffer,
+                self.cc_id)
         return StreamEndpoint(
             self, local_port, remote_host, remote_port, initiator=initiator,
             send_buffer=exp.socket_send_buffer,
             recv_buffer=exp.socket_recv_buffer,
+            cc=self.cc_name,
         )
 
     def connect(self, remote_host: int, remote_port: int) -> StreamEndpoint:

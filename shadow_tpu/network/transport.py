@@ -13,14 +13,23 @@ per its COMPONENTS.md #13 retirement criterion:
   duplicates, and acks cumulatively with its advertised window. A lost ACK
   is repaired by any later ACK — no cross-host bookkeeping (round 1's
   ``_peer_sender`` reach-across is gone).
-- **Retransmission machinery.** Two layers, like TCP's fast-retransmit vs
-  RTO: the receiver acks out-of-order data immediately, the sender counts
-  consecutive duplicate acks, and the 3rd triggers fast retransmit +
-  multiplicative decrease (RFC 5681-shaped — no simulator-side loss
-  information); an RTO timer (2x path RTT, exponential backoff)
-  independently guarantees progress for every loss pattern duplicate acks
-  do not cover (lost ACKs, lost retransmits). Control units use pure
-  timers: SYN and FIN retransmit
+- **Retransmission machinery with SACK.** Two layers, like TCP's
+  fast-retransmit vs RTO: the receiver acks out-of-order data immediately
+  and attaches SACK blocks (its merged received-unit ranges, up to 4,
+  RFC 2018-shaped — encoded in the ACK's payload field, wire size
+  unchanged); the sender keeps a scoreboard of SACKed segments, counts
+  consecutive duplicate acks, and the 3rd enters recovery: multiplicative
+  decrease + retransmission of EVERY un-SACKed hole below the highest
+  SACKed byte in one burst — a multi-unit loss burst repairs in one RTT
+  instead of the pre-PR-9 one-retransmit-per-RTT crawl. While in recovery,
+  each partial ack or newly arrived SACK block retransmits newly exposed
+  holes (each hole at most once per recovery episode); recovery ends when
+  the cumulative ack reaches the recovery point. An RTO timer (2x path
+  RTT, exponential backoff, RTO_MAX_NS ceiling) independently guarantees
+  progress for every pattern duplicate acks do not cover (lost ACKs, lost
+  retransmits, tail loss); an RTO discards the scoreboard (renege safety,
+  RFC 2018 §8) and falls back to go-back-N from the oldest hole. Control
+  units use pure timers: SYN and FIN retransmit
   on RTO with bounded retries; SYNACK loss is repaired by SYN retransmit +
   the server's duplicate-SYN re-ack; FINACK loss by FIN retransmit + the
   TIME_WAIT re-ack below.
@@ -37,8 +46,15 @@ per its COMPONENTS.md #13 retirement criterion:
   stranded connections (tests assert ``_conns`` empties; exhausted retries
   force-drop like TCP's orphan timeout).
 
-Congestion control is standard slow-start + AIMD (RFC 5681 shaped) in
-integer bytes. Datagram sockets fragment payloads into units and reassemble
+Congestion control is pluggable behind the ``CongestionControl`` seam
+(selected per host via ``experimental.congestion_control`` or the
+per-host ``congestion_control`` key): ``newreno`` is the extracted
+default (standard slow-start + AIMD, RFC 5681 shaped, in integer bytes —
+bit-identical to the pre-seam behavior), ``cubic`` a CUBIC-shaped
+variant (RFC 8312's time-based cubic window in pure integer arithmetic,
+beta 0.7, C = 0.4 — every operation is int64-safe and
+floor-division-free on negatives so the C twin computes the exact same
+windows). Datagram sockets fragment payloads into units and reassemble
 at the receiver; losing any fragment loses the datagram (IP semantics).
 
 Telemetry contract (shadow_tpu/telemetry/): the sampler aggregates, per
@@ -75,13 +91,150 @@ SYN_RETRIES = 5
 FIN_RETRIES = 5
 DATA_RETRIES = 8  # consecutive data RTOs before the connection resets
 
+#: SACK blocks per ack (TCP fits 3-4 in the options space; we keep 4)
+SACK_MAX_BLOCKS = 4
+
+
+def _icbrt(x: int) -> int:
+    """Floor integer cube root (binary search; operands stay < 2**60 so
+    the C twin computes the identical result in int64)."""
+    lo, hi = 0, 1 << 20
+    while lo < hi:
+        mid = (lo + hi + 1) >> 1
+        if mid * mid * mid <= x:
+            lo = mid
+        else:
+            hi = mid - 1
+    return lo
+
+
+class CongestionControl:
+    """The pluggable congestion-control seam: pure window arithmetic over
+    the sender's integer state (cwnd/ssthresh plus the cubic epoch fields
+    w_max/epoch_start, which live ON the sender so checkpoint export and
+    the determinism fingerprint stay uniform across algorithms).
+
+    Contract: every hook mutates only ``s.cwnd``/``s.ssthresh``/
+    ``s.w_max``/``s.epoch_start``, in integer arithmetic with no negative
+    floor divisions — the C endpoint twin (native/colcore ``cc_*``
+    functions, dispatched on the same ``cc_id``) must reproduce every
+    result bit-exactly in int64, so any new algorithm needs BOTH halves
+    or the cross-plane byte-identity gates fail."""
+
+    name = "?"
+    cc_id = -1
+
+    def on_ack(self, s: "StreamSender", newly: int) -> None:
+        """``newly`` bytes newly acknowledged (called for every
+        cumulative advance, including during recovery — ack-clocked
+        growth, like the pre-seam behavior)."""
+        raise NotImplementedError
+
+    def on_loss(self, s: "StreamSender") -> None:
+        """Entering fast-retransmit recovery (3rd duplicate ack)."""
+        raise NotImplementedError
+
+    def on_rto(self, s: "StreamSender") -> None:
+        """Retransmission timeout: collapse to slow start."""
+        raise NotImplementedError
+
+
+class NewReno(CongestionControl):
+    """RFC 5681-shaped slow start + AIMD — the extracted default,
+    bit-identical to the pre-seam inline arithmetic."""
+
+    name = "newreno"
+    cc_id = 0
+
+    def on_ack(self, s, newly):
+        if s.cwnd < s.ssthresh:
+            s.cwnd += min(newly, s.cwnd)  # slow start (doubles/RTT)
+        else:
+            s.cwnd += max(1, MSS * newly // s.cwnd)  # AIMD
+
+    def on_loss(self, s):
+        s.ssthresh = max(s.inflight // 2, MIN_CWND)
+        s.cwnd = max(s.cwnd // 2, MIN_CWND)
+
+    def on_rto(self, s):
+        s.ssthresh = max(s.inflight // 2, MIN_CWND)
+        s.cwnd = MIN_CWND
+
+
+class CubicLike(CongestionControl):
+    """CUBIC-shaped variant (RFC 8312 reduced to integer arithmetic):
+    beta = 0.7 multiplicative decrease, and congestion avoidance grows
+    toward the cubic function W(t) = C*(t-K)^3 + w_max with C = 0.4 and
+    t measured from the last decrease (``s.epoch_start``). All division
+    operands are clamped non-negative and below 2**63 so the C twin's
+    truncating int64 division equals Python's floor division."""
+
+    name = "cubic"
+    cc_id = 1
+
+    def on_ack(self, s, newly):
+        if s.cwnd < s.ssthresh:
+            s.cwnd += min(newly, s.cwnd)  # slow start, shared shape
+            return
+        now = s.ep.host._now
+        if s.epoch_start == 0:  # first CA ack with no recorded epoch
+            s.epoch_start = now
+            s.w_max = s.cwnd
+        t_ms = (now - s.epoch_start) // NS_PER_MS
+        # K = cbrt(w_max * beta_decrement / C) seconds, in ms; operands
+        # clamped so (…)*1e9 stays under 2**63 in the C twin
+        wmax_c = min(s.w_max, 1 << 32)
+        k_ms = _icbrt((wmax_c * 3 // (4 * MSS)) * 1_000_000_000)
+        d = t_ms - k_ms
+        if d > 200_000:
+            d = 200_000
+        elif d < -200_000:
+            d = -200_000
+        a = -d if d < 0 else d
+        # C*(t-K)^3 with C = 0.4*MSS bytes/s^3: cube in ms^3, scaled by
+        # 4*MSS/10 over 1e9 — split into two non-negative divisions
+        delta = (a * a * a // 1_000_000) * (4 * MSS) // 10_000
+        target = s.w_max - delta if d < 0 else s.w_max + delta
+        if target < MIN_CWND:
+            target = MIN_CWND
+        elif target > 1 << 45:
+            target = 1 << 45
+        nn = min(newly, 1 << 20)
+        if s.cwnd < target:
+            dd = min(target - s.cwnd, 1 << 40)
+            inc = dd * nn // s.cwnd
+            s.cwnd = min(s.cwnd + (inc if inc > 1 else 1), target)
+        else:
+            # at/above the cubic target: slow reno-friendly creep
+            inc = MSS * nn // (100 * s.cwnd)
+            s.cwnd += inc if inc > 1 else 1
+
+    def on_loss(self, s):
+        s.w_max = s.cwnd
+        s.epoch_start = s.ep.host._now
+        nc = s.cwnd * 7 // 10
+        s.ssthresh = s.cwnd = nc if nc > MIN_CWND else MIN_CWND
+
+    def on_rto(self, s):
+        s.w_max = s.cwnd
+        s.epoch_start = s.ep.host._now
+        half = s.inflight // 2
+        s.ssthresh = half if half > MIN_CWND else MIN_CWND
+        s.cwnd = MIN_CWND
+
+
+#: config name -> class (config/schema.py validates against these keys)
+CONGESTION_CONTROLS = {"newreno": NewReno, "cubic": CubicLike}
+
 
 class StreamSender:
     """The sending half of one endpoint: segmentation, windows, retransmit."""
 
-    def __init__(self, endpoint: "StreamEndpoint", send_buffer: int):
+    def __init__(self, endpoint: "StreamEndpoint", send_buffer: int,
+                 cc: Optional[CongestionControl] = None):
         self.ep = endpoint
         self.chunk = endpoint.host.unit_chunk  # fluid quantum payload size
+        self.cc = cc if cc is not None else NewReno()
         self.cwnd = INIT_CWND
         self.ssthresh = 1 << 62
         self.send_buffer = send_buffer
@@ -97,6 +250,19 @@ class StreamSender:
         self.loss_events = 0
         self.bytes_acked = 0
         self.dup_acks = 0  # consecutive duplicate acks (RFC 5681 counting)
+        #: SACK scoreboard: seqs of rtx entries the peer reported holding
+        #: (pruned as the cumulative ack passes them), the highest SACKed
+        #: byte seen since the last RTO (holes live strictly below it),
+        #: and the per-recovery-episode set of already-retransmitted seqs
+        #: — "all holes per RTT" means each hole at most once per episode
+        self.sacked: set[int] = set()
+        self.sack_high = 0
+        self.rtx_done: set[int] = set()
+        self.in_recovery = False
+        self.recover = 0  # recovery point: snd_nxt when recovery began
+        #: cubic epoch state (CongestionControl contract: on the sender)
+        self.w_max = 0
+        self.epoch_start = 0
 
     # -- app side ----------------------------------------------------------
     def queue(self, nbytes: int, payload: Optional[bytes]) -> int:
@@ -151,17 +317,63 @@ class StreamSender:
         # sender gets no simulator-side loss information
         self.ep.emit(U.DATA, nbytes=nbytes, payload=payload, seq=seq)
 
-    # -- loss recovery -----------------------------------------------------
-    def _loss_response(self, seq: int, nbytes: int, payload) -> None:
+    # -- loss recovery (SACK) ----------------------------------------------
+    def _apply_sack(self, payload: bytes) -> None:
+        """Fold an arriving ack's SACK blocks (pairs of big-endian u64
+        byte offsets) into the scoreboard: mark every rtx segment fully
+        covered by a block, and track the highest SACKed byte."""
+        sacked = self.sacked
+        for off in range(0, len(payload) - 15, 16):
+            a = int.from_bytes(payload[off:off + 8], "big")
+            b = int.from_bytes(payload[off + 8:off + 16], "big")
+            if b > self.sack_high:
+                self.sack_high = b
+            for seq, n, _p in self.rtx:
+                if seq >= b:
+                    break  # rtx is seq-ascending
+                if seq >= a and seq + n <= b:
+                    sacked.add(seq)
+
+    def _retransmit_holes(self, force_head: bool = False) -> int:
+        """Retransmit every un-SACKed, not-yet-retransmitted segment
+        below the highest SACKed byte — ALL holes in one burst, so a
+        multi-unit loss repairs in one RTT. ``force_head`` additionally
+        retransmits the oldest segment even without SACK cover (the
+        no-SACK-info entry fallback and the NewReno partial-ack rule).
+        Returns the number of segments emitted."""
+        hi = self.sack_high
+        sacked, done = self.sacked, self.rtx_done
+        emitted = 0
+        for i, (seq, n, p) in enumerate(self.rtx):
+            if seq >= hi and not (force_head and i == 0):
+                break  # rtx is seq-ascending: nothing past hi is a hole
+            if seq in sacked or seq in done:
+                continue
+            done.add(seq)
+            self._emit_data(seq, n, p)
+            emitted += 1
+        return emitted
+
+    def _enter_recovery(self) -> None:
         """The fast-retransmit response (3rd consecutive duplicate ack):
-        multiplicative decrease + retransmit + RTO reset."""
+        multiplicative decrease + retransmit of every known hole + RTO
+        reset."""
         self.loss_events += 1
-        if self.ep.host.faults_active:
-            self.ep.host.counters.add("stream_fast_retransmits", 1)
-        self.ssthresh = max(self.inflight // 2, MIN_CWND)
-        self.cwnd = max(self.cwnd // 2, MIN_CWND)
-        self._emit_data(seq, nbytes, payload)
+        host = self.ep.host
+        if host.faults_active:
+            host.counters.add("stream_fast_retransmits", 1)
+        self.in_recovery = True
+        self.recover = self.snd_nxt
+        self.rtx_done.clear()
+        self.cc.on_loss(self)
+        emitted = self._retransmit_holes(force_head=True)
+        if emitted > 1 and host.faults_active:
+            host.counters.add("stream_sack_retransmits", emitted - 1)
         self._arm_rto(reset=True)
+
+    def _exit_recovery(self) -> None:
+        self.in_recovery = False
+        self.rtx_done.clear()
 
     def _arm_rto(self, reset: bool = False) -> None:
         if reset and self.rto_timer is not None:
@@ -200,18 +412,26 @@ class StreamSender:
         if self.ep.host.faults_active:
             self.ep.host.counters.add("stream_rto_retransmits", 1)
         # classic RTO response: collapse to slow start, back off, resend the
-        # oldest unacked chunk (its ACK, cumulative, repairs everything else)
-        self.ssthresh = max(self.inflight // 2, MIN_CWND)
-        self.cwnd = MIN_CWND
+        # oldest unacked chunk (its ACK, cumulative, repairs everything
+        # else). The SACK scoreboard is discarded (RFC 2018 §8 renege
+        # safety): after a timeout the receiver's reported state is stale.
+        self.sacked.clear()
+        self.rtx_done.clear()
+        self.sack_high = 0
+        self.in_recovery = False
+        self.cc.on_rto(self)
         self.rto_backoff = min(self.rto_backoff * 2, 64)
         seq, nbytes, payload = self.rtx[0]
         self._emit_data(seq, nbytes, payload)
         self._arm_rto()
 
     # -- ack processing ----------------------------------------------------
-    def on_ack(self, cum_ack: int, wnd: int) -> None:
+    def on_ack(self, cum_ack: int, wnd: int,
+               sack: Optional[bytes] = None) -> None:
         prev_wnd = self.adv_wnd
         self.adv_wnd = wnd
+        if sack is not None:
+            self._apply_sack(sack)
         if cum_ack > self.snd_una:
             self.dup_acks = 0
             newly = cum_ack - self.snd_una
@@ -219,27 +439,44 @@ class StreamSender:
             self.bytes_acked += newly
             while self.rtx and self.rtx[0][0] + self.rtx[0][1] <= cum_ack:
                 self.rtx.popleft()
+            if self.sacked:
+                self.sacked = {s for s in self.sacked if s >= cum_ack}
+            if self.rtx_done:
+                self.rtx_done = {s for s in self.rtx_done if s >= cum_ack}
             self.rto_backoff = 1
             self.retries = 0
             self._cancel_rto()
             if self.inflight > 0:
                 self._arm_rto()
-            if self.cwnd < self.ssthresh:
-                self.cwnd += min(newly, self.cwnd)  # slow start (doubles/RTT)
-            else:
-                self.cwnd += max(1, MSS * newly // self.cwnd)  # AIMD
+            if self.in_recovery:
+                if self.snd_una >= self.recover:
+                    self._exit_recovery()
+                else:
+                    # partial ack: the oldest hole arrived but the burst
+                    # is not repaired — retransmit the NEW oldest segment
+                    # (NewReno partial-ack rule) plus any holes the
+                    # scoreboard newly exposes, each at most once
+                    n = self._retransmit_holes(force_head=True)
+                    if n and self.ep.host.faults_active:
+                        self.ep.host.counters.add(
+                            "stream_sack_retransmits", n)
+            self.cc.on_ack(self, newly)
             drained = self.ep.on_drain
             if drained is not None and self.buffered < self.send_buffer:
                 drained(self.send_buffer - self.buffered)
         elif (cum_ack == self.snd_una
               and wnd == prev_wnd and self.inflight > 0 and self.rtx):
             # duplicate ack (RFC 5681: same cum, same window, data
-            # outstanding); the 3rd CONSECUTIVE one triggers fast
-            # retransmit of the oldest unacked segment
+            # outstanding); the 3rd CONSECUTIVE one enters recovery and
+            # retransmits EVERY hole the scoreboard knows about
             self.dup_acks += 1
-            if self.dup_acks == 3:
-                seq, nbytes, payload = self.rtx[0]
-                self._loss_response(seq, nbytes, payload)
+            if self.dup_acks == 3 and not self.in_recovery:
+                self._enter_recovery()
+            elif self.in_recovery and sack is not None:
+                # later dup acks can expose new holes (higher sack_high)
+                n = self._retransmit_holes()
+                if n and self.ep.host.faults_active:
+                    self.ep.host.counters.add("stream_sack_retransmits", n)
         else:
             self.dup_acks = 0  # anything else breaks the consecutive run
         self.pump()  # pump() fires _on_sender_drained when fully drained
@@ -309,6 +546,35 @@ class StreamReceiver:
         if self.ep.on_data is not None:
             self.ep.on_data(nbytes, payload, now)
 
+    def sack_payload(self) -> Optional[bytes]:
+        """The receiver's SACK report: its buffered out-of-order segments
+        merged into contiguous [start, end) byte ranges, the lowest
+        SACK_MAX_BLOCKS of them, each encoded as two big-endian u64s in
+        the ACK's payload field (wire size unchanged — SACK option bytes
+        are noise at fluid-quantum granularity). None when nothing is
+        buffered, which is every ack of a loss-free connection. The C
+        receiver twin (colcore cr_sack_payload) emits identical bytes."""
+        ooo = self.ooo
+        if not ooo:
+            return None
+        out = bytearray()
+        nblocks = 0
+        cs = ce = -1
+        for s in sorted(ooo):
+            n = ooo[s][0]
+            if cs < 0:
+                cs, ce = s, s + n
+            elif s == ce:
+                ce = s + n
+            else:
+                out += cs.to_bytes(8, "big") + ce.to_bytes(8, "big")
+                nblocks += 1
+                if nblocks == SACK_MAX_BLOCKS:
+                    return bytes(out)
+                cs, ce = s, s + n
+        out += cs.to_bytes(8, "big") + ce.to_bytes(8, "big")
+        return bytes(out)
+
     def _ack(self) -> None:
         # round-barrier ack coalescing (the fluid analog of delayed acks):
         # every in-round delivery marks the endpoint; the engine flushes ONE
@@ -332,11 +598,13 @@ class StreamReceiver:
         if ep.state in (CLOSED, TIME_WAIT):
             return
         ep.host._ack_eps.pop(ep, None)
-        ep.emit(U.ACK, acked=self.rcv_nxt, wnd=self.last_wnd)
+        ep.emit(U.ACK, payload=self.sack_payload(), acked=self.rcv_nxt,
+                wnd=self.last_wnd)
 
     def flush_ack(self) -> None:
         self.last_wnd = self.window()
-        self.ep.emit(U.ACK, acked=self.rcv_nxt, wnd=self.last_wnd)
+        self.ep.emit(U.ACK, payload=self.sack_payload(),
+                     acked=self.rcv_nxt, wnd=self.last_wnd)
 
 
 # endpoint states
@@ -353,14 +621,16 @@ class StreamEndpoint:
 
     def __init__(self, host, local_port: int, remote_host: int, remote_port: int,
                  initiator: bool, send_buffer: int = 131072,
-                 recv_buffer: int = 174760):
+                 recv_buffer: int = 174760,
+                 cc: Optional[str] = None):
         self.host = host
         self.local_port = local_port
         self.remote_host = remote_host
         self.remote_port = remote_port
         self.initiator = initiator
         self.state = CLOSED
-        self.sender = StreamSender(self, send_buffer)
+        cc_cls = CONGESTION_CONTROLS[cc] if cc else NewReno
+        self.sender = StreamSender(self, send_buffer, cc=cc_cls())
         self.receiver = StreamReceiver(self, recv_buffer)
         self.syn_tries = 0
         self.fin_tries = 0
@@ -555,7 +825,7 @@ class StreamEndpoint:
         if k == U.ACK:
             if self.state in (CLOSED, TIME_WAIT):
                 return
-            self.sender.on_ack(nbytes, seq)
+            self.sender.on_ack(nbytes, seq, payload)
             return
         if k == U.FIN:
             # the peer's data all precedes its FIN (it fins only once fully
@@ -596,7 +866,12 @@ class StreamEndpoint:
                 self.peer_fin, s.snd_nxt, s.snd_una, s.cwnd, s.ssthresh,
                 s.adv_wnd, s.buffered, s.retries, s.rto_backoff, s.dup_acks,
                 s.loss_events, s.bytes_acked, r.rcv_nxt, r.ooo_bytes,
-                r.bytes_received, r.last_wnd)
+                r.bytes_received, r.last_wnd,
+                # PR 9: SACK scoreboard + congestion-control seam state
+                # (same order/types in the C twin's CEp_fingerprint)
+                s.cc.cc_id, s.w_max, s.epoch_start,
+                1 if s.in_recovery else 0, s.recover, s.sack_high,
+                tuple(sorted(s.sacked)), tuple(sorted(s.rtx_done)))
 
 
 class DatagramSocket:

@@ -1443,11 +1443,16 @@ def _merge_tel_summaries(states: list) -> dict:
             tgt = counts.setdefault(kind, {"ok": 0, "failed": 0})
             tgt["ok"] += c["ok"]
             tgt["failed"] += c["failed"]
+            if c.get("x_n"):
+                tgt["x_sum"] = tgt.get("x_sum", 0) + c["x_sum"]
+                tgt["x_n"] = tgt.get("x_n", 0) + c["x_n"]
     flows = {}
     for kind in sorted(counts):
         c = counts[kind]
         row = {"count": c["ok"] + c["failed"], "ok": c["ok"],
                "failed": c["failed"]}
+        if c.get("x_n"):
+            row["x_mean"] = c["x_sum"] // c["x_n"]
         h = hist.get(kind)
         if h is not None and h.total:
             row.update(h.quantiles_ns_to_ms())

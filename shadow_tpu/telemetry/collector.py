@@ -367,7 +367,7 @@ class TelemetryCollector:
             hid = h.id
             name_j = self._enc_str(h.name)
             for (kind, peer, t_open, t_close, ttfb, nbytes, status,
-                 retx) in buf:
+                 retx, x) in buf:
                 lat = t_close - t_open
                 if status == "ok":
                     hist = self.hist.get(kind)
@@ -378,17 +378,26 @@ class TelemetryCollector:
                 if c is None:
                     c = counts[kind] = {"ok": 0, "failed": 0}
                 c["ok" if status == "ok" else "failed"] += 1
+                if x is not None:
+                    # model-defined metric (e.g. ABR selected bitrate):
+                    # mergeable sum/count so the summary, the sharded
+                    # parent, and the fleet reducer all derive the same
+                    # mean (keys appear only for kinds that carry x)
+                    c["x_sum"] = c.get("x_sum", 0) + x
+                    c["x_n"] = c.get("x_n", 0) + 1
                 # hand-rolled canonical JSON (keys in sorted order, the
                 # _dumps separators) — byte-identical to json.dumps of
-                # the same mapping, at a fraction of its cost
+                # the same mapping, at a fraction of its cost; "x" sorts
+                # last and appears only when the model provided one
                 lines.append(
                     '{"bytes":%d,"flow":%s,"hid":%d,"host":%s,'
                     '"latency_ns":%d,"peer":%s,"retx":%d,"round":%d,'
-                    '"status":%s,"t_close":%d,"t_open":%d,"ttfb_ns":%s}'
+                    '"status":%s,"t_close":%d,"t_open":%d,"ttfb_ns":%s%s}'
                     % (nbytes, self._enc_str(kind), hid, name_j, lat,
                        self._enc_str(peer), retx, rounds,
                        self._enc_str(status), t_close, t_open,
-                       "null" if ttfb is None else "%d" % ttfb))
+                       "null" if ttfb is None else "%d" % ttfb,
+                       "" if x is None else ',"x":%d' % x))
             self.flows_written += len(buf)
         self._flow_lines.extend(lines)
 
@@ -459,6 +468,9 @@ class TelemetryCollector:
             c = self.flow_counts[kind]
             row = {"count": c["ok"] + c["failed"], "ok": c["ok"],
                    "failed": c["failed"]}
+            if c.get("x_n"):
+                # model metric mean (ABR: mean selected bitrate, b/s)
+                row["x_mean"] = c["x_sum"] // c["x_n"]
             hist = self.hist.get(kind)
             if hist is not None and hist.total:
                 row.update(hist.quantiles_ns_to_ms())

@@ -76,3 +76,22 @@ def test_bandwidth_override_parsing():
     cfg = parse_config(doc)
     assert cfg.hosts[0].bandwidth_up == 1_250_000
     assert cfg.hosts[0].bandwidth_down == 12_500_000
+
+
+def test_schema_rosters_track_their_sources_of_truth():
+    """CONGESTION_CONTROL_NAMES and MODEL_REGISTRY are duplicated into
+    the schema (import-avoidance: parse_config must not pull in the
+    transport or every model module); this pins them to the real
+    rosters so adding an algorithm or a model without updating the
+    schema fails here instead of rejecting valid configs at parse
+    time."""
+    import pkgutil
+
+    import shadow_tpu.models
+    from shadow_tpu.config.schema import (CONGESTION_CONTROL_NAMES,
+                                          MODEL_REGISTRY)
+    from shadow_tpu.network.transport import CONGESTION_CONTROLS
+
+    assert set(CONGESTION_CONTROL_NAMES) == set(CONGESTION_CONTROLS)
+    assert set(MODEL_REGISTRY) == {
+        m.name for m in pkgutil.iter_modules(shadow_tpu.models.__path__)}

@@ -481,14 +481,22 @@ def test_schema_rejects_bad_fault_configs():
         _parse({"faults": {}})
 
 
-def test_removed_oracle_mode_rejected():
-    """The retired engine-notification loss model (COMPONENTS.md #13) is
-    a config error now — old configs fail loudly, not silently change
-    semantics."""
-    with pytest.raises(ValueError, match="dupack"):
+def test_removed_loss_models_rejected():
+    """Both retired loss models — the engine-notification oracle
+    (COMPONENTS.md #13) and the PR-9-replaced one-retransmit-per-RTT
+    dupack model — are config errors now: old configs fail loudly
+    instead of silently changing semantics."""
+    with pytest.raises(ValueError, match="sack"):
         _parse({"experimental": {"stream_loss_recovery": "oracle"}})
-    cfg = _parse({"experimental": {"stream_loss_recovery": "dupack"}})
-    assert cfg.experimental.stream_loss_recovery == "dupack"
+    with pytest.raises(ValueError, match="SACK-style"):
+        _parse({"experimental": {"stream_loss_recovery": "dupack"}})
+    cfg = _parse({"experimental": {"stream_loss_recovery": "sack"}})
+    assert cfg.experimental.stream_loss_recovery == "sack"
+    # congestion-control knob: valid names parse, unknown names are named
+    cfg = _parse({"experimental": {"congestion_control": "cubic"}})
+    assert cfg.experimental.congestion_control == "cubic"
+    with pytest.raises(ValueError, match="congestion_control"):
+        _parse({"experimental": {"congestion_control": "bbr2"}})
 
 
 def test_unknown_host_and_node_fail_at_build():

@@ -197,6 +197,71 @@ print(f"fast+robust smoke OK: churned+checkpointed+digested C run "
       f"checkpoints, colcore ABI {h['colcore']})")
 EOF
 
+echo "== modern-web smoke (web_cdn: cross-policy + C on/off hashes, SACK counters) =="
+webrun() {
+    rm -rf "/tmp/ci-web-$1"
+    python -m shadow_tpu examples/web_cdn.yaml --quiet --json-summary \
+        --data-directory "/tmp/ci-web-$1" \
+        --scheduler-policy "$2" \
+        --set "experimental.native_colcore=$3" \
+        --set general.stop_time=26s \
+        | python -c 'import json,sys; from shadow_tpu.core.controller import VOLATILE_SUMMARY_KEYS as V; d=json.load(sys.stdin); [d.pop(k, None) for k in V]; print(json.dumps(d,sort_keys=True))' \
+        > "/tmp/ci-web-$1.json"
+    (cd "/tmp/ci-web-$1" && find hosts -type f | sort | xargs -r sha256sum && \
+     sha256sum flows.jsonl metrics.jsonl) > "/tmp/ci-web-$1.hashes"
+}
+webrun tpc thread_per_core true
+webrun tpu tpu_batch true
+webrun py tpu_batch false
+diff /tmp/ci-web-tpc.json /tmp/ci-web-tpu.json
+diff /tmp/ci-web-tpu.json /tmp/ci-web-py.json
+diff /tmp/ci-web-tpc.hashes /tmp/ci-web-tpu.hashes
+diff /tmp/ci-web-tpu.hashes /tmp/ci-web-py.hashes
+python - <<'EOF'
+import json
+d = json.load(open("/tmp/ci-web-tpu.json"))
+c = d["counters"]
+flows = d["telemetry"]["flows"]
+for kind in ("web.fetch", "web.origin", "dns.resolve"):
+    assert flows.get(kind, {}).get("count", 0) > 0, f"no {kind} flows"
+assert c.get("stream_fast_retransmits", 0) > 0, "no fast retransmits"
+assert c.get("stream_sack_retransmits", 0) > 0, \
+    "SACK recovered no extra holes under the lossy degrade window"
+print(f"modern-web smoke OK: {d['events']} events bit-identical across "
+      f"thread_per_core/tpu_batch and C on/off; "
+      f"{flows['web.fetch']['count']} fetches, "
+      f"{c['stream_sack_retransmits']} SACK hole retransmits")
+EOF
+
+echo "== ABR smoke (abr_1k: C on/off hash + report ABR rows) =="
+abrrun() {
+    rm -rf "/tmp/ci-abr-$1"
+    python -m shadow_tpu examples/abr_1k.yaml --quiet --json-summary \
+        --data-directory "/tmp/ci-abr-$1" \
+        --scheduler-policy tpu_batch \
+        --set "experimental.native_colcore=$2" \
+        --set general.stop_time=16s \
+        | python -c 'import json,sys; from shadow_tpu.core.controller import VOLATILE_SUMMARY_KEYS as V; d=json.load(sys.stdin); [d.pop(k, None) for k in V]; print(json.dumps(d,sort_keys=True))' \
+        > "/tmp/ci-abr-$1.json"
+    (cd "/tmp/ci-abr-$1" && sha256sum flows.jsonl metrics.jsonl) \
+        > "/tmp/ci-abr-$1.hashes"
+}
+abrrun c true
+abrrun py false
+diff /tmp/ci-abr-c.json /tmp/ci-abr-py.json
+diff /tmp/ci-abr-c.hashes /tmp/ci-abr-py.hashes
+python tools/metrics_report.py /tmp/ci-abr-c --json | python -c '
+import json, sys
+r = json.load(sys.stdin)
+assert r["abr"], "report has no ABR rows"
+seg = sum(g["segments"] for g in r["abr"])
+assert seg > 0, r["abr"]
+assert any(g["mean_rate_bps"] > 0 for g in r["abr"])
+groups = len(r["abr"])
+print(f"ABR smoke OK: C on/off bit-identical, {seg} segments across "
+      f"{groups} host-groups in the report")
+'
+
 echo "== telemetry smoke (gossip_churn: cross-policy stream hashes + report parse) =="
 telrun() {
     python -m shadow_tpu examples/gossip_churn.yaml --quiet \

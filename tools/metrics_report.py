@@ -76,6 +76,51 @@ def flow_tables(flows: list) -> dict:
     return out
 
 
+def abr_tables(flows: list) -> list:
+    """ABR roll-up per host-group: mean selected rate (the ``x`` field
+    ``abr.segment`` records carry), stall seconds and stall counts (the
+    ``abr.stall`` records whose latency IS the stall duration). Empty
+    for runs without ABR sessions."""
+    acc: dict = {}
+    for rec in flows:
+        flow = rec["flow"]
+        if flow not in ("abr.segment", "abr.stall"):
+            continue
+        g = group_of(rec["host"])
+        row = acc.get(g)
+        if row is None:
+            row = acc[g] = {"segments": 0, "failed": 0, "rate_sum": 0,
+                            "rate_n": 0, "bytes": 0, "stalls": 0,
+                            "stall_ns": 0}
+        if flow == "abr.segment":
+            if rec["status"] == "ok":
+                row["segments"] += 1
+                row["bytes"] += rec["bytes"]
+            else:
+                row["failed"] += 1
+            x = rec.get("x")
+            if x is not None:
+                row["rate_sum"] += x
+                row["rate_n"] += 1
+        else:
+            row["stalls"] += 1
+            row["stall_ns"] += rec["latency_ns"]
+    out = []
+    for g in sorted(acc):
+        r = acc[g]
+        out.append({
+            "group": g,
+            "segments": r["segments"],
+            "failed": r["failed"],
+            "mean_rate_bps": (r["rate_sum"] // r["rate_n"]
+                              if r["rate_n"] else 0),
+            "mbytes": round(r["bytes"] / 1e6, 1),
+            "stalls": r["stalls"],
+            "stall_s": round(r["stall_ns"] / 1e9, 3),
+        })
+    return out
+
+
 def fault_windows(faults: list, t_end: int) -> list:
     """Fold the applied-transition records into [t0, t1) windows. A
     transition that never restores closes at the end of the run."""
@@ -208,6 +253,7 @@ def build_report(metrics_path: Path, flows_path: Path) -> dict:
             for w in windows],
         "link_utilization": (link_utilization(meta, samples, flows)
                              if meta and samples else []),
+        "abr": abr_tables(flows),
     }
     return report
 
@@ -257,6 +303,12 @@ def main(argv=None) -> int:
                       "ingress_headroom_mean", "deferred_max",
                       "retx_total", "down_host_samples",
                       "most_saturated_host"]))
+    if report["abr"]:
+        print("\nABR sessions per host-group (mean selected rate, "
+              "rebuffering stalls):")
+        print(_fmt_table(report["abr"],
+                         ["group", "segments", "failed", "mean_rate_bps",
+                          "mbytes", "stalls", "stall_s"]))
     print("\nfault windows (flow latencies inside each window):")
     wrows = [{**w, "t0_s": round(w["t0"] / 1e9, 3),
               "t1_s": round(w["t1"] / 1e9, 3)}
