@@ -226,7 +226,8 @@ static PyObject *S_id, *S_now, *S_inbox, *S_egress_rows, *S_uid_counter,
     *S_popleft, *S_append, *S_ingress_deferred_rows, *S_pcap,
     *S_n_emitted, *S_n_delivered, *S_n_dgrams, *S_n_dgrams_recv,
     *S_n_events, *S_dispatch, *S_n_teardown, *S_n_blackholed, *S_down,
-    *S_cc_id;
+    *S_cc_id, *S_seed, *S_bootstrap_end, *S_unit_chunk,
+    *S_socket_send_buffer, *S_socket_recv_buffer;
 
 /* cached small objects */
 static PyObject *O_zero, *O_one, *O_false, *O_kind_dgram;
@@ -2346,7 +2347,7 @@ static int Core_init(CoreObject *c, PyObject *args, PyObject *kwds) {
     if (ok) {
       c->G = PyArray_DIM((PyArrayObject *)c->arrs[6], 0);
       int64_t seed;
-      ok = attr_i64(params, PyUnicode_InternFromString("seed"), &seed) == 0;
+      ok = attr_i64(params, S_seed, &seed) == 0;
       c->seed = (uint64_t)seed;
     }
   }
@@ -2354,8 +2355,7 @@ static int Core_init(CoreObject *c, PyObject *args, PyObject *kwds) {
   Py_XDECREF(buckets);
   Py_XDECREF(graph);
   if (!ok) return -1;
-  if (attr_i64(plane, PyUnicode_InternFromString("bootstrap_end"),
-               &c->bootstrap_end) < 0)
+  if (attr_i64(plane, S_bootstrap_end, &c->bootstrap_end) < 0)
     return -1;
   PyObject *mp = PyObject_GetAttrString(plane, "mesh_plane");
   if (!mp) return -1;
@@ -2424,7 +2424,7 @@ static int Core_init(CoreObject *c, PyObject *args, PyObject *kwds) {
     }
     if (i == 0) {
       int64_t uc;
-      if (attr_i64(host, PyUnicode_InternFromString("unit_chunk"), &uc) < 0)
+      if (attr_i64(host, S_unit_chunk, &uc) < 0)
         return -1;
       c->unit_chunk = uc;
       PyObject *exp = NULL, *ctl2 = PyObject_GetAttrString(host,
@@ -2432,10 +2432,8 @@ static int Core_init(CoreObject *c, PyObject *args, PyObject *kwds) {
       PyObject *cfg2 = ctl2 ? PyObject_GetAttrString(ctl2, "cfg") : NULL;
       exp = cfg2 ? PyObject_GetAttrString(cfg2, "experimental") : NULL;
       int ok2 = exp &&
-          attr_i64(exp, PyUnicode_InternFromString("socket_send_buffer"),
-                   &c->sock_sbuf) == 0 &&
-          attr_i64(exp, PyUnicode_InternFromString("socket_recv_buffer"),
-                   &c->sock_rbuf) == 0;
+          attr_i64(exp, S_socket_send_buffer, &c->sock_sbuf) == 0 &&
+          attr_i64(exp, S_socket_recv_buffer, &c->sock_rbuf) == 0;
       Py_XDECREF(exp);
       Py_XDECREF(cfg2);
       Py_XDECREF(ctl2);
@@ -6606,6 +6604,11 @@ PyMODINIT_FUNC PyInit__colcore(void) {
   INTERN(S_n_blackholed, "_n_blackholed");
   INTERN(S_down, "down");
   INTERN(S_cc_id, "cc_id");
+  INTERN(S_seed, "seed");
+  INTERN(S_bootstrap_end, "bootstrap_end");
+  INTERN(S_unit_chunk, "unit_chunk");
+  INTERN(S_socket_send_buffer, "socket_send_buffer");
+  INTERN(S_socket_recv_buffer, "socket_recv_buffer");
   INTERN(S_dispatch, "dispatch");
   INTERN(S_schedule_in, "schedule_in");
   INTERN(S_cancel_m, "cancel");

@@ -26,7 +26,7 @@ from __future__ import annotations
 
 from functools import partial
 
-import time as _walltime
+import time as _walltime  # detlint: ok(wallclock): phase_wall + heartbeat wall costs
 from pathlib import Path
 
 import numpy as np

@@ -63,7 +63,7 @@ import math
 import os
 import shutil
 import sys
-import time as _walltime
+import time as _walltime  # detlint: ok(wallclock): worker admission, RSS polling, sweep wall report
 from pathlib import Path
 
 import numpy as np
@@ -327,6 +327,7 @@ def output_tree_digest(data_dir) -> str:
     while stack:
         d = stack.pop()
         try:
+            # detlint: ok(unordered-iter): list is .sort()ed before hashing
             with os.scandir(d) as it:
                 for e in it:
                     if e.is_dir(follow_symlinks=False):

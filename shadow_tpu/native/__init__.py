@@ -1,1 +1,24 @@
-from shadow_tpu.native.managed import ManagedProcess  # noqa: F401
+"""Native pieces: the managed-process layer and the _colcore C engine.
+
+SHADOW_TPU_COLCORE_SO points the loader at an alternate _colcore build
+— the ci.sh sanitize-smoke gate runs the whole simulator against the
+ASan/UBSan build in native/build/asan/ without touching the optimized
+extension the rest of the tree imports.  The override must be installed
+before anything imports the packaged submodule, and every import of
+shadow_tpu.native._colcore passes through this package first.
+"""
+
+import importlib.util as _ilu
+import os as _os
+import sys as _sys
+
+_so = _os.environ.get("SHADOW_TPU_COLCORE_SO")
+if _so and "shadow_tpu.native._colcore" not in _sys.modules:
+    _spec = _ilu.spec_from_file_location("shadow_tpu.native._colcore", _so)
+    _mod = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_mod)
+    _sys.modules["shadow_tpu.native._colcore"] = _mod
+    _colcore = _mod  # `from shadow_tpu.native import _colcore` resolves here
+del _ilu, _os, _so, _sys
+
+from shadow_tpu.native.managed import ManagedProcess  # noqa: E402,F401

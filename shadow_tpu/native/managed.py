@@ -622,6 +622,7 @@ class ManagedProcess(ProcessLifecycle):
                 f"{lib} missing — build the native shim first: make -C native")
         self._new_clock_page()
         ddir = self._time_path.parent  # hosts/<name>/ (capture files etc.)
+        # detlint: ok(envread): guests inherit the operator environment
         env = dict(os.environ)
         env.update(self.opts.environment)
         env.update({

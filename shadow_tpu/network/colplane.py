@@ -57,7 +57,7 @@ tgt/peer = dst/src of the unit.
 
 from __future__ import annotations
 
-import time as _walltime
+import time as _walltime  # detlint: ok(wallclock): phase_wall routing telemetry
 from bisect import bisect_left
 from collections import deque
 

@@ -31,7 +31,7 @@ counted, then silently discarded — matching IP semantics for no-route.
 
 from __future__ import annotations
 
-import time as _walltime
+import time as _walltime  # detlint: ok(wallclock): phase_wall + device break-even routing
 from collections import deque
 from dataclasses import dataclass
 from functools import partial

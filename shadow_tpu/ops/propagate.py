@@ -34,7 +34,7 @@ Design notes (TPU-first):
 from __future__ import annotations
 
 import functools
-import time as _walltime
+import time as _walltime  # detlint: ok(wallclock): device attach/calibration wall measures
 
 import numpy as np
 

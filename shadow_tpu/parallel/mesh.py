@@ -47,7 +47,7 @@ the table rides ICI, not HBM-resident state; per-shard bucket state is O(H/N).
 
 from __future__ import annotations
 
-import time as _walltime
+import time as _walltime  # detlint: ok(wallclock): attach/compile wall telemetry
 from functools import partial
 
 import numpy as np

@@ -71,7 +71,7 @@ import marshal
 import os
 import pickle
 import struct
-import time as _walltime
+import time as _walltime  # detlint: ok(wallclock): ring polling + straggler wall telemetry
 from pathlib import Path
 
 import numpy as np
@@ -1148,7 +1148,7 @@ class ShardedRun:
         dig = cfg.general.state_digest_every
         if dig and self.resume_at is None:
             (self.data_dir / _ckpt.DIGEST_FILE).unlink(missing_ok=True)
-            for p in self.data_dir.glob("state_digests.shard*.jsonl"):
+            for p in sorted(self.data_dir.glob("state_digests.shard*.jsonl")):
                 p.unlink()
         tel = cfg.telemetry
         if tel is not None and self.resume_at is None:

@@ -61,7 +61,7 @@ runs: no per-event work, no per-round work beyond one None check.
 from __future__ import annotations
 
 import json
-import time as _walltime
+import time as _walltime  # detlint: ok(wallclock): collector overhead accounting (wall field)
 from pathlib import Path
 
 from shadow_tpu.telemetry.histogram import LogHistogram

@@ -5,6 +5,64 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# The static gates run FIRST: twin-contract drift, determinism-discipline
+# violations, and sanitizer findings fail in seconds, before the
+# expensive identity matrices below ever start.
+
+echo "== twincheck: twin-contract audit (C vs Python surfaces) =="
+python tools/twincheck audit
+
+echo "== twincheck: determinism lint (shadow_tpu/ sim-state modules) =="
+python tools/twincheck detlint
+
+echo "== sanitize smoke (ASan+UBSan colcore+shim: gossip_churn + web_cdn) =="
+make -C native sanitize
+ASAN_LIB=$(gcc -print-file-name=libasan.so)
+# jax throws C++ exceptions in normal operation; ASan's __cxa_throw
+# interceptor needs libstdc++ resolvable at preload time
+STDCXX_LIB=$(gcc -print-file-name=libstdc++.so.6)
+# the loader override + colplane attach both swallow ImportError into a
+# silent Python-plane fallback — probe the sanitized extension imports
+# under the exact smoke environment, so the gate can never "pass" while
+# sanitizing nothing
+LD_PRELOAD="$ASAN_LIB $STDCXX_LIB" \
+ASAN_OPTIONS=detect_leaks=0 \
+SHADOW_TPU_COLCORE_SO=native/build/asan/_colcore.so \
+python -c '
+from shadow_tpu.native import _colcore
+assert "build/asan" in _colcore.__file__, _colcore.__file__
+print("sanitized _colcore imports (ABI %d)" % _colcore.ABI)'
+sanrun() {
+    rm -rf "/tmp/ci-san-$1"
+    LD_PRELOAD="$ASAN_LIB $STDCXX_LIB" \
+    LSAN_OPTIONS=exitcode=0 \
+    SHADOW_TPU_COLCORE_SO=native/build/asan/_colcore.so \
+    JAX_PLATFORMS=cpu \
+    python -m shadow_tpu "examples/$1.yaml" --quiet --json-summary \
+        --data-directory "/tmp/ci-san-$1" \
+        --scheduler-policy tpu_batch \
+        --set experimental.native_colcore=true \
+        > "/tmp/ci-san-$1.json" 2> "/tmp/ci-san-$1.err"
+    # a memory error or unrecovered UB aborts the run above (set -e);
+    # exit-time leak reports are CPython/jax noise EXCEPT frames inside
+    # the colcore extension — those gate
+    if grep -q "colcore" "/tmp/ci-san-$1.err"; then
+        echo "sanitize smoke: colcore frames in the sanitizer report:" >&2
+        grep -B3 -A12 "colcore" "/tmp/ci-san-$1.err" | head -80 >&2
+        exit 1
+    fi
+    python - "$1" <<'EOF'
+import json, sys
+d = json.load(open("/tmp/ci-san-%s.json" % sys.argv[1]))
+assert d["process_errors"] == [], d["process_errors"]
+assert d["events"] > 0, "sanitized run simulated nothing"
+print("sanitize smoke OK: %s ran %d events under ASan/UBSan with the "
+      "C engine, no colcore-attributed leaks" % (sys.argv[1], d["events"]))
+EOF
+}
+sanrun gossip_churn
+sanrun web_cdn
+
 echo "== pytest (CPU JAX, 8 virtual devices) =="
 python -m pytest tests/ -q
 

@@ -1,0 +1,69 @@
+"""CLI for the static twin-contract auditor + determinism linter.
+
+    python tools/twincheck audit    # twin-contract audit (C vs Python)
+    python tools/twincheck detlint  # determinism lint over shadow_tpu/
+    python tools/twincheck all      # both
+
+Exit status 1 when any finding survives (ci.sh gates on this), 0 on a
+clean tree.  `--json` emits machine-readable findings; `--waivers`
+lists every in-place detlint waiver with its written reason.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+import det_lint  # noqa: E402
+import twin_audit  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="twincheck")
+    ap.add_argument("command", choices=("audit", "detlint", "all"))
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: two levels above this file)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--waivers", action="store_true",
+                    help="also list detlint waivers with reasons")
+    args = ap.parse_args(argv)
+
+    root = Path(args.root) if args.root else \
+        Path(__file__).resolve().parent.parent.parent
+    findings = []
+    if args.command in ("audit", "all"):
+        findings += twin_audit.audit(root)
+    waivers = []
+    if args.command in ("detlint", "all"):
+        f, waivers = det_lint.lint_with_waivers(root)
+        findings += f
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [vars(f) for f in findings],
+            "waivers": [
+                {"path": p, "line": ln, "rule": r, "reason": why}
+                for p, ln, r, why in waivers],
+        }, indent=2, sort_keys=True))
+    else:
+        for f in findings:
+            print(f)
+        if args.waivers and waivers:
+            print("-- waivers --")
+            for p, ln, r, why in waivers:
+                print("%s:%d: ok(%s): %s" % (p, ln, r, why))
+        label = {"audit": "twin audit", "detlint": "determinism lint",
+                 "all": "twincheck"}[args.command]
+        if findings:
+            print("%s: %d finding(s)" % (label, len(findings)))
+        else:
+            print("%s: clean" % label)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

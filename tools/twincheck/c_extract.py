@@ -1,0 +1,325 @@
+"""Light C extractor over native/colcore/colcore.c for the twin-contract
+auditor (tools/twincheck/twin_audit.py).
+
+This is deliberately NOT a C parser: colcore.c is hand-written in a
+narrow, consistent style (K&R braces, one function per `static ...
+name(...) {` header, object-like `#define`s, `Py_BuildValue`/
+`PyArg_ParseTuple` with adjacent string literals), and the auditor only
+needs the contract-bearing surfaces: `#define`d constants, the module
+ABI constant, format-string arities, interned-name tables, struct field
+lists, and integer literals inside named function bodies.  Every
+extractor RAISES ExtractError when its anchor is missing — an audit that
+cannot find its subject must fail loudly, not report a clean tree.
+"""
+
+from __future__ import annotations
+
+import re
+
+
+class ExtractError(Exception):
+    """An expected anchor (function, define, table) was not found."""
+
+
+# -- source preparation -------------------------------------------------------
+
+def strip_comments(src: str) -> str:
+    """Blank out /* */ and // comments and string/char literals' inner
+    text is LEFT ALONE (extractors that need literals run before this or
+    use the raw source).  Newlines are preserved so line numbers hold."""
+    out = []
+    i, n = 0, len(src)
+    while i < n:
+        c = src[i]
+        if c == "/" and i + 1 < n and src[i + 1] == "*":
+            j = src.find("*/", i + 2)
+            j = n if j < 0 else j + 2
+            out.append("".join(ch if ch == "\n" else " " for ch in src[i:j]))
+            i = j
+        elif c == "/" and i + 1 < n and src[i + 1] == "/":
+            j = src.find("\n", i)
+            j = n if j < 0 else j
+            out.append(" " * (j - i))
+            i = j
+        elif c == '"':
+            j = i + 1
+            while j < n and src[j] != '"':
+                j += 2 if src[j] == "\\" else 1
+            out.append(src[i:j + 1])
+            i = j + 1
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+# -- constants ----------------------------------------------------------------
+
+_DEFINE_RE = re.compile(r"^#define\s+([A-Za-z_]\w*)\s+(.+?)\s*$", re.M)
+
+
+def defines(src: str) -> dict:
+    """Object-like `#define NAME value` map (function-like macros are
+    skipped).  Values are the raw replacement text."""
+    out = {}
+    for m in _DEFINE_RE.finditer(strip_comments(src)):
+        name, val = m.group(1), m.group(2).strip()
+        if name.endswith("("):  # never happens with this regex, guard anyway
+            continue
+        # function-like macro: NAME(args) — the '(' abuts the name
+        probe = src.find("#define " + name)
+        if probe >= 0 and src[probe + 8 + len(name):probe + 9 + len(name)] == "(":
+            continue
+        out[name] = val
+    return out
+
+
+_INT_TOKEN = re.compile(r"^[0-9]+$")
+
+
+def eval_cexpr(expr: str, env: dict):
+    """Evaluate a small constant C expression: integer literals with
+    L/LL/U suffixes, parentheses, + - * / << >>, and names resolvable in
+    ``env``.  Returns None when the expression uses anything else."""
+    toks = re.findall(r"[A-Za-z_]\w*|\d+|<<|>>|[()+\-*/]", expr)
+    if "".join(toks) != re.sub(r"\s+", "", expr):
+        # token stream lost characters -> unsupported syntax (bit-ops,
+        # casts, ternaries): refuse rather than mis-evaluate
+        return None
+    py = []
+    for t in toks:
+        if _INT_TOKEN.match(t):
+            py.append(t)
+        elif re.match(r"^\d+(?:[uUlL]+)$", t):
+            py.append(re.sub(r"[uUlL]+$", "", t))
+        elif t in ("(", ")", "+", "-", "*", "<<", ">>"):
+            py.append(t)
+        elif t == "/":
+            py.append("//")  # positive constant division in this codebase
+        elif t in env:
+            v = env[t]
+            if v is None:
+                return None
+            py.append("(%d)" % v)
+        elif re.match(r"^[uUlL]+$", t):
+            continue  # literal suffix split off by the tokenizer
+        else:
+            return None
+    try:
+        return int(eval(" ".join(py), {"__builtins__": {}}))  # noqa: S307
+    except Exception:
+        return None
+
+
+def resolve_defines(src: str) -> dict:
+    """defines() with values evaluated to ints where possible (two
+    passes so defines may reference earlier defines)."""
+    raw = defines(src)
+    # strip literal suffixes like 60000000000LL before evaluation
+    env: dict = {}
+    for _ in range(3):
+        for k, v in raw.items():
+            if k not in env or env[k] is None:
+                env[k] = eval_cexpr(re.sub(r"(\d)[uUlL]+\b", r"\1", v), env)
+    return env
+
+
+def module_int_constant(src: str, name: str) -> int:
+    """`PyModule_AddIntConstant(m, "NAME", value)` -> value."""
+    m = re.search(
+        r'PyModule_AddIntConstant\s*\(\s*\w+\s*,\s*"%s"\s*,\s*([^)]+)\)'
+        % re.escape(name), src)
+    if not m:
+        raise ExtractError("PyModule_AddIntConstant %r not found" % name)
+    v = eval_cexpr(m.group(1), {})
+    if v is None:
+        raise ExtractError("module constant %r is not a literal" % name)
+    return v
+
+
+# -- function bodies ----------------------------------------------------------
+
+def function_body(src: str, name: str) -> str:
+    """Body text (between the outermost braces) of the function whose
+    definition header contains ``name(``.  Matches the FIRST definition
+    (colcore.c forward-declares with `;`, defines once)."""
+    clean = strip_comments(src)
+    for m in re.finditer(r"\b%s\s*\(" % re.escape(name), clean):
+        # find the closing paren of the parameter list
+        i = m.end() - 1
+        depth = 0
+        while i < len(clean):
+            if clean[i] == "(":
+                depth += 1
+            elif clean[i] == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+            i += 1
+        # definition iff the next non-space char is '{'
+        j = i + 1
+        while j < len(clean) and clean[j] in " \t\n":
+            j += 1
+        if j >= len(clean) or clean[j] != "{":
+            continue  # declaration or call
+        # brace-match the body
+        depth, k = 0, j
+        while k < len(clean):
+            if clean[k] == "{":
+                depth += 1
+            elif clean[k] == "}":
+                depth -= 1
+                if depth == 0:
+                    return clean[j + 1:k]
+            k += 1
+        raise ExtractError("unbalanced braces in %s" % name)
+    raise ExtractError("function %r not found" % name)
+
+
+# -- format strings -----------------------------------------------------------
+
+def _call_string_arg(body: str, callee: str) -> str:
+    """The leading adjacent-string-literal argument of the first
+    ``callee(...)`` call in ``body`` (skipping non-string leading args,
+    e.g. PyArg_ParseTuple's object argument)."""
+    m = re.search(r"\b%s\s*\(" % re.escape(callee), body)
+    if not m:
+        raise ExtractError("no %s call found" % callee)
+    seg = body[m.end():m.end() + 4000]
+    sm = re.search(r'"((?:[^"\\]|\\.)*)"(?:\s*"((?:[^"\\]|\\.)*)")*', seg)
+    if not sm:
+        raise ExtractError("no string literal in %s call" % callee)
+    # re-scan to concatenate every adjacent literal
+    parts = re.findall(r'"((?:[^"\\]|\\.)*)"', seg[sm.start():])
+    # adjacent literals only: stop at the first token that isn't a string
+    out, pos, sub = [], sm.start(), seg[sm.start():]
+    for pm in re.finditer(r'\s*"((?:[^"\\]|\\.)*)"', sub):
+        if pm.start() != pos - sm.start():
+            break
+        out.append(pm.group(1))
+        pos = sm.start() + pm.end()
+    return "".join(out or parts[:1])
+
+
+def buildvalue_format(src: str, func: str) -> str:
+    return _call_string_arg(function_body(src, func), "Py_BuildValue")
+
+
+def parsetuple_format(src: str, func: str) -> str:
+    return _call_string_arg(function_body(src, func), "PyArg_ParseTuple")
+
+
+def format_codes(fmt: str) -> list:
+    """Per-element type codes of a Py_BuildValue/PyArg_ParseTuple format
+    (outer parens stripped, separators dropped).  Every code used by
+    colcore.c is single-character."""
+    fmt = fmt.strip()
+    if fmt.startswith("(") and fmt.endswith(")"):
+        fmt = fmt[1:-1]
+    codes = []
+    for ch in fmt:
+        if ch in "(),:;| $":
+            continue
+        codes.append(ch)
+    return codes
+
+
+# -- tables and structs -------------------------------------------------------
+
+def string_array(src: str, var: str) -> list:
+    """`static const char *var[N] = {"a", "b", ...}` -> ["a", "b", ...]."""
+    m = re.search(r"\*\s*%s\s*\[[^]]*\]\s*=\s*\{" % re.escape(var), src)
+    if not m:
+        raise ExtractError("string table %r not found" % var)
+    seg = src[m.end():src.find("}", m.end())]
+    return re.findall(r'"([^"]+)"', seg)
+
+
+def struct_fields(src: str, name: str) -> list:
+    """Field names of `typedef struct name { ... } name;`."""
+    clean = strip_comments(src)
+    m = re.search(r"typedef\s+struct\s+%s\s*\{" % re.escape(name), clean)
+    if not m:
+        raise ExtractError("struct %r not found" % name)
+    end = clean.find("} %s;" % name, m.end())
+    if end < 0:
+        raise ExtractError("struct %r not terminated" % name)
+    body = clean[m.end():end]
+    fields = []
+    for stmt in body.split(";"):
+        stmt = stmt.strip()
+        if not stmt or stmt.startswith("#"):
+            continue
+        # drop PyObject_HEAD-style macros with no declarator
+        if re.fullmatch(r"[A-Za-z_]\w*", stmt):
+            continue
+        # `type a, b, c` / `struct X *a` / `Ring r` — take the trailing
+        # identifiers of each comma-separated declarator
+        decl = stmt.split("{")[-1]
+        for piece in decl.split(","):
+            im = re.search(r"([A-Za-z_]\w*)\s*(?:\[[^]]*\])?\s*$", piece)
+            if im:
+                fields.append(im.group(1))
+    return fields
+
+
+def intern_calls_outside_init(src: str) -> list:
+    """(lineno, line) for every PyUnicode_InternFromString call outside
+    the module init function (where the INTERN macro checks the result
+    and the reference is intentionally immortal).  Anywhere else the
+    call leaks a reference per call and its NULL return is typically
+    unchecked — the pattern PR 9 review caught once already."""
+    clean = strip_comments(src)
+    init = re.search(r"PyMODINIT_FUNC\s+PyInit_\w+\s*\(", clean)
+    init_span = (0, 0)
+    if init:
+        j = clean.find("{", init.end())
+        depth, k = 0, j
+        while k < len(clean):
+            if clean[k] == "{":
+                depth += 1
+            elif clean[k] == "}":
+                depth -= 1
+                if depth == 0:
+                    break
+            k += 1
+        init_span = (init.start(), k)
+    out = []
+    for m in re.finditer(r"PyUnicode_InternFromString", clean):
+        if init_span[0] <= m.start() <= init_span[1]:
+            continue
+        line = clean.count("\n", 0, m.start()) + 1
+        text = src.splitlines()[line - 1].strip()
+        out.append((line, text))
+    return out
+
+
+def interned_names(src: str) -> list:
+    """Every string interned through the module-init INTERN(var, "s")
+    table — the C side's attribute-name contract with the Python twins."""
+    body = None
+    m = re.search(r"PyMODINIT_FUNC\s+PyInit_\w+", src)
+    if not m:
+        raise ExtractError("module init not found")
+    return re.findall(r'INTERN\(\s*\w+\s*,\s*"([^"]+)"\s*\)', src)
+
+
+def int_literals(src: str, func: str, env: dict, minval: int = 3) -> list:
+    """Integer literals (and env-resolvable identifiers) >= minval in
+    the body of ``func``, in source order.  Shift amounts count as their
+    literal value (both twins write `1 << 32` / `(1LL << 32)` so the
+    raw-token view matches)."""
+    body = function_body(src, func)
+    out = []
+    for t in re.findall(r"[A-Za-z_]\w*|\d+", body):
+        if t.isdigit():
+            v = int(t)
+        elif re.fullmatch(r"\d+[uUlL]+", t):
+            v = int(re.sub(r"[uUlL]+$", "", t))
+        elif t in env and isinstance(env.get(t), int):
+            v = env[t]
+        else:
+            continue
+        if v >= minval:
+            out.append(v)
+    return out

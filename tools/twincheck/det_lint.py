@@ -1,0 +1,349 @@
+"""Determinism linter over shadow_tpu/ — AST rules for the discipline
+every identity gate depends on: no wall-clock, no global RNG, no
+unordered iteration or filesystem-order dependence in anything that can
+feed trees/flows/digests, no id()-derived ordering, and no environment
+reads outside the documented SHADOW_*/JAX_* surface.
+
+Rules (stable ids, asserted by tests/test_twincheck.py):
+
+  wallclock       `import time` / `import datetime` or calls through
+                  them.  The sanctioned escape hatch is the repo
+                  convention `import time as _walltime` carrying an
+                  inline waiver — one documented line per module makes
+                  every deliberate wall-clock consumer auditable, and
+                  any NEW `time` import without a written reason fails.
+  modrandom       stdlib `random` (global Mersenne state) or numpy
+                  global-state RNG (np.random.seed/rand/randint/...).
+                  Simulation randomness must come through the
+                  counter-based constructions in core/rng.py.
+  unordered-iter  iteration/materialization of a set expression, or an
+                  os.listdir/glob/iterdir/scandir result, without
+                  sorted(...) — set order is hash-seed dependent and
+                  directory order is filesystem dependent.  Set
+                  iteration is only flagged inside digest/fingerprint/
+                  export/serialize functions; filesystem listings are
+                  flagged module-wide.
+  idorder         id() used as an ordering key (sorted/sort/min/max
+                  key=id, or id() under <,>,<=,>=) — CPython addresses
+                  change run to run.
+  envread         os.environ/os.getenv with a name outside the
+                  SHADOW_*/JAX_*/XLA_* allowlist, a non-literal name
+                  that doesn't resolve to one, or a whole-environment
+                  read.
+
+Waivers: append ``# detlint: ok(<rule>): <reason>`` to the flagged line
+(or the line directly above).  A waiver with an empty reason is itself a
+finding (`waiver-reason`) — the point is the documented WHY, in place.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from report import Finding
+
+#: functions whose bodies are canonical-serialization / digest paths —
+#: set iteration inside them must be sorted
+DIGEST_FN_RE = re.compile(
+    r"(fingerprint|digest|_feed|export_state|serialize|canonical)",
+    re.I)
+
+#: env-name prefixes the simulator may read (the documented config
+#: surface; SHADOW_* covers SHADOW_TPU_* and SHADOW_SHIM_*)
+ENV_ALLOW_RE = re.compile(r"^(SHADOW_|JAX_|XLA_)")
+
+WALLCLOCK_MODULES = {"time", "datetime"}
+
+NP_GLOBAL_RNG = {"seed", "rand", "randn", "randint", "random", "choice",
+                 "shuffle", "permutation", "normal", "uniform",
+                 "exponential"}
+
+FS_LIST_CALLS = {"listdir", "scandir", "iterdir", "glob", "rglob",
+                 "iglob"}
+
+WAIVER_RE = re.compile(r"#\s*detlint:\s*ok\(([\w-]+)\)\s*:?\s*(.*)$")
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, src: str):
+        self.path = path
+        self.lines = src.splitlines()
+        self.findings: list = []
+        self.waivers: list = []  # (line, rule, reason)
+        #: local alias -> wallclock module ("time"/"datetime")
+        self.clock_aliases: dict = {}
+        #: local alias -> "np" for `import numpy as np`
+        self.np_aliases: set = set()
+        #: module-level str constants (for env-name resolution)
+        self.str_consts: dict = {}
+        self._fn_stack: list = []
+        for ln, text in enumerate(self.lines, 1):
+            m = WAIVER_RE.search(text)
+            if m:
+                self.waivers.append((ln, m.group(1), m.group(2).strip()))
+
+    # -- plumbing ------------------------------------------------------------
+
+    def flag(self, rule: str, node: ast.AST, msg: str):
+        line = getattr(node, "lineno", 0)
+        for wln, wrule, reason in self.waivers:
+            if wrule == rule and wln in (line, line - 1):
+                return  # waived in place (reason presence checked globally)
+        self.findings.append(Finding(rule, self.path, line, msg))
+
+    def _prescan(self, tree: ast.Module):
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                self.str_consts[node.targets[0].id] = node.value.value
+
+    # -- imports -------------------------------------------------------------
+
+    def visit_Import(self, node: ast.Import):
+        for alias in node.names:
+            top = alias.name.split(".")[0]
+            if top in WALLCLOCK_MODULES:
+                self.clock_aliases[alias.asname or top] = top
+                self.flag("wallclock", node,
+                          "`import %s` in a simulation module — wall "
+                          "clocks must never feed sim state; alias as "
+                          "_walltime and waive with the reason if this "
+                          "is deliberate wall-side telemetry" % alias.name)
+            if top == "numpy":
+                self.np_aliases.add(alias.asname or top)
+            if top == "random":
+                self.flag("modrandom", node,
+                          "stdlib `random` is global-state Mersenne — "
+                          "use the counter-based RNG (core/rng.py)")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        top = (node.module or "").split(".")[0]
+        if top in WALLCLOCK_MODULES:
+            self.flag("wallclock", node,
+                      "`from %s import ...` in a simulation module" %
+                      node.module)
+        if top == "random":
+            self.flag("modrandom", node,
+                      "stdlib `random` import — use core/rng.py")
+        self.generic_visit(node)
+
+    # -- calls ---------------------------------------------------------------
+
+    def _attr_chain(self, node):
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return list(reversed(parts))
+        return None
+
+    def visit_Call(self, node: ast.Call):
+        chain = self._attr_chain(node.func) or []
+        # (wall-clock coverage is import-site only, by design: the one
+        # flagged/waived import line per module documents every call
+        # made through its alias)
+        # numpy global-state RNG
+        if len(chain) == 3 and chain[0] in self.np_aliases \
+                and chain[1] == "random" and chain[2] in NP_GLOBAL_RNG:
+            self.flag("modrandom", node,
+                      "np.random.%s uses numpy global/unseeded RNG state "
+                      "— construct an explicit seeded Generator "
+                      "(core/rng.py)" % chain[2])
+        # default_rng() with no explicit seed draws OS entropy
+        if len(chain) == 3 and chain[0] in self.np_aliases \
+                and chain[1] == "random" and chain[2] == "default_rng" \
+                and not node.args:
+            self.flag("modrandom", node,
+                      "np.random.default_rng() with no seed draws OS "
+                      "entropy — pass an explicit seed")
+        # filesystem listing order
+        if chain and chain[-1] in FS_LIST_CALLS \
+                and not self._sorted_parent(node):
+            self.flag("unordered-iter", node,
+                      "%s returns entries in filesystem order — wrap in "
+                      "sorted(...) before anything that feeds output "
+                      "streams" % ".".join(chain))
+        # env reads
+        if chain[-2:] == ["environ", "get"] or chain[-1:] == ["getenv"]:
+            self._check_env_name(node, node.args[0] if node.args else None)
+        if chain[-1:] == ["dict"] or (isinstance(node.func, ast.Name)
+                                      and node.func.id == "dict"):
+            for a in node.args:
+                ac = self._attr_chain(a) or []
+                if ac[-1:] == ["environ"]:
+                    self.flag("envread", node,
+                              "whole-environment read — the simulation "
+                              "surface is the SHADOW_*/JAX_* allowlist")
+        # list(<set>)/tuple(<set>) materialization in digest paths —
+        # same hash-seed hazard as iterating the set directly
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in ("list", "tuple") and node.args \
+                and self._in_digest_fn() \
+                and self._is_set_expr(node.args[0]) \
+                and not self._sorted_parent(node):
+            self.flag("unordered-iter", node,
+                      "%s() over a set inside a digest/canonical path — "
+                      "set order is hash-seed dependent; wrap in "
+                      "sorted(...)" % node.func.id)
+        # id() as ordering key
+        if chain and chain[-1] in ("sorted", "sort", "min", "max"):
+            for kw in node.keywords:
+                if kw.arg == "key" and isinstance(kw.value, ast.Name) \
+                        and kw.value.id == "id":
+                    self.flag("idorder", node,
+                              "%s(key=id) orders by CPython address — "
+                              "never stable across runs" % chain[-1])
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        chain = self._attr_chain(node.value) or []
+        if chain[-1:] == ["environ"] and isinstance(node.ctx, ast.Load):
+            sl = node.slice
+            self._check_env_name(node, sl)
+        self.generic_visit(node)
+
+    def _check_env_name(self, node, name_node):
+        if isinstance(name_node, ast.Constant) \
+                and isinstance(name_node.value, str):
+            name = name_node.value
+        elif isinstance(name_node, ast.Name) \
+                and name_node.id in self.str_consts:
+            name = self.str_consts[name_node.id]
+        else:
+            self.flag("envread", node,
+                      "environment read with a name the linter cannot "
+                      "resolve — use a literal or module-level constant")
+            return
+        if not ENV_ALLOW_RE.match(name):
+            self.flag("envread", node,
+                      "environment read of %r outside the SHADOW_*/JAX_* "
+                      "allowlist — env must not steer simulation state" %
+                      name)
+
+    # -- comparisons ---------------------------------------------------------
+
+    def visit_Compare(self, node: ast.Compare):
+        if any(isinstance(op, (ast.Lt, ast.Gt, ast.LtE, ast.GtE))
+               for op in node.ops):
+            for operand in [node.left] + node.comparators:
+                if isinstance(operand, ast.Call) \
+                        and isinstance(operand.func, ast.Name) \
+                        and operand.func.id == "id":
+                    self.flag("idorder", node,
+                              "ordering comparison on id(...) — CPython "
+                              "addresses are not stable across runs")
+        self.generic_visit(node)
+
+    # -- set iteration in digest paths ---------------------------------------
+
+    def _sorted_parent(self, node) -> bool:
+        p = getattr(node, "_dl_parent", None)
+        while p is not None:
+            if isinstance(p, ast.Call) and isinstance(p.func, ast.Name) \
+                    and p.func.id == "sorted":
+                return True
+            p = getattr(p, "_dl_parent", None)
+        return False
+
+    def _is_set_expr(self, node) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id in ("set", "frozenset"):
+            return True
+        return False
+
+    def _in_digest_fn(self) -> bool:
+        return any(DIGEST_FN_RE.search(fn) for fn in self._fn_stack)
+
+    def _check_set_iter(self, node, iter_node):
+        if self._in_digest_fn() and self._is_set_expr(iter_node) \
+                and not self._sorted_parent(iter_node):
+            self.flag("unordered-iter", node,
+                      "unsorted set iteration inside a digest/canonical "
+                      "path — set order is hash-seed dependent; wrap in "
+                      "sorted(...)")
+
+    def visit_For(self, node: ast.For):
+        self._check_set_iter(node, node.iter)
+        self.generic_visit(node)
+
+    def visit_comprehension_gen(self, node):
+        for gen in node.generators:
+            self._check_set_iter(node, gen.iter)
+
+    def visit_ListComp(self, node):
+        self.visit_comprehension_gen(node)
+        self.generic_visit(node)
+
+    def visit_GeneratorExp(self, node):
+        self.visit_comprehension_gen(node)
+        self.generic_visit(node)
+
+    def visit_DictComp(self, node):
+        self.visit_comprehension_gen(node)
+        self.generic_visit(node)
+
+    def visit_SetComp(self, node):
+        self.visit_comprehension_gen(node)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._fn_stack.append(node.name)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _link_parents(tree: ast.Module):
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            child._dl_parent = parent
+
+
+def lint_file(path: Path, relpath: str) -> "tuple[list, list]":
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return ([Finding("parse", relpath, e.lineno or 0, str(e))], [])
+    _link_parents(tree)
+    linter = _Linter(relpath, src)
+    linter._prescan(tree)
+    linter.visit(tree)
+    out = linter.findings
+    # a waiver with no written reason defeats the point of waivers
+    for wln, wrule, reason in linter.waivers:
+        if not reason:
+            out.append(Finding(
+                "waiver-reason", relpath, wln,
+                "detlint waiver for %r has no written reason — every "
+                "deliberate exception must say why, in place" % wrule))
+    return out, linter.waivers
+
+
+def lint(root) -> list:
+    findings, _ = lint_with_waivers(root)
+    return findings
+
+
+def lint_with_waivers(root) -> "tuple[list, list]":
+    root = Path(root)
+    findings: list = []
+    waivers: list = []
+    for path in sorted((root / "shadow_tpu").rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        rel = str(path.relative_to(root))
+        f, w = lint_file(path, rel)
+        findings.extend(f)
+        waivers.extend((rel, ln, rule, reason) for ln, rule, reason in w)
+    return findings, waivers

@@ -1,0 +1,17 @@
+"""Shared finding record for the auditor and the linter."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self):
+        loc = "%s:%d" % (self.path, self.line) if self.line else self.path
+        return "%s: %s: %s" % (loc, self.rule, self.message)
