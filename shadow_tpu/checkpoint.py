@@ -80,8 +80,12 @@ FORMAT = "shadow_tpu-checkpoint"
 #: of the colcore ABI 2 -> 3 bump), so version-2 checkpoints — whose
 #: senders lack those attributes and would crash on the first ack after
 #: resume — are refused by the version gate like version-1 before them.
-#: See MIGRATION.md.
-VERSION = 3
+#: Version 4: the StreamSender SACK/rtx scoreboards became SORTED LISTS
+#: (canonical by construction for the columnar transport export,
+#: network/devtransport.py); a version-3 checkpoint would restore sets
+#: where the bisect-based scoreboard code expects lists. See
+#: MIGRATION.md.
+VERSION = 4
 #: config keys that may legitimately differ between the checkpointing run
 #: and the resuming invocation (run-location, snapshot policy, and the
 #: data-plane implementation toggle — never simulation semantics:
@@ -96,6 +100,11 @@ VOLATILE_CONFIG_KEYS = (
     ("general", "heartbeat_interval"),
     ("general", "log_level"),
     ("experimental", "native_colcore"),
+    # the columnar transport engine is the same kind of toggle: every
+    # path is bit-identical (tests/test_devtransport.py), engagement is
+    # wall-clock policy, and _reattach_runtime rebuilds — or not — the
+    # engine from the resume invocation's value
+    ("experimental", "device_transport"),
 )
 
 DIGEST_FILE = "state_digests.jsonl"

@@ -58,7 +58,8 @@ _GC_EVERY_ROUNDS = 5000
 VOLATILE_SUMMARY_KEYS = ("wall_seconds", "sim_sec_per_wall_sec",
                          "phase_wall", "max_rss_mb", "device",
                          "device_windows_dispatched", "sim_shards",
-                         "shards")
+                         "shards", "device_transport",
+                         "device_transport_engaged")
 
 
 class Controller:
@@ -367,6 +368,12 @@ class Controller:
                 h.equeue.on_first = partial(self._active.add, h.id)
         from shadow_tpu import checkpoint as _ckpt
 
+        attach_dt = getattr(self.engine, "attach_devtransport", None)
+        if attach_dt is not None:
+            # after attach_colcore: the transport engine yields to an
+            # attached C core (experimental.device_transport is volatile
+            # wall-clock policy, like native_colcore)
+            attach_dt(cfg.experimental)
         _ckpt.finish_colcore_adopt(self)
 
     def _on_signal(self, signum, frame) -> None:
@@ -495,6 +502,11 @@ class Controller:
         try/finally stays readable). Returns the final sim time."""
         import gc as _gc
 
+        # device transport (network/devtransport.py): deferred host
+        # rounds replay inside end_of_round; their event counts fold
+        # back into `executed` so the skip-ahead decision, the events
+        # total, and the round grid are identical to the scalar twin's
+        devt = getattr(self.engine, "devt", None)
         while now < stop:
             if self._interrupt is not None:
                 # graceful shutdown: the signal arrived during the last
@@ -541,6 +553,8 @@ class Controller:
                         self._active.discard(h.id)
             self._events_wall += _walltime.perf_counter() - t_ev
             self.engine.end_of_round(now, round_end)
+            if devt is not None:
+                executed += devt.take_executed()
             self.rounds += 1
             self.events += executed
             if dig and self.rounds % dig == 0:
@@ -721,6 +735,15 @@ class Controller:
                 self.engine, "dev_windows", 0),
             **({"device": self.engine.device_summary()}
                if hasattr(self.engine, "device_summary") else {}),
+            # device transport (PR 11): wall-clock routing telemetry for
+            # the columnar endpoint ticks; engaged = at least one cohort
+            # actually advanced through the batched kernel (bench.py
+            # turns a silent fallback into a loud warning, the
+            # device_engaged discipline)
+            **(lambda dt: {} if dt is None else {
+                "device_transport_engaged": dt.cohorts > 0,
+                "device_transport": dt.summary(),
+            })(getattr(self.engine, "devt", None)),
             **({"fault_transitions_applied": self.faults.applied}
                if self.faults is not None else {}),
             # flow-latency percentiles + sample counts (telemetry/):

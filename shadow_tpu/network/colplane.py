@@ -181,7 +181,7 @@ class ColumnarPlane(DeviceRoutedPlane):
         self.phase_wall = {"barrier": 0.0, "draw_flush": 0.0,
                            "extract": 0.0, "ingress_deferred": 0.0,
                            "window_build": 0.0, "window_dispatch": 0.0,
-                           "window_readback": 0.0}
+                           "window_readback": 0.0, "transport_tick": 0.0}
         for h in hosts:
             h.colplane = self
         self._init_device_routing(backend, tpu_options, params)
@@ -191,6 +191,12 @@ class ColumnarPlane(DeviceRoutedPlane):
         #: disabled, everything below runs pure Python.
         self._c = None
         self.attach_colcore(tpu_options)
+        #: device-resident columnar transport (network/devtransport.py):
+        #: attached when experimental.device_transport is on and the C
+        #: engine is not (colcore already owns the scalar fast path —
+        #: the column snapshot/adopt ABI remains available either way)
+        self.devt = None
+        self.attach_devtransport(tpu_options)
 
     def attach_colcore(self, tpu_options):
         """(Re)build the C engine over the current structures — the
@@ -218,6 +224,28 @@ class ColumnarPlane(DeviceRoutedPlane):
         if self.shard_n > 1:
             self._bind_shard_core()
         return self._c
+
+    def attach_devtransport(self, tpu_options):
+        """(Re)attach the columnar transport engine — constructor hookup
+        and the checkpoint-restore twin (Controller._reattach_runtime).
+        experimental.device_transport is a volatile wall-clock-policy
+        key: engagement cannot change results (every path is
+        bit-identical, enforced by tests/test_devtransport.py), so a
+        resume may flip it like native_colcore."""
+        for h in self.hosts:
+            h.devt = None
+        self.devt = None
+        if not getattr(tpu_options, "device_transport", False):
+            return None
+        if self._c is not None:
+            return None  # colcore IS the fast scalar twin (module doc)
+        from shadow_tpu.network.devtransport import DeviceTransport
+
+        self.devt = DeviceTransport(self)
+        self.devt.start_device_attach()
+        for h in self.hosts:
+            h.devt = self.devt
+        return self.devt
 
     def _bind_shard_core(self) -> None:
         """Install the shard filter on the C core: the packed send path
@@ -331,6 +359,12 @@ class ColumnarPlane(DeviceRoutedPlane):
         window, install ready speculative tables, pull new speculation
         demand). Windows open and close ONLY at round boundaries, so
         checkpoint.py's round-boundary snapshots stay valid."""
+        if self.devt is not None:
+            # deferred host rounds replay (and their ack cohorts advance
+            # as one batched kernel) BEFORE the barrier collects
+            # emitters, so replayed emissions join this round's barrier
+            # exactly as live-dispatched ones would have
+            self.devt.flush_round(round_end)
         self._barrier_round(round_start, round_end)
         self._window_tick(round_end)
 

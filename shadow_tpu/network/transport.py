@@ -69,6 +69,7 @@ across the Python/C twins (tests/test_telemetry.py enforces this).
 
 from __future__ import annotations
 
+from bisect import bisect_left, insort
 from collections import deque
 from typing import Callable, Optional
 
@@ -106,6 +107,13 @@ def _icbrt(x: int) -> int:
         else:
             hi = mid - 1
     return lo
+
+
+def _sb_has(sb: list, seq: int) -> bool:
+    """Sorted-scoreboard membership (bisect; the lists are tiny — entries
+    are a subset of the rtx seqs under loss)."""
+    i = bisect_left(sb, seq)
+    return i < len(sb) and sb[i] == seq
 
 
 class CongestionControl:
@@ -253,11 +261,17 @@ class StreamSender:
         #: SACK scoreboard: seqs of rtx entries the peer reported holding
         #: (pruned as the cumulative ack passes them), the highest SACKed
         #: byte seen since the last RTO (holes live strictly below it),
-        #: and the per-recovery-episode set of already-retransmitted seqs
-        #: — "all holes per RTT" means each hole at most once per episode
-        self.sacked: set[int] = set()
+        #: and the per-recovery-episode list of already-retransmitted seqs
+        #: — "all holes per RTT" means each hole at most once per episode.
+        #: Both are SORTED lists (PR 11), not sets: membership stays cheap
+        #: at scoreboard scale (entries ⊆ rtx seqs, a handful under real
+        #: loss), iteration order is canonical by construction — the
+        #: columnar transport export (network/devtransport.py) and the
+        #: determinism fingerprint read them without a sort or a detlint
+        #: unordered-iteration waiver
+        self.sacked: list[int] = []
         self.sack_high = 0
-        self.rtx_done: set[int] = set()
+        self.rtx_done: list[int] = []
         self.in_recovery = False
         self.recover = 0  # recovery point: snd_nxt when recovery began
         #: cubic epoch state (CongestionControl contract: on the sender)
@@ -331,8 +345,8 @@ class StreamSender:
             for seq, n, _p in self.rtx:
                 if seq >= b:
                     break  # rtx is seq-ascending
-                if seq >= a and seq + n <= b:
-                    sacked.add(seq)
+                if seq >= a and seq + n <= b and not _sb_has(sacked, seq):
+                    insort(sacked, seq)
 
     def _retransmit_holes(self, force_head: bool = False) -> int:
         """Retransmit every un-SACKed, not-yet-retransmitted segment
@@ -347,9 +361,9 @@ class StreamSender:
         for i, (seq, n, p) in enumerate(self.rtx):
             if seq >= hi and not (force_head and i == 0):
                 break  # rtx is seq-ascending: nothing past hi is a hole
-            if seq in sacked or seq in done:
+            if _sb_has(sacked, seq) or _sb_has(done, seq):
                 continue
-            done.add(seq)
+            insort(done, seq)
             self._emit_data(seq, n, p)
             emitted += 1
         return emitted
@@ -440,9 +454,9 @@ class StreamSender:
             while self.rtx and self.rtx[0][0] + self.rtx[0][1] <= cum_ack:
                 self.rtx.popleft()
             if self.sacked:
-                self.sacked = {s for s in self.sacked if s >= cum_ack}
+                del self.sacked[:bisect_left(self.sacked, cum_ack)]
             if self.rtx_done:
-                self.rtx_done = {s for s in self.rtx_done if s >= cum_ack}
+                del self.rtx_done[:bisect_left(self.rtx_done, cum_ack)]
             self.rto_backoff = 1
             self.retries = 0
             self._cancel_rto()
@@ -871,7 +885,8 @@ class StreamEndpoint:
                 # (same order/types in the C twin's CEp_fingerprint)
                 s.cc.cc_id, s.w_max, s.epoch_start,
                 1 if s.in_recovery else 0, s.recover, s.sack_high,
-                tuple(sorted(s.sacked)), tuple(sorted(s.rtx_done)))
+                # sorted lists since PR 11: canonical by construction
+                tuple(s.sacked), tuple(s.rtx_done))
 
 
 class DatagramSocket:

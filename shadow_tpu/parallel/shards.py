@@ -489,6 +489,12 @@ class _ShardWorker:
                         ctl._active.discard(h.id)
             ctl._events_wall += _walltime.perf_counter() - t_ev
             eng.end_of_round(now, round_end)
+            devt = getattr(eng, "devt", None)
+            if devt is not None:
+                # columnar-transport replays ran inside end_of_round:
+                # fold them into this shard's executed count BEFORE the
+                # marker reduction, exactly like Controller._round_loop
+                executed += devt.take_executed()
             ctl.rounds += 1
             ctl.events += executed
 

@@ -153,7 +153,7 @@ def test_multi_hole_burst_retransmits_all_holes_in_one_entry():
     # both holes (and only the holes) retransmitted, in seq order
     assert data_seqs(ep, base) == [1000, 2000]
     assert s.sack_high == 10000
-    assert s.sacked == {3000 + i * 1000 for i in range(7)}
+    assert s.sacked == [3000 + i * 1000 for i in range(7)]
     assert host.counters.c["stream_fast_retransmits"] == 1
     assert host.counters.c["stream_sack_retransmits"] == 1
 
@@ -174,8 +174,8 @@ def test_partial_ack_does_not_reretransmit_done_holes():
     # full repair exits recovery and clears the episode state
     s.on_ack(10000, 1 << 20, None)
     assert not s.in_recovery
-    assert s.rtx_done == set()
-    assert s.sacked == set()  # pruned below the cumulative ack
+    assert s.rtx_done == []
+    assert s.sacked == []  # pruned below the cumulative ack
     assert s.inflight == 0
 
 
@@ -206,7 +206,7 @@ def test_rto_discards_scoreboard_and_collapses():
     base = len(ep.sent)
     s._on_rto()
     # renege safety: scoreboard gone, go-back-N from the oldest hole
-    assert s.sacked == set() and s.rtx_done == set()
+    assert s.sacked == [] and s.rtx_done == []
     assert s.sack_high == 0 and not s.in_recovery
     assert s.cwnd == MIN_CWND
     assert s.rto_backoff == 2

@@ -80,13 +80,13 @@ def test_scratch_copy_is_clean(tree):
 
 def test_abi_bump_without_migration_entry(tree):
     mutate(tree, "native/colcore/colcore.c",
-           'PyModule_AddIntConstant(m, "ABI", 3)',
-           'PyModule_AddIntConstant(m, "ABI", 4)')
+           'PyModule_AddIntConstant(m, "ABI", 4)',
+           'PyModule_AddIntConstant(m, "ABI", 5)')
     assert "abi-migration" in rules(twin_audit.audit(tree))
 
 
 def test_version_bump_without_migration_entry(tree):
-    mutate(tree, "shadow_tpu/checkpoint.py", "VERSION = 3", "VERSION = 9")
+    mutate(tree, "shadow_tpu/checkpoint.py", "VERSION = 4", "VERSION = 9")
     assert "version-migration" in rules(twin_audit.audit(tree))
 
 
@@ -133,6 +133,41 @@ def test_cubic_beta_drift_is_caught(tree):
            "int64_t nc = e->cwnd * 7 / 10;",
            "int64_t nc = e->cwnd * 8 / 10;")
     assert "cubic-arith:on_loss" in rules(twin_audit.audit(tree))
+
+
+# -- the columnar kernel twin (third surface, PR 11) --------------------------
+
+def test_kernel_const_drift_is_caught(tree):
+    mutate(tree, "shadow_tpu/ops/transport_kernels.py",
+           "MSS = 1460", "MSS = 1500")
+    assert "kernel-const-drift:MSS" in rules(twin_audit.audit(tree))
+
+
+def test_kernel_cc_id_drift_is_caught(tree):
+    mutate(tree, "shadow_tpu/ops/transport_kernels.py",
+           "CC_CUBIC = 1", "CC_CUBIC = 2")
+    assert "kernel-const-drift:CC_CUBIC" in rules(twin_audit.audit(tree))
+
+
+def test_kernel_cc_literal_drift_is_caught(tree):
+    # cubic C constant 0.4 -> 0.5 seeded in the KERNEL side only: the
+    # on_ack literal sets of the scalar twins and the batched kernel
+    # diverge (10_000 -> 8_000 in the delta scaling)
+    mutate(tree, "shadow_tpu/ops/transport_kernels.py",
+           "(a * a * a // 1_000_000) * (4 * MSS) // 10_000",
+           "(a * a * a // 1_000_000) * (4 * MSS) // 8_000")
+    assert "kernel-cc-drift:on_ack" in rules(twin_audit.audit(tree))
+
+
+def test_kernel_cc_literal_drift_scalar_side_is_caught(tree):
+    # the same drift seeded on the SCALAR side fails too — the check is
+    # symmetric, so neither twin can move without the other
+    mutate(tree, "shadow_tpu/network/transport.py",
+           "nn = min(newly, 1 << 20)", "nn = min(newly, 1 << 21)")
+    found = rules(twin_audit.audit(tree))
+    assert "kernel-cc-drift:on_ack" in found
+    # ... and the C twin diverges with it (the PR 10 check still fires)
+    assert "cubic-arith:on_ack" in found
 
 
 def test_new_struct_field_without_export_is_caught(tree):
