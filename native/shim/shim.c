@@ -209,10 +209,13 @@ static int read_all(void *buf, size_t n) {
   return 0;
 }
 
+static int shim_poll_streak_reset(void); /* defined with the ring plane */
+
 static int64_t forward(uint64_t nr, uint64_t a0, uint64_t a1, uint64_t a2,
                        uint64_t a3, uint64_t a4, uint64_t a5) {
   struct shim_req rq = {nr, {a0, a1, a2, a3, a4, a5}};
   int64_t ret = -ENOSYS;
+  shim_poll_streak_reset();
   if (write_all(&rq, sizeof rq) != 0) return -EPIPE;
   if (read_all(&ret, sizeof ret) != 0) return -EPIPE;
   return ret;
@@ -238,15 +241,24 @@ static int shim_recv_fd(int64_t *val_out) {
   return fd;
 }
 
-/* ---- shared-memory pipe rings (native/shring.h) ------------------------
- * The worker backs emulated pipes with a memfd ring mapped here on first
- * use (SHIM_RET_MAPRING reply + SCM_RIGHTS). Non-blocking reads/writes
- * are then served entirely locally — zero worker round trips; blocking
- * edges (empty read, full/atomic-split write, EPIPE) forward as before.
+/* ---- shared-memory rings (native/shring.h) -----------------------------
+ * The worker backs emulated pipes AND established stream sockets with a
+ * memfd ring mapped here on first use (SHIM_RET_MAPRING reply +
+ * SCM_RIGHTS; sockets get a pair, role 0 = RX, role 1 = TX). Non-blocking
+ * reads/writes are then served entirely locally — zero worker round
+ * trips; blocking edges (empty read, full/atomic-split/over-budget write,
+ * EPIPE, errors) forward as before. Every in-shim SOCKET op is appended
+ * to the clock page's oplog so the worker can replay the exact call
+ * sequence against the simulated transport at the next fold — bit
+ * determinism does not depend on the fast plane being on.
  * Strict turn-taking makes the shared state race-free. The buffer
  * pointer the guest passed is dereferenced directly (a bad pointer that
  * the kernel would EFAULT faults here instead — cooperative guests). */
 #include "../shring.h"
+/* The simulation boots at 2000-01-01T00:00:00Z (shadow_tpu/core/time.py
+ * EMULATED_EPOCH); monotonic-family clocks originate at boot == sim
+ * start (used by both the libc interposition and the raw SIGSYS path). */
+#define SHIM_EMULATED_EPOCH_NS 946684800000000000LL
 #define SHIM_RET_MAPRING (-1000001)
 #define SHIM_RING_MAX 128
 
@@ -265,24 +277,41 @@ static volatile struct shring *shim_ring_find(long fd, int role) {
   return NULL;
 }
 
+static void shim_ring_unmap(int i) {
+  raw3(SYS_munmap, (long)shim_rings[i].h,
+       (long)(SHRING_HDR + shim_rings[i].h->cap), 0);
+  shim_rings[i].h = NULL;
+}
+
 static void shim_ring_drop(long fd) {
   for (int i = 0; i < SHIM_RING_MAX; i++)
-    if (shim_rings[i].h && shim_rings[i].vfd == fd) {
-      raw3(SYS_munmap, (long)shim_rings[i].h, SHRING_SIZE, 0);
-      shim_rings[i].h = NULL;
-    }
+    if (shim_rings[i].h && shim_rings[i].vfd == fd) shim_ring_unmap(i);
 }
 
 static long raw6_asm(long, long, long, long, long, long, long);
 
 static void shim_ring_install(long vfd, int role, int mfd) {
   shim_gadget_fn m = shim_gadget ? shim_gadget : raw6_asm;
-  long p = m(9 /* mmap */, 0, SHRING_SIZE, 3 /* RW */, 1 /* SHARED */,
-             mfd, 0);
+  /* cap is parameterized (pipes: SHRING_CAP; sockets: the connection's
+   * buffer size): learn the map size from the memfd itself */
+  char st[144];
+  long sz = 0;
+  if (raw3(SYS_fstat, mfd, (long)st, 0) == 0)
+    memcpy(&sz, st + 48, sizeof sz); /* struct stat.st_size (x86_64) */
+  if (sz < SHRING_HDR + SHRING_CAP_MIN ||
+      sz > SHRING_HDR + (long)SHRING_CAP_MAX) {
+    raw3(SYS_close, mfd, 0, 0);
+    return;
+  }
+  long p = m(9 /* mmap */, 0, sz, 3 /* RW */, 1 /* SHARED */, mfd, 0);
   raw3(SYS_close, mfd, 0, 0);
-  if (p <= 0 || ((volatile struct shring *)p)->magic != SHRING_MAGIC ||
-      ((volatile struct shring *)p)->cap != SHRING_CAP) {
-    if (p > 0) raw3(SYS_munmap, p, SHRING_SIZE, 0);
+  if (p <= 0) return;
+  volatile struct shring *h = (volatile struct shring *)p;
+  uint32_t cap = h->cap;
+  if (h->magic != SHRING_MAGIC || cap < SHRING_CAP_MIN ||
+      cap > SHRING_CAP_MAX || (cap & (cap - 1)) != 0 ||
+      (long)cap + SHRING_HDR != sz) {
+    raw3(SYS_munmap, p, sz, 0);
     return;
   }
   int slot = -1;
@@ -290,65 +319,239 @@ static void shim_ring_install(long vfd, int role, int mfd) {
     if (shim_rings[i].h && shim_rings[i].vfd == vfd &&
         shim_rings[i].role == role) {
       /* post-fork/duplicate re-offer: replace the inherited mapping */
-      raw3(SYS_munmap, (long)shim_rings[i].h, SHRING_SIZE, 0);
-      shim_rings[i].h = NULL;
+      shim_ring_unmap(i);
       slot = i;
       break;
     }
     if (!shim_rings[i].h && slot < 0) slot = i;
   }
-  if (slot < 0) { raw3(SYS_munmap, p, SHRING_SIZE, 0); return; } /* full */
+  if (slot < 0) { raw3(SYS_munmap, p, sz, 0); return; } /* full */
   shim_rings[slot].vfd = vfd;
   shim_rings[slot].role = role;
-  shim_rings[slot].h = (volatile struct shring *)p;
+  shim_rings[slot].h = h;
 }
 
 static int shim_page_rw; /* the clock page mapped writable (counter slot) */
 
-static void shim_ring_mark(volatile struct shring *h) {
+/* worker-granted master switch for the poll/time/socket fast paths
+ * (0 under strace, syscall-latency modeling, SHADOW_TPU_SHIM_FASTPATH=0) */
+static int shim_page_fast(void) {
+  return shim_page_rw &&
+         ((uint64_t)shim_time_page[SHIM_PAGE_FLAGS] & SHIM_PAGE_F_FAST);
+}
+
+static void shim_count_class(int word) {
+  if (shim_page_rw) {
+    shim_time_page[SHIM_PAGE_FASTOPS]++;
+    shim_time_page[word]++;
+  }
+}
+
+static void shim_ring_mark(volatile struct shring *h, int cls_word) {
   h->shim_ops++;
   h->dirty = 1; /* worker's wake scan is gated on the page counter */
-  if (shim_page_rw) shim_time_page[SHIM_PAGE_FASTOPS]++;
+  shim_count_class(cls_word);
+}
+
+/* append one socket op to the clock-page oplog (replayed by the worker,
+ * in order, at the next fold). 0 = log full: caller must forward. */
+static int shim_oplog_append(int op, long fd, uint64_t nbytes) {
+  if (!shim_page_rw) return 0;
+  uint64_t cnt = (uint64_t)shim_time_page[SHIM_PAGE_OPLOG_N];
+  if (cnt >= SHIM_OPLOG_MAX) return 0;
+  uint64_t idx = (uint64_t)fd - SHIM_VFD_BASE;
+  shim_time_page[SHIM_OPLOG_OFF / 8 + cnt] =
+      (int64_t)(nbytes | ((((uint64_t)op << 24) | idx) << 32));
+  shim_time_page[SHIM_PAGE_OPLOG_N] = (int64_t)(cnt + 1);
+  return 1;
 }
 
 /* local service; INT64_MIN = not serviceable here, forward to worker */
-static int64_t shim_ring_read(long fd, uint64_t buf, uint64_t count) {
+static int64_t shim_ring_read(long fd, uint64_t buf, uint64_t count,
+                              int peek) {
   volatile struct shring *h = shim_ring_find(fd, 0);
   /* without a writable counter slot the worker cannot observe local
    * activity (wake scans would starve parked peers): forward everything */
   if (!h || !h->fast_ok || !shim_page_rw) return INT64_MIN;
+  int sock = (h->flags & SHRING_F_SOCK) != 0;
+  if (sock && (shim_is_fork || (h->flags & SHRING_F_ERR) ||
+               !shim_page_fast()))
+    return INT64_MIN; /* fork children / error state: worker owns it */
+  if (!sock && peek) return INT64_MIN; /* MSG_PEEK on a plain pipe end */
   uint64_t avail = h->wpos - h->rpos;
-  if (avail == 0) return INT64_MIN; /* EOF / park / EAGAIN: worker's call */
+  if (avail == 0) {
+    if (sock && (h->flags & SHRING_F_HUP)) {
+      /* drained + peer closed: EOF, exactly the worker's _vfd_recv */
+      shim_ring_mark(h, SHIM_PAGE_CLS_RING_R);
+      return 0;
+    }
+    return INT64_MIN; /* EOF / park / EAGAIN: worker's call */
+  }
   uint64_t k = count < avail ? count : avail;
   if (k == 0) return 0;
-  uint64_t off = h->rpos % SHRING_CAP;
-  uint64_t first = SHRING_CAP - off;
+  uint64_t cap = h->cap;
+  uint64_t off = h->rpos % cap;
+  uint64_t first = cap - off;
   if (first > k) first = k;
   memcpy((void *)buf, (const void *)(SHRING_DATA(h) + off), first);
   if (k > first)
     memcpy((void *)(buf + first), (const void *)SHRING_DATA(h), k - first);
-  h->rpos += k;
-  shim_ring_mark(h);
+  if (!peek) {
+    if (sock && !shim_oplog_append(SHIM_OP_RECV, fd, k))
+      return INT64_MIN; /* oplog full: rpos untouched, worker re-serves */
+    h->rpos += k;
+  }
+  shim_ring_mark(h, SHIM_PAGE_CLS_RING_R);
   return (int64_t)k;
 }
 
 static int64_t shim_ring_write(long fd, uint64_t buf, uint64_t count) {
   volatile struct shring *h = shim_ring_find(fd, 1);
   if (!h || !h->fast_ok || !shim_page_rw) return INT64_MIN;
-  if (h->readers == 0) return INT64_MIN; /* EPIPE + SIGPIPE: worker path */
-  if (count == 0) return 0;
-  uint64_t room = SHRING_CAP - (h->wpos - h->rpos);
+  int sock = (h->flags & SHRING_F_SOCK) != 0;
+  if (sock) {
+    /* HUP: the worker's _vfd_send returns EPIPE on peer_closed — forward.
+     * Budget: only FULL writes complete locally (partial accepts and
+     * parking are the worker's call); wbudget is exact for the whole
+     * turn because transport state is frozen while the guest runs. */
+    if (shim_is_fork || (h->flags & (SHRING_F_ERR | SHRING_F_HUP)) ||
+        !shim_page_fast())
+      return INT64_MIN;
+    if (count == 0) return 0;
+    if (h->wbudget < count) return INT64_MIN;
+  } else {
+    if (h->readers == 0) return INT64_MIN; /* EPIPE + SIGPIPE: worker */
+    if (count == 0) return 0;
+  }
+  uint64_t cap = h->cap;
+  uint64_t room = cap - (h->wpos - h->rpos);
   if (room < count) return INT64_MIN; /* partial/atomic/park: worker */
-  uint64_t off = h->wpos % SHRING_CAP;
-  uint64_t first = SHRING_CAP - off;
+  if (sock && !shim_oplog_append(SHIM_OP_SEND, fd, count))
+    return INT64_MIN; /* oplog full: nothing written yet, forward */
+  uint64_t off = h->wpos % cap;
+  uint64_t first = cap - off;
   if (first > count) first = count;
   memcpy((void *)(SHRING_DATA(h) + off), (const void *)buf, first);
   if (count > first)
     memcpy((void *)SHRING_DATA(h), (const void *)(buf + first),
            count - first);
   h->wpos += count;
-  shim_ring_mark(h);
+  if (sock) h->wbudget -= count;
+  shim_ring_mark(h, SHIM_PAGE_CLS_RING_W);
   return (int64_t)count;
+}
+
+/* ---- in-shim poll/ppoll over live ring state + the readiness page ------
+ *
+ * Mirrors the worker's _revents EXACTLY or forwards. Per entry:
+ *   - ring-backed fds (a mapping exists for the needed role) use live
+ *     ring state — the page bytes would be stale for fds the shim itself
+ *     mutates between round trips;
+ *   - everything else needs a VALID readiness byte (published by the
+ *     worker on every service reply for watched, non-ring-backed vfds).
+ * Any entry it cannot evaluate forwards the WHOLE call. Only a ready
+ * result (n > 0) or a zero-timeout zero-ready result completes locally;
+ * a would-block poll with a real timeout must park at the worker. */
+#define SHIM_POLLIN 0x001
+#define SHIM_POLLOUT 0x004
+#define SHIM_POLLERR 0x008
+#define SHIM_POLLHUP 0x010
+
+/* consecutive in-shim polls without any worker round trip; forward after
+ * a bound so a guest spinning on poll() still reaches the worker's spin
+ * detector (reset inside forward()) */
+static int shim_poll_streak;
+
+static int shim_poll_streak_reset(void) {
+  shim_poll_streak = 0;
+  return 0;
+}
+
+static int64_t shim_poll_local(uint64_t fds_ptr, uint64_t nfds,
+                               uint64_t t_arg, int is_ppoll) {
+  if (shim_is_fork || !shim_page_fast() || nfds > 64 ||
+      (nfds && !fds_ptr))
+    return INT64_MIN;
+  if (++shim_poll_streak > 1000) return INT64_MIN;
+  int zero_timeout;
+  if (is_ppoll) { /* timespec*; NULL = infinite; sigmask is ignored by
+                     the worker twin, so it is ignored here too */
+    if (t_arg == 0) {
+      zero_timeout = 0;
+    } else {
+      int64_t sec, nsec;
+      memcpy(&sec, (const void *)t_arg, 8);
+      memcpy(&nsec, (const void *)(t_arg + 8), 8);
+      zero_timeout = (sec == 0 && nsec == 0);
+    }
+  } else { /* poll: signed ms, negative = infinite */
+    zero_timeout = ((int)t_arg == 0);
+  }
+  int16_t revs[64];
+  int n = 0;
+  for (uint64_t i = 0; i < nfds; i++) {
+    int32_t fd;
+    int16_t want;
+    memcpy(&fd, (const void *)(fds_ptr + 8 * i), 4);
+    memcpy(&want, (const void *)(fds_ptr + 8 * i + 4), 2);
+    if (fd < 0) { revs[i] = 0; continue; } /* poll(2): ignored entry */
+    volatile struct shring *h0 = shim_ring_find(fd, 0);
+    volatile struct shring *h1 = shim_ring_find(fd, 1);
+    int16_t r = 0;
+    if (h0 || h1) {
+      if ((h0 && !h0->fast_ok) || (h1 && !h1->fast_ok)) return INT64_MIN;
+      uint32_t fl = h0 ? h0->flags : h1->flags;
+      if (fl & SHRING_F_SOCK) {
+        if (fl & SHRING_F_ERR) return INT64_MIN; /* POLLERR: worker */
+        int hup = (fl & SHRING_F_HUP) != 0;
+        /* _readable: rxbuf or peer_closed; _writable: budget, never
+         * when peer closed (connect_err stays 0 while fast_ok holds) */
+        if ((want & SHIM_POLLIN) &&
+            (hup || (h0 && h0->wpos - h0->rpos > 0)))
+          r |= SHIM_POLLIN;
+        if ((want & SHIM_POLLOUT) && !hup && h1 && h1->wbudget > 0)
+          r |= SHIM_POLLOUT;
+        if (hup) r |= SHIM_POLLHUP;
+        if ((want & SHIM_POLLIN) && !h0 && !hup)
+          return INT64_MIN; /* RX ring not offered yet: cannot know */
+        if ((want & SHIM_POLLOUT) && !h1 && !hup) return INT64_MIN;
+      } else {
+        /* pipe flavor: need the ring for each polled direction (a
+         * missing role cannot be told apart from a wrong-direction
+         * end, whose answer is a constant false — the worker knows) */
+        if (want & SHIM_POLLIN) {
+          if (!h0) return INT64_MIN;
+          if (h0->wpos - h0->rpos > 0 || h0->writers == 0)
+            r |= SHIM_POLLIN;
+        }
+        if (want & SHIM_POLLOUT) {
+          if (!h1) return INT64_MIN;
+          if (h1->cap - (h1->wpos - h1->rpos) > 0 || h1->readers == 0)
+            r |= SHIM_POLLOUT;
+        }
+      }
+    } else {
+      /* readiness byte: VALID only for watched vfds with NO ring-capable
+       * backing anywhere in the process (worker-maintained invariant) */
+      long idx = (long)fd - SHIM_VFD_BASE;
+      if (idx < 0 || idx >= SHIM_READY_LEN) return INT64_MIN;
+      uint8_t b = ((volatile uint8_t *)shim_time_page)[SHIM_READY_OFF +
+                                                       idx];
+      if (!(b & SHIM_READY_VALID)) return INT64_MIN;
+      if ((want & SHIM_POLLIN) && (b & SHIM_READY_IN)) r |= SHIM_POLLIN;
+      if ((want & SHIM_POLLOUT) && (b & SHIM_READY_OUT))
+        r |= SHIM_POLLOUT;
+      if (b & SHIM_READY_HUP) r |= SHIM_POLLHUP;
+      if (b & SHIM_READY_ERR) r |= SHIM_POLLERR;
+    }
+    if (r) n++;
+    revs[i] = r;
+  }
+  if (n == 0 && !zero_timeout) return INT64_MIN; /* park at the worker */
+  for (uint64_t i = 0; i < nfds; i++)
+    memcpy((void *)(fds_ptr + 8 * i + 6), &revs[i], 2);
+  shim_count_class(SHIM_PAGE_CLS_READY);
+  return n;
 }
 
 /* the child re-reads its real pid from /proc (getpid is trapped and would
@@ -422,6 +625,13 @@ static long shim_do_fork(uint64_t nr, greg_t *g) {
      * them (close() on the IPC window is trapped — the worker must not
      * see channel traffic from this thread before its HELLO) */
     shim_is_fork = 1; /* the shared clock page's vpid is the parent's */
+    /* socket rings are per-OWNER-process (the worker's oplog replay map
+     * and wbudget refresh only track the page owner's fds): drop the
+     * inherited mappings so every child socket op forwards. Pipe rings
+     * stay — their state lives in the ring itself and is shared. */
+    for (int i = 0; i < SHIM_RING_MAX; i++)
+      if (shim_rings[i].h && (shim_rings[i].h->flags & SHRING_F_SOCK))
+        shim_ring_unmap(i);
     raw3(SYS_dup2, newfd, SHIM_IPC_FD, 0);
     if (newfd != SHIM_IPC_FD) raw3(SYS_close, newfd, 0, 0);
     int nullfd = (int)raw3(SYS_open, (long)"/dev/null", 2 /*O_RDWR*/, 0);
@@ -579,11 +789,58 @@ static void sigsys_handler(int signo, siginfo_t *info, void *vctx) {
   if (info->si_syscall == SYS_getpid || info->si_syscall == SYS_gettid) {
     if (!shim_is_fork && shim_time_page && shim_time_page[1] > 0) {
       g[REG_RAX] = (greg_t)shim_time_page[1];
+      shim_count_class(SHIM_PAGE_CLS_IDENT);
       return;
     }
   } else if (info->si_syscall == SYS_getppid) {
     g[REG_RAX] = 1;
+    shim_count_class(SHIM_PAGE_CLS_IDENT);
     return;
+  }
+  /* raw time-family syscalls (static binaries / raw-syscall guests that
+   * bypass the libc interposition) served from the clock page. The
+   * monotonic-clock set and the sec/nsec split mirror the worker's
+   * _service exactly; the (uint64_t)-1 sentinel stays a worker call. */
+  if (shim_page_fast()) {
+    if (info->si_syscall == SYS_clock_gettime && g[REG_RSI] &&
+        (uint64_t)g[REG_RDI] != (uint64_t)-1) {
+      int64_t ns = *shim_time_page;
+      uint64_t clk = (uint64_t)g[REG_RDI];
+      if (clk == 1 || clk == 2 || clk == 3 || clk == 4 || clk == 6 ||
+          clk == 7)
+        ns -= SHIM_EMULATED_EPOCH_NS; /* MONO_CLOCKS (worker twin) */
+      int64_t *tp = (int64_t *)g[REG_RSI];
+      tp[0] = ns / 1000000000;
+      tp[1] = ns % 1000000000;
+      shim_count_class(SHIM_PAGE_CLS_TIME);
+      g[REG_RAX] = 0;
+      return;
+    }
+    if (info->si_syscall == SYS_gettimeofday) {
+      if (g[REG_RDI]) {
+        int64_t ns = *shim_time_page;
+        int64_t *tp = (int64_t *)g[REG_RDI];
+        tp[0] = ns / 1000000000;
+        tp[1] = (ns % 1000000000) / 1000;
+      }
+      shim_count_class(SHIM_PAGE_CLS_TIME);
+      g[REG_RAX] = 0;
+      return;
+    }
+    if (info->si_syscall == SYS_time) {
+      int64_t secs = *shim_time_page / 1000000000;
+      if (g[REG_RDI]) *(int64_t *)g[REG_RDI] = secs;
+      shim_count_class(SHIM_PAGE_CLS_TIME);
+      g[REG_RAX] = (greg_t)secs;
+      return;
+    }
+    if (info->si_syscall == SYS_poll || info->si_syscall == SYS_ppoll) {
+      int64_t r = shim_poll_local((uint64_t)g[REG_RDI],
+                                  (uint64_t)g[REG_RSI],
+                                  (uint64_t)g[REG_RDX],
+                                  info->si_syscall == SYS_ppoll);
+      if (r != INT64_MIN) { g[REG_RAX] = (greg_t)r; return; }
+    }
   }
   /* shared-memory pipe fast path (zero round trips when it hits).
    * Covers vfds AND the trapped stdio fds — a shell pipeline dup2's
@@ -596,10 +853,24 @@ static void sigsys_handler(int signo, siginfo_t *info, void *vctx) {
     if (info->si_syscall == SYS_read &&
         (fd0 == 0 || fd0 >= SHIM_VFD_BASE)) {
       int64_t r = shim_ring_read(fd0, (uint64_t)g[REG_RSI],
-                                 (uint64_t)g[REG_RDX]);
+                                 (uint64_t)g[REG_RDX], 0);
+      if (r != INT64_MIN) { g[REG_RAX] = (greg_t)r; return; }
+    } else if (info->si_syscall == SYS_recvfrom &&
+               fd0 >= SHIM_VFD_BASE) {
+      /* flags: the worker honors MSG_PEEK only and ignores the rest,
+       * as does the src-address pair on connected streams — mirror it */
+      int64_t r = shim_ring_read(fd0, (uint64_t)g[REG_RSI],
+                                 (uint64_t)g[REG_RDX],
+                                 ((uint64_t)g[REG_R10] & 2) != 0);
       if (r != INT64_MIN) { g[REG_RAX] = (greg_t)r; return; }
     } else if (info->si_syscall == SYS_write &&
                (fd0 == 1 || fd0 == 2 || fd0 >= SHIM_VFD_BASE)) {
+      int64_t r = shim_ring_write(fd0, (uint64_t)g[REG_RSI],
+                                  (uint64_t)g[REG_RDX]);
+      if (r != INT64_MIN) { g[REG_RAX] = (greg_t)r; return; }
+    } else if (info->si_syscall == SYS_sendto && fd0 >= SHIM_VFD_BASE) {
+      /* dest-address/flags are ignored by the worker on connected
+       * streams (_vfd_send takes fd/buf/len only) — mirror it */
       int64_t r = shim_ring_write(fd0, (uint64_t)g[REG_RSI],
                                   (uint64_t)g[REG_RDX]);
       if (r != INT64_MIN) { g[REG_RAX] = (greg_t)r; return; }
@@ -619,10 +890,8 @@ static void sigsys_handler(int signo, siginfo_t *info, void *vctx) {
     /* CLOSE_RANGE_CLOEXEC (flag 4) marks without closing */
     for (int i = 0; i < SHIM_RING_MAX; i++)
       if (shim_rings[i].h && shim_rings[i].vfd >= (long)g[REG_RDI] &&
-          shim_rings[i].vfd <= (long)g[REG_RSI]) {
-        raw3(SYS_munmap, (long)shim_rings[i].h, SHRING_SIZE, 0);
-        shim_rings[i].h = NULL;
-      }
+          shim_rings[i].vfd <= (long)g[REG_RSI])
+        shim_ring_unmap(i);
   }
   if (info->si_syscall == 9) {
     /* mmap of a virtualized file: the worker replies with the real
@@ -654,8 +923,9 @@ static void sigsys_handler(int signo, siginfo_t *info, void *vctx) {
                         (uint64_t)g[REG_RSI], (uint64_t)g[REG_RDX],
                         (uint64_t)g[REG_R10], (uint64_t)g[REG_R8],
                         (uint64_t)g[REG_R9]);
-  if (ret == SHIM_RET_MAPRING) {
-    /* a ring memfd + role follows, then the real result of this op */
+  while (ret == SHIM_RET_MAPRING) {
+    /* a ring memfd + role follows, then either ANOTHER offer (socket
+     * rings arrive as an RX+TX pair) or the real result of this op */
     int64_t role = 0;
     int mfd = shim_recv_fd(&role);
     if (mfd >= 0) shim_ring_install((long)g[REG_RDI], (int)role, mfd);
@@ -802,24 +1072,26 @@ static int64_t emulated_now_ns(void) {
   return forward(SYS_clock_gettime, (uint64_t)-1, 0, 0, 0, 0, 0);
 }
 
-/* The simulation boots at 2000-01-01T00:00:00Z (shadow_tpu/core/time.py
- * EMULATED_EPOCH); monotonic-family clocks originate at boot == sim start,
- * consistent with sysinfo's sim-second uptime and Linux's near-zero
- * monotonic origin. */
-#define SHIM_EMULATED_EPOCH_NS 946684800000000000LL
-
+/* The simulation boots at 2000-01-01T00:00:00Z; monotonic-family clocks
+ * originate at boot == sim start, consistent with sysinfo's sim-second
+ * uptime and Linux's near-zero monotonic origin (SHIM_EMULATED_EPOCH_NS
+ * is defined beside the ring plane above, which also needs it). */
 static int clk_is_monotonic(clockid_t clk) {
   return clk == CLOCK_MONOTONIC || clk == CLOCK_MONOTONIC_RAW ||
          clk == CLOCK_MONOTONIC_COARSE || clk == CLOCK_BOOTTIME ||
          clk == CLOCK_PROCESS_CPUTIME_ID || clk == CLOCK_THREAD_CPUTIME_ID;
 }
 
+/* the interposed family completes shim-locally in EVERY mode (it never
+ * reaches the worker), so it counts unconditionally — keeping the
+ * "syscalls" counter invariant across fast-plane on/off */
 int clock_gettime(clockid_t clk, struct timespec *ts) {
   if (!shim_active) return (int)raw3(SYS_clock_gettime, clk, (long)ts, 0);
   int64_t ns = emulated_now_ns();
   if (clk_is_monotonic(clk)) ns -= SHIM_EMULATED_EPOCH_NS;
   ts->tv_sec = ns / 1000000000;
   ts->tv_nsec = ns % 1000000000;
+  shim_count_class(SHIM_PAGE_CLS_TIME);
   return 0;
 }
 
@@ -829,6 +1101,7 @@ int gettimeofday(struct timeval *tv, void *tz) {
   int64_t ns = emulated_now_ns();
   tv->tv_sec = ns / 1000000000;
   tv->tv_usec = (ns % 1000000000) / 1000;
+  shim_count_class(SHIM_PAGE_CLS_TIME);
   return 0;
 }
 
@@ -836,6 +1109,7 @@ time_t time(time_t *out) {
   if (!shim_active) return (time_t)raw3(SYS_time, (long)out, 0, 0);
   time_t t = (time_t)(emulated_now_ns() / 1000000000);
   if (out) *out = t;
+  shim_count_class(SHIM_PAGE_CLS_TIME);
   return t;
 }
 

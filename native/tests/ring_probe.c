@@ -1,0 +1,76 @@
+/* ring_probe — drives the shim's socket fast plane on purpose.
+ *
+ * Connects to a tgen server, requests <nbytes>, then drains the reply in
+ * deliberately SMALL odd-sized recvs so that a delivered burst sits in
+ * the connection's shared ring across many consecutive recv calls (each
+ * completing in-shim, zero worker round trips). Before every recv it
+ * issues a zero-timeout poll (served from ring state / the readiness
+ * page once granted), and after the payload it drains to EOF — against a
+ * server that closes after serving, the final recv returns 0 IN-SHIM
+ * from the ring's HUP flag. Finishes with a raw (non-libc-interposed)
+ * clock_gettime via syscall(2) to exercise the in-shim raw time service.
+ *
+ *   usage: ring_probe <ip> <port> <nbytes>
+ */
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
+
+int main(int argc, char **argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s <ip> <port> <nbytes>\n", argv[0]);
+    return 2;
+  }
+  long want = atol(argv[3]);
+
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) { perror("socket"); return 1; }
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof addr);
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((unsigned short)atoi(argv[2]));
+  if (inet_pton(AF_INET, argv[1], &addr.sin_addr) != 1) {
+    fprintf(stderr, "bad ip %s\n", argv[1]);
+    return 2;
+  }
+  if (connect(fd, (struct sockaddr *)&addr, sizeof addr) != 0) {
+    perror("connect");
+    return 1;
+  }
+
+  char req[9];
+  snprintf(req, sizeof req, "%8ld", want);
+  if (send(fd, req, 8, 0) != 8) { perror("send"); return 1; }
+
+  long got = 0, recvs = 0, polls = 0, ready = 0;
+  char buf[997]; /* small + odd: many ring reads per delivered burst */
+  while (got < want) {
+    struct pollfd p = {fd, POLLIN, 0};
+    int pr = poll(&p, 1, 0);
+    polls++;
+    if (pr > 0) ready++;
+    long n = recv(fd, buf, sizeof buf, 0);
+    if (n < 0) { perror("recv"); return 1; }
+    if (n == 0) break; /* early EOF: report what we got */
+    got += n;
+    recvs++;
+  }
+  long n, eof_zero = 0;
+  while ((n = recv(fd, buf, sizeof buf, 0)) > 0) got += n;
+  if (n == 0) eof_zero = 1; /* server closed: clean EOF */
+
+  struct timespec ts;
+  syscall(SYS_clock_gettime, CLOCK_MONOTONIC, &ts);
+  close(fd);
+  printf("ring-probe bytes=%ld recvs=%ld polls=%ld ready=%ld eof=%ld "
+         "mono_s=%ld\n",
+         got, recvs, polls, ready, eof_zero, (long)ts.tv_sec);
+  return 0;
+}

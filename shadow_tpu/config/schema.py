@@ -604,4 +604,19 @@ def load_config(path: str, overrides: Optional[dict] = None,
                 f["path"] = resolved
             else:
                 g["file"] = resolved
+    # process binary paths resolve the same way (managed processes spawn
+    # with cwd inside the data directory, so a committed config's
+    # relative "native/build/foo" would otherwise depend on the caller's
+    # cwd). First try relative to the config file, then the caller's cwd;
+    # pyapp: entries and absolute paths pass through untouched.
+    for h in cfg.hosts:
+        for p in h.processes:
+            if p.path.startswith("pyapp:") or os.path.isabs(p.path):
+                continue
+            for base in (os.path.dirname(os.path.abspath(path)),
+                         os.getcwd()):
+                resolved = os.path.join(base, p.path)
+                if os.path.exists(resolved):
+                    p.path = resolved
+                    break
     return cfg

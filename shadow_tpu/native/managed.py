@@ -58,6 +58,58 @@ AUDIT_NOTE = 0xFFFFFFF7    # arg0 = unemulated syscall nr, first native use
 #: (native/shring.h shared-memory pipe fast path; outside the errno
 #: range, distinct from vfs.RETRY_NATIVE's -1000000)
 MAPRING = -1000001
+
+# -- shim fast-plane ABI (C twin: native/shring.h; tools/twincheck audits
+# every constant below against the header — drift cannot merge) ----------
+SHIM_PAGE_FLAGS = 4         # clock-page u64 word indices
+SHIM_PAGE_CLS_TIME = 5
+SHIM_PAGE_CLS_IDENT = 6
+SHIM_PAGE_CLS_RING_R = 7
+SHIM_PAGE_CLS_RING_W = 8
+SHIM_PAGE_CLS_READY = 9
+SHIM_PAGE_OPLOG_N = 15
+SHIM_PAGE_F_FAST = 1        # flags word bit0: fast plane enabled
+SHIM_READY_OFF = 256        # per-vfd readiness bytes [OFF, OFF+LEN)
+SHIM_READY_LEN = 768
+SHIM_READY_VALID = 1
+SHIM_READY_IN = 2
+SHIM_READY_OUT = 4
+SHIM_READY_HUP = 8
+SHIM_READY_ERR = 16
+SHIM_OPLOG_OFF = 1024       # socket-op log [OFF, OFF + 8*MAX)
+SHIM_OPLOG_MAX = 383
+SHIM_OP_RECV = 1
+SHIM_OP_SEND = 2
+SHRING_OFF_FLAGS = 44       # struct shring field offsets (new fields)
+SHRING_OFF_WBUDGET = 56
+SHRING_F_HUP = 1
+SHRING_F_ERR = 2
+SHRING_F_SOCK = 4
+SHRING_CAP_MIN = 4096
+SHRING_CAP_MAX = 1 << 24
+
+#: clock-page class word -> host counter (fold reads then zeroes, in
+#: this order; the per-class counters are informational — the "syscalls"
+#: fold uses the total in word [2], so totals stay mode-invariant)
+_SHIM_CLASS_COUNTERS = (
+    (SHIM_PAGE_CLS_TIME, "shim_fast_time"),
+    (SHIM_PAGE_CLS_IDENT, "shim_fast_identity"),
+    (SHIM_PAGE_CLS_RING_R, "shim_fast_ring_read"),
+    (SHIM_PAGE_CLS_RING_W, "shim_fast_ring_write"),
+    (SHIM_PAGE_CLS_READY, "shim_fast_readiness"),
+)
+
+# operator escape hatch for A/B determinism runs: with the fast plane
+# forced off, every guest op takes the worker round trip and all
+# simulated observables must stay byte-identical (tools/ci.sh gates it)
+# detlint: ok(envread): host-side A/B switch, never sim state
+_FASTPATH_ON = os.environ.get("SHADOW_TPU_SHIM_FASTPATH", "1") != "0"
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(n - 1, 1).bit_length()
+
+
 SYS_wait4, SYS_exit_group, SYS_pipe, SYS_pipe2 = 61, 231, 22, 293
 SYS_dup, SYS_dup2, SYS_dup3 = 32, 33, 292
 SYS_fstat, SYS_lseek, SYS_newfstatat = 5, 8, 262
@@ -231,7 +283,7 @@ class VSocket:
                  "expirations", "interval_ns", "deadline", "timer_handle",
                  "evt_counter", "refs", "pipe", "pipe_out", "timer_clock",
                  "vfile", "sig_mask", "sig_q", "watches", "next_wd",
-                 "ino_q")
+                 "ino_q", "sockring")
 
     def __init__(self, vfd: int, kind: str = "stream") -> None:
         self.vfd = vfd
@@ -258,6 +310,7 @@ class VSocket:
         self.evt_counter = 0
         self.timer_clock = 0  # timerfd: clockid the deadlines are based on
         self.vfile = None  # VFile when kind is file/dir (native/vfs.py)
+        self.sockring = None  # SockRing once ESTABLISHED + offered
         # fork support: open-file-description refcount (a forked child's fd
         # table shares VSocket objects; the backing object closes when the
         # LAST table entry referencing it closes, like the kernel's)
@@ -462,6 +515,111 @@ class RingPipeBuf(PipeBuf):
             struct.pack_into("<I", self.mm, 36, 0)
 
 
+class SockRing:
+    """Per-connection RX/TX ring pair for an ESTABLISHED managed stream
+    socket (native/shring.h with SHRING_F_SOCK set). Unlike RingPipeBuf,
+    these rings MIRROR authoritative transport state rather than store
+    it: the worker appends every delivered payload to RX (invariant: RX
+    unread == len(vs.rxbuf)) and refreshes the TX ring's wbudget =
+    send_buffer - buffered before every service reply, while the shim
+    consumes RX / fills TX locally and logs each op in the clock-page
+    oplog. The worker replays that log IN ORDER at the next fold, so the
+    simulated transport sees the exact slow-path call sequence and every
+    observable is byte-identical with the fast plane on or off. Exact
+    because of strict turn-taking: transport state is frozen for the
+    whole guest turn, so budgets/readiness published at reply time
+    cannot go stale mid-turn."""
+
+    __slots__ = ("cap", "rx_fd", "tx_fd", "rx", "tx", "dead")
+    HDR = RingPipeBuf.HDR
+    MAGIC = RingPipeBuf.MAGIC
+
+    def __init__(self, cap: int) -> None:
+        self.cap = cap
+        self.dead = False
+        self.rx_fd, self.rx = self._mk(cap)
+        self.tx_fd, self.tx = self._mk(cap)
+
+    def _mk(self, cap: int):
+        fd = os.memfd_create("sockring", 0)
+        os.ftruncate(fd, self.HDR + cap)
+        mm = mmap.mmap(fd, self.HDR + cap)
+        struct.pack_into("<II", mm, 0, self.MAGIC, cap)
+        struct.pack_into("<II", mm, 24, 1, 1)  # readers/writers: wired
+        struct.pack_into("<I", mm, 40, 1)      # fast_ok
+        struct.pack_into("<I", mm, SHRING_OFF_FLAGS, SHRING_F_SOCK)
+        return fd, mm
+
+    # -- RX mirror: worker appends on delivery; rpos advances either
+    #    in-shim (local read, oplogged) or here (slow-path consume) -----
+    def rx_unread(self) -> int:
+        r, w = struct.unpack_from("<QQ", self.rx, 8)
+        return w - r
+
+    def rx_room(self) -> int:
+        return self.cap - self.rx_unread()
+
+    def rx_append(self, data) -> None:
+        r, w = struct.unpack_from("<QQ", self.rx, 8)
+        off = w % self.cap
+        first = min(self.cap - off, len(data))
+        self.rx[self.HDR + off:self.HDR + off + first] = data[:first]
+        if len(data) > first:
+            rest = len(data) - first
+            self.rx[self.HDR:self.HDR + rest] = data[first:]
+        struct.pack_into("<Q", self.rx, 16, w + len(data))
+
+    def rx_advance(self, k: int) -> None:
+        r = struct.unpack_from("<Q", self.rx, 8)[0]
+        struct.pack_into("<Q", self.rx, 8, r + k)
+
+    # -- TX: shim appends within wbudget (oplogged); replay takes -------
+    def tx_take(self, k: int) -> bytes:
+        r, _w = struct.unpack_from("<QQ", self.tx, 8)
+        off = r % self.cap
+        first = min(self.cap - off, k)
+        out = self.tx[self.HDR + off:self.HDR + off + first]
+        if k > first:
+            out += self.tx[self.HDR:self.HDR + (k - first)]
+        struct.pack_into("<Q", self.tx, 8, r + k)
+        return out
+
+    def set_wbudget(self, n: int) -> None:
+        struct.pack_into("<Q", self.tx, SHRING_OFF_WBUDGET, n)
+
+    def sync_flags(self, vs) -> None:
+        if self.rx.closed:
+            return
+        fl = SHRING_F_SOCK
+        if vs.peer_closed:
+            fl |= SHRING_F_HUP
+        if vs.connect_err:
+            fl |= SHRING_F_ERR
+        struct.pack_into("<I", self.rx, SHRING_OFF_FLAGS, fl)
+        struct.pack_into("<I", self.tx, SHRING_OFF_FLAGS, fl)
+
+    def kill(self) -> None:
+        """Permanent fast-off (mirror overflow, shutdown, socket error,
+        teardown): the shim checks fast_ok on every local op, so any
+        still-installed alias mapping stops serving immediately and all
+        traffic takes the worker round trip again."""
+        self.dead = True
+        if not self.rx.closed:
+            struct.pack_into("<I", self.rx, 40, 0)
+            struct.pack_into("<I", self.tx, 40, 0)
+
+    def retire(self) -> None:
+        """Last fd-table reference is gone (every shim mapping was
+        dropped before its close forwarded): release the mappings."""
+        if self.rx.closed:
+            return
+        self.kill()
+        self.rx.close()
+        self.tx.close()
+        os.close(self.rx_fd)
+        os.close(self.tx_fd)
+
+
 class GuestThread:
     """One thread of a managed guest: its IPC channel + scheduling state.
 
@@ -521,6 +679,31 @@ class ManagedProcess(ProcessLifecycle):
         self._ring_offered: set[int] = set()
         gen = host.controller.cfg.general
         self._syscall_latency = 1000 if gen.model_unblocked_syscall_latency else 0
+        #: master gate for every worker-GRANTED shim fast path (socket
+        #: rings, in-shim poll, raw-time service via the clock-page flags
+        #: word); identity + libc-time interposition are shim-intrinsic
+        #: and stay on in every mode. Off under strace (which must see
+        #: every call), modeled syscall latency (each call must cost sim
+        #: time), or the SHADOW_TPU_SHIM_FASTPATH=0 escape hatch.
+        self._fast_plane = (
+            _FASTPATH_ON and self._syscall_latency == 0
+            and host.controller.cfg.experimental.strace_logging_mode == "off")
+        #: ESTABLISHED-socket ring pairs by guest fd (page-owner process
+        #: only: vfd numbering is per-process, so a fork child's fds
+        #: could collide with the parent's — children service sockets on
+        #: the slow path and the shim drops SOCK rings at fork)
+        self._sock_rings: dict[int, SockRing] = {}
+        #: oplog replay map: (vfd - VFD_BASE) -> VSocket (owner process)
+        self._oplog_vs: dict[int, VSocket] = {}
+        #: vfds a worker-serviced poll referenced; their readiness bytes
+        #: are published on the clock page at every reply (non-ring fds
+        #: only — ring-backed readiness is computed in-shim, live)
+        self._ready_watch: set[int] = set()
+        #: per-syscall-number worker round-trip census for the bench
+        #: audit table (controller-scoped, NOT host.counters: it must
+        #: stay out of determinism fingerprints)
+        self._slow_nrs = host.controller.__dict__.setdefault(
+            "_shim_slow_nrs", {})
         #: guest watchdog (experimental.guest_turn_timeout): wall seconds a
         #: turn may last without a syscall before the guest is killed and
         #: the host downed (spin-wait livelock containment; 0 = off)
@@ -609,6 +792,12 @@ class ManagedProcess(ProcessLifecycle):
         self._time_map = mmap.mmap(tf.fileno(), 4096)
         tf.close()
         self._time_map[8:16] = struct.pack("<q", self.vpid)
+        if self._fast_plane:
+            # arm the shim's worker-granted fast paths (raw time, local
+            # poll, socket rings); zero = forward everything (strace /
+            # modeled latency / SHADOW_TPU_SHIM_FASTPATH=0)
+            struct.pack_into("<q", self._time_map, 8 * SHIM_PAGE_FLAGS,
+                             SHIM_PAGE_F_FAST)
         if old is not None and self.parent_proc is None:
             # repeated execs: release the superseded mapping (fork-child
             # records borrow the parent's map — never close that one)
@@ -856,6 +1045,8 @@ class ManagedProcess(ProcessLifecycle):
         self.threads = {0: GuestThread(0, parent)}
         main = self.threads[0]
         self._ring_offered.clear()  # the replacement shim starts unmapped
+        self._sock_rings.clear()  # re-offered on first use (same rings)
+        self._ready_watch.clear()  # fresh page: readiness region is zero
         self.host.counters.add("execs", 1)
         if self._strace is not None:
             self._strace.write(f"+++ execve {real} +++\n")
@@ -892,7 +1083,68 @@ class ManagedProcess(ProcessLifecycle):
 
     def _reply(self, th: GuestThread, ret: int) -> None:
         self._time_map[:8] = struct.pack("<q", emulated(self.host.now))
+        if self._fast_plane and self.parent_proc is None:
+            self._refresh_fast_state()
         th.sock.sendall(struct.pack("<q", ret))
+
+    def _refresh_fast_state(self) -> None:
+        """Re-arm the shim's local world view before handing the turn
+        back: per-connection TX budgets + HUP/ERR flags, and readiness
+        bytes for watched non-ring vfds. Exact for the whole guest turn
+        because transport state is frozen while the guest runs (strict
+        turn-taking); every worker-serviced op ends here, so the view is
+        refreshed before the guest can consult it again."""
+        for fd, sr in self._sock_rings.items():
+            if sr.dead:
+                continue
+            vs = self.fds.get(fd)
+            if vs is None or vs.endpoint is None:
+                continue
+            snd = vs.endpoint.sender
+            sr.set_wbudget(max(0, snd.send_buffer - snd.buffered))
+            sr.sync_flags(vs)
+        if self._ready_watch:
+            tm = self._time_map
+            for fd in self._ready_watch:
+                vs = self.fds.get(fd)
+                idx = fd - VFD_BASE
+                if vs is None or not self._ready_byte_ok(vs):
+                    tm[SHIM_READY_OFF + idx] = 0  # shim must forward
+                    continue
+                b = SHIM_READY_VALID
+                if self._readable(vs):
+                    b |= SHIM_READY_IN
+                if self._writable(vs):
+                    b |= SHIM_READY_OUT
+                if vs.peer_closed:
+                    b |= SHIM_READY_HUP
+                if vs.connect_err:
+                    b |= SHIM_READY_ERR
+                tm[SHIM_READY_OFF + idx] = b
+
+    def _drop_fast_fd(self, fd: int) -> None:
+        """This fd number is going away (close / dup-over): forget its
+        fast-plane state. The VSocket's SockRing itself survives while
+        other references (dup aliases) remain; _close_vs retires it when
+        the LAST reference goes."""
+        self._sock_rings.pop(fd, None)
+        self._ready_watch.discard(fd)
+        if (self.parent_proc is None and self._time_map is not None
+                and 0 <= fd - VFD_BASE < SHIM_READY_LEN):
+            self._time_map[SHIM_READY_OFF + (fd - VFD_BASE)] = 0
+
+    @staticmethod
+    def _ready_byte_ok(vs: VSocket) -> bool:
+        """Publish a page readiness byte only for fds with NO ring-
+        capable backing: the shim's own local ring ops would make a
+        published byte stale mid-turn, so ring-backed fds are evaluated
+        from live ring state in-shim instead (shim_poll_local)."""
+        if vs.sockring is not None:
+            return False
+        for pb in (vs.pipe, vs.pipe_out):
+            if isinstance(pb, RingPipeBuf):
+                return False
+        return True
 
     def _maybe_offer_ring(self, fd: int, vs: VSocket, role: int, ret):
         """First read/write on a ring-pipe end from this process image:
@@ -922,6 +1174,52 @@ class ManagedProcess(ProcessLifecycle):
             return ret  # channel died; the pump notices on its next read
         return _REPLIED
 
+    def _maybe_offer_sock(self, fd: int, ret):
+        """First serviced read/write/recv/send on an ESTABLISHED stream
+        from the page-owner image: publish the per-connection RX/TX ring
+        pair over the service reply (two MAPRING offers + the real
+        result — the same wire mechanism as pipe rings), so subsequent
+        ready-data ops on this fd complete in-shim. Socket rings are
+        MIRRORS of transport state; see SockRing."""
+        if (not self._fast_plane or self.parent_proc is not None
+                or not isinstance(ret, int)
+                or fd < VFD_BASE or fd - VFD_BASE >= (1 << 24)
+                or (fd, 0) in self._ring_offered):
+            return ret
+        vs = self.fds.get(fd)
+        if (vs is None or vs.kind != "stream" or vs.endpoint is None
+                or not vs.connected or vs.peer_closed or vs.connect_err
+                or vs.listening):
+            return ret
+        sr = vs.sockring
+        if sr is None:
+            ep = vs.endpoint
+            cap = _next_pow2(max(ep.receiver.recv_buffer,
+                                 ep.sender.send_buffer, SHRING_CAP_MIN))
+            if cap > SHRING_CAP_MAX:
+                return ret
+            sr = vs.sockring = SockRing(cap)
+            if vs.rxbuf:  # mirror invariant holds from birth
+                sr.rx_append(bytes(vs.rxbuf))
+        if sr.dead:
+            return ret
+        self._ring_offered.add((fd, 0))
+        self._ring_offered.add((fd, 1))
+        # register BEFORE the reply: _reply's refresh must arm wbudget
+        self._sock_rings[fd] = sr
+        self._oplog_vs[fd - VFD_BASE] = vs
+        th = self._cur
+        try:
+            for role, memfd in ((0, sr.rx_fd), (1, sr.tx_fd)):
+                th.sock.sendall(struct.pack("<q", MAPRING))
+                th.sock.sendmsg([struct.pack("<q", role)],
+                                [(socket.SOL_SOCKET, socket.SCM_RIGHTS,
+                                  struct.pack("<i", memfd))])
+            self._reply(th, ret)
+        except OSError:
+            return ret  # channel died; the pump notices on its next read
+        return _REPLIED
+
     def _fold_fast_ops(self) -> None:
         """Fold shim-local ring ops into the syscall counters and wake
         anything parked on a ring the guest touched. The op counter lives
@@ -931,18 +1229,76 @@ class ManagedProcess(ProcessLifecycle):
         turn-taking). Called on every received request: any shim-local
         activity strictly precedes the guest's next trap."""
         tm = self._time_map
+        # socket oplog FIRST: replaying the shim's in-shim recv/send
+        # sequence against the simulated transport — in arrival order,
+        # before the trapped syscall is serviced — reproduces the slow
+        # path's exact event schedule (window-update acks, drain wakes)
+        nlog = struct.unpack_from("<q", tm, 8 * SHIM_PAGE_OPLOG_N)[0]
+        if nlog:
+            self._replay_oplog(tm, nlog)
+            struct.pack_into("<q", tm, 8 * SHIM_PAGE_OPLOG_N, 0)
         ops, cur = struct.unpack_from("<qq", tm, 16)
         if ops == cur:
             return
         struct.pack_into("<q", tm, 24, ops)
         d = ops - cur
-        self.host.counters.add("syscalls", d)
-        self.host.counters.add("shim_fast_syscalls", d)
+        c = self.host.counters
+        c.add("syscalls", d)
+        c.add("shim_fast_syscalls", d)
+        # per-class census (informational: host.state_fingerprint filters
+        # the shim_fast_ prefix, so these never gate determinism)
+        for word, name in _SHIM_CLASS_COUNTERS:
+            k = struct.unpack_from("<q", tm, 8 * word)[0]
+            if k:
+                struct.pack_into("<q", tm, 8 * word, 0)
+                c.add(name, k)
         reg = self.host.controller.__dict__.get("_ring_registry")
         if reg:
             for pb in [p for p in reg if p.dirty()]:
                 pb.clear_dirty()
                 pb.wake()
+
+    def _replay_oplog(self, tm, nlog: int) -> None:
+        """Apply the shim's logged in-shim socket ops to the simulated
+        transport, in order. Socket rings belong to the page OWNER (the
+        root of a fork chain) — a child's trap folds the shared page, so
+        replay resolves vfds through the owner's map."""
+        owner = self
+        while owner.parent_proc is not None:
+            owner = owner.parent_proc
+        for i in range(min(nlog, SHIM_OPLOG_MAX)):
+            word = struct.unpack_from("<Q", tm, SHIM_OPLOG_OFF + 8 * i)[0]
+            nbytes = word & 0xFFFFFFFF
+            op = word >> 56
+            idx = (word >> 32) & 0xFFFFFF
+            vs = owner._oplog_vs.get(idx)
+            sr = None if vs is None else vs.sockring
+            if vs is None or sr is None:
+                continue  # ring retired with ops in flight: cannot happen
+                # mid-turn (close traps AFTER the fold); tolerated anyway
+            if op == SHIM_OP_RECV:
+                # the shim consumed nbytes from the RX ring (rpos already
+                # advanced); drop the same prefix from the authoritative
+                # buffer and let the receiver ack the window update
+                del vs.rxbuf[:nbytes]
+                owner._rx_consumed(vs)
+            elif op == SHIM_OP_SEND:
+                data = sr.tx_take(nbytes)
+                accepted = 0
+                if vs.endpoint is not None:
+                    accepted = vs.endpoint.send(payload=bytes(data))
+                if accepted != nbytes:
+                    # the wbudget contract (send always accepts in full)
+                    # broke — fail LOUDLY and fall back to the slow path
+                    # forever on this connection
+                    import sys as _sys
+
+                    print(
+                        f"shadow_tpu: {self.host.name}/{self.name} socket"
+                        f" ring replay short ({accepted}/{nbytes} vfd"
+                        f" {VFD_BASE + idx}) — wbudget contract violated;"
+                        f" ring disabled", file=_sys.stderr)
+                    sr.kill()
 
     def _pump(self, th: GuestThread) -> None:
         """Service one thread's syscalls until it blocks in sim time, yields
@@ -966,6 +1322,9 @@ class ManagedProcess(ProcessLifecycle):
                 return
             self._fold_fast_ops()
             nr, args = req
+            # worker round-trip census by syscall number (bench audit
+            # table; controller-scoped so fingerprints never see it)
+            self._slow_nrs[nr] = self._slow_nrs.get(nr, 0) + 1
             try:
                 ret = self._service(nr, args)
             except OSError:
@@ -1133,6 +1492,11 @@ class ManagedProcess(ProcessLifecycle):
             return
         if vs.listening:
             self.host.unlisten(vs.bound_port)
+        if vs.sockring is not None:
+            # every fd-table reference closed -> every shim mapping was
+            # dropped before its close trap forwarded; safe to unmap
+            vs.sockring.retire()
+            vs.sockring = None
         if vs.endpoint is not None:
             vs.endpoint.close()
         if vs.pipe is not None:
@@ -1819,7 +2183,8 @@ class ManagedProcess(ProcessLifecycle):
         serves non-blocking ops locally, zero worker round trips) when
         eligible; plain worker-side buffers under strace / modeled
         syscall latency, which must see every call."""
-        if self._strace is None and self._syscall_latency == 0:
+        if (_FASTPATH_ON and self._strace is None
+                and self._syscall_latency == 0):
             reg = self.host.controller.__dict__.setdefault(
                 "_ring_registry", {})
             return [RingPipeBuf(reg) for _ in range(n)]
@@ -1890,6 +2255,7 @@ class ManagedProcess(ProcessLifecycle):
                 self._close_vs(old)
             self._ring_offered.discard((newfd, 0))  # rebound fd number
             self._ring_offered.discard((newfd, 1))
+            self._drop_fast_fd(newfd)
         vs.refs += 1
         self.fds[newfd] = vs
         self.fd_cloexec.discard(newfd)  # dup/dup2 clear FD_CLOEXEC
@@ -2218,7 +2584,7 @@ class ManagedProcess(ProcessLifecycle):
                 return -EBADF  # write on the read end
             if vs is not None and vs.kind in ("file", "dir"):
                 return self.vfs.write(vs, self.mem.read(addr, min(n, 1 << 20)))
-            return self._vfd_send(fd, addr, n)
+            return self._maybe_offer_sock(fd, self._vfd_send(fd, addr, n))
         if nr == SYS_read:
             if args[0] == 0 and 0 not in self.fds:
                 return 0  # stdin: EOF (unless a vfd was dup2'd onto it)
@@ -2240,7 +2606,8 @@ class ManagedProcess(ProcessLifecycle):
                 return self._maybe_offer_ring(args[0], vs, 0, ret)
             if vs is not None and vs.kind == "pipe_w":
                 return -EBADF  # read on the write end
-            return self._vfd_recv(args[0], args[1], args[2])
+            return self._maybe_offer_sock(
+                args[0], self._vfd_recv(args[0], args[1], args[2]))
         if nr == SYS_close:
             if IPC_LOW <= args[0] <= SHIM_IPC_FD:
                 # a guest sweeping "all fds" (subprocess close_fds) must
@@ -2252,6 +2619,7 @@ class ManagedProcess(ProcessLifecycle):
             self.fd_cloexec.discard(args[0])
             self._ring_offered.discard((args[0], 0))  # fd may be reused
             self._ring_offered.discard((args[0], 1))
+            self._drop_fast_fd(args[0])
             self._close_vs(vs)
             return 0
         if nr == SYS_clock_gettime:
@@ -2313,14 +2681,15 @@ class ManagedProcess(ProcessLifecycle):
             vs = self.fds.get(args[0])
             if vs is not None and vs.kind == "dgram":
                 return self._dgram_sendto(vs, args)
-            return self._vfd_send(args[0], args[1], args[2])
+            return self._maybe_offer_sock(
+                args[0], self._vfd_send(args[0], args[1], args[2]))
         if nr == SYS_recvfrom:
             vs = self.fds.get(args[0])
             if vs is not None and vs.kind == "dgram":
                 return self._dgram_recvfrom(vs, args,
                                             peek=bool(args[3] & 2))
-            return self._vfd_recv(args[0], args[1], args[2],
-                                  peek=bool(args[3] & 2))  # MSG_PEEK
+            return self._maybe_offer_sock(args[0], self._vfd_recv(
+                args[0], args[1], args[2], peek=bool(args[3] & 2)))
         if nr == SYS_shutdown:
             vs = self.fds.get(args[0])
             if vs is None:
@@ -2337,6 +2706,10 @@ class ManagedProcess(ProcessLifecycle):
                     pb.wake()
                 return 0
             if vs.endpoint is not None:
+                if vs.sockring is not None:
+                    # full close of the connection: every alias mapping
+                    # (the shim only dropped THIS fd's) must stop serving
+                    vs.sockring.kill()
                 vs.endpoint.close()
             return 0
         if nr in (SYS_setsockopt,):
@@ -3043,7 +3416,17 @@ class ManagedProcess(ProcessLifecycle):
         self._notify()
 
     def _on_net_data(self, vs: VSocket, n: int, payload) -> None:
-        vs.rxbuf += payload if payload is not None else b"\0" * n
+        data = payload if payload is not None else b"\0" * n
+        vs.rxbuf += data
+        sr = vs.sockring
+        if sr is not None and not sr.dead:
+            if len(data) <= sr.rx_room():
+                sr.rx_append(data)  # mirror: RX unread == len(rxbuf)
+            else:
+                # mirror overflow (rxbuf grew past the ring's slack over
+                # recv_buffer): permanent slow path; rxbuf stays
+                # authoritative, so nothing is lost
+                sr.kill()
         # wake every satisfiable waiter: a fulfilled MSG_PEEK leaves the
         # data in place, so another thread's recv may also be servable
         while vs.rxbuf:
@@ -3060,6 +3443,10 @@ class ManagedProcess(ProcessLifecycle):
 
     def _on_net_close(self, vs: VSocket) -> None:
         vs.peer_closed = True
+        if vs.sockring is not None:
+            # HUP now: the shim serves EOF-once-drained locally and
+            # forwards writes (the worker twin returns -EPIPE)
+            vs.sockring.sync_flags(vs)
         woke = False
         while not vs.rxbuf:  # terminal event: EVERY reader gets EOF
             th, _ = self._find_waiter((("recv", "rmsg"), vs))
@@ -3072,6 +3459,11 @@ class ManagedProcess(ProcessLifecycle):
 
     def _on_net_error(self, vs: VSocket) -> None:
         vs.connect_err = ETIMEDOUT if not vs.connected else ECONNRESET
+        if vs.sockring is not None:
+            # error delivery ordering is worker business: flag + kill so
+            # the shim forwards everything on this connection from now on
+            vs.sockring.sync_flags(vs)
+            vs.sockring.kill()
         woke = False
         while True:  # terminal event: EVERY waiter on this socket errors
             th, w = self._find_waiter((("connect",), vs))
@@ -3144,6 +3536,9 @@ class ManagedProcess(ProcessLifecycle):
         self.mem.write(bufaddr, bytes(vs.rxbuf[:k]))
         if consume:
             del vs.rxbuf[:k]
+            sr = vs.sockring
+            if sr is not None and not sr.dead and k:
+                sr.rx_advance(k)  # keep the mirror invariant
             self._rx_consumed(vs)
         return k
 
@@ -3242,6 +3637,12 @@ class ManagedProcess(ProcessLifecycle):
             fd = struct.unpack_from("<i", raw, 8 * i)[0]
             want = struct.unpack_from("<h", raw, 8 * i + 4)[0]
             entries.append((fd, want))
+        if self._fast_plane and self.parent_proc is None:
+            # this poll reached the worker: publish readiness bytes for
+            # its fds from the next reply on, so repeats complete in-shim
+            for fd, _w in entries:
+                if 0 <= fd - VFD_BASE < SHIM_READY_LEN:
+                    self._ready_watch.add(fd)
         n = self._poll_scan(entries, fds_ptr)
         if n:
             return n
@@ -3338,6 +3739,9 @@ class ManagedProcess(ProcessLifecycle):
         self._scatter(iovs, bytes(vs.rxbuf[:k]))
         if consume:
             del vs.rxbuf[:k]
+            sr = vs.sockring
+            if sr is not None and not sr.dead and k:
+                sr.rx_advance(k)  # keep the mirror invariant
             self._rx_consumed(vs)
         return k
 

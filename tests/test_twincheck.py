@@ -30,11 +30,16 @@ COLCORE = REPO / "native" / "colcore" / "colcore.c"
 @pytest.fixture()
 def tree(tmp_path):
     """A minimal copy of the audited surfaces: shadow_tpu/ (sans caches),
-    colcore.c, MIGRATION.md."""
+    colcore.c, shring.h + shim.c, MIGRATION.md."""
     shutil.copytree(REPO / "shadow_tpu", tmp_path / "shadow_tpu",
                     ignore=shutil.ignore_patterns("__pycache__", "*.so"))
     (tmp_path / "native" / "colcore").mkdir(parents=True)
     shutil.copy(COLCORE, tmp_path / "native" / "colcore" / "colcore.c")
+    (tmp_path / "native" / "shim").mkdir(parents=True)
+    shutil.copy(REPO / "native" / "shring.h",
+                tmp_path / "native" / "shring.h")
+    shutil.copy(REPO / "native" / "shim" / "shim.c",
+                tmp_path / "native" / "shim" / "shim.c")
     shutil.copy(REPO / "MIGRATION.md", tmp_path / "MIGRATION.md")
     return tmp_path
 
@@ -201,6 +206,55 @@ def test_cc_registry_drift_is_caught(tree):
            'CONGESTION_CONTROL_NAMES = ("newreno", "cubic")',
            'CONGESTION_CONTROL_NAMES = ("newreno", "cubic", "bbr")')
     assert "cc-enum" in rules(twin_audit.audit(tree))
+
+
+# -- the shim fast-plane ABI (fourth surface, PR 13) --------------------------
+
+def test_shim_page_word_drift_c_side_is_caught(tree):
+    # shim would fold in-shim ring reads from the wrong clock-page word
+    mutate(tree, "native/shring.h",
+           "#define SHIM_PAGE_CLS_RING_R 7",
+           "#define SHIM_PAGE_CLS_RING_R 12")
+    assert "shim-abi-drift:SHIM_PAGE_CLS_RING_R" in rules(
+        twin_audit.audit(tree))
+
+
+def test_shim_ready_off_drift_python_side_is_caught(tree):
+    # worker would publish readiness bytes where the shim doesn't look
+    mutate(tree, "shadow_tpu/native/managed.py",
+           "SHIM_READY_OFF = 256", "SHIM_READY_OFF = 264")
+    assert "shim-abi-drift:SHIM_READY_OFF" in rules(twin_audit.audit(tree))
+
+
+def test_shim_vfd_base_drift_is_caught(tree):
+    # the hex-literal sentinel that separates simulated fds from real ones
+    mutate(tree, "native/shim/shim.c",
+           "#define SHIM_VFD_BASE 0x100000",
+           "#define SHIM_VFD_BASE 0x200000")
+    assert "shim-abi-drift:VFD_BASE" in rules(twin_audit.audit(tree))
+
+
+def test_shim_ring_magic_drift_is_caught(tree):
+    mutate(tree, "native/shring.h",
+           "#define SHRING_MAGIC 0x53524E47u",
+           "#define SHRING_MAGIC 0x53524E48u")
+    assert "shim-abi-drift:SHRING_MAGIC" in rules(twin_audit.audit(tree))
+
+
+def test_shim_epoch_drift_is_caught(tree):
+    # realtime family would disagree with core/time.EMULATED_EPOCH
+    mutate(tree, "native/shim/shim.c",
+           "#define SHIM_EMULATED_EPOCH_NS 946684800000000000LL",
+           "#define SHIM_EMULATED_EPOCH_NS 946684800000000001LL")
+    assert "shim-abi-drift:EMULATED_EPOCH" in rules(twin_audit.audit(tree))
+
+
+def test_shim_wbudget_offset_drift_is_caught(tree):
+    # worker would arm the tx write budget at the wrong struct offset
+    mutate(tree, "shadow_tpu/native/managed.py",
+           "SHRING_OFF_WBUDGET = 56", "SHRING_OFF_WBUDGET = 48")
+    assert "shim-abi-drift:SHRING_OFF_WBUDGET" in rules(
+        twin_audit.audit(tree))
 
 
 # -- determinism-lint mutations -----------------------------------------------

@@ -78,17 +78,21 @@ _INT_TOKEN = re.compile(r"^[0-9]+$")
 
 
 def eval_cexpr(expr: str, env: dict):
-    """Evaluate a small constant C expression: integer literals with
-    L/LL/U suffixes, parentheses, + - * / << >>, and names resolvable in
-    ``env``.  Returns None when the expression uses anything else."""
-    toks = re.findall(r"[A-Za-z_]\w*|\d+|<<|>>|[()+\-*/]", expr)
+    """Evaluate a small constant C expression: integer literals (decimal
+    or hex) with L/LL/U suffixes, parentheses, + - * / << >>, and names
+    resolvable in ``env``.  Returns None when the expression uses
+    anything else."""
+    toks = re.findall(
+        r"0[xX][0-9a-fA-F]+[uUlL]*|[A-Za-z_]\w*|\d+|<<|>>|[()+\-*/]", expr)
     if "".join(toks) != re.sub(r"\s+", "", expr):
         # token stream lost characters -> unsupported syntax (bit-ops,
         # casts, ternaries): refuse rather than mis-evaluate
         return None
     py = []
     for t in toks:
-        if _INT_TOKEN.match(t):
+        if re.match(r"^0[xX][0-9a-fA-F]+[uUlL]*$", t):
+            py.append("%d" % int(re.sub(r"[uUlL]+$", "", t), 16))
+        elif _INT_TOKEN.match(t):
             py.append(t)
         elif re.match(r"^\d+(?:[uUlL]+)$", t):
             py.append(re.sub(r"[uUlL]+$", "", t))

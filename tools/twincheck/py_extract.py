@@ -65,11 +65,18 @@ def module_constants(tree: ast.Module, env: dict = None) -> dict:
     constants may reference earlier ones)."""
     out = dict(env or {})
     for node in tree.body:
+        target = None
         if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                 and isinstance(node.targets[0], ast.Name):
+            target = node.targets[0].id
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.value is not None:
+            target = node.target.id  # NAME: SomeType = <int expr>
+        if target is not None:
             v = _eval_const(node.value, out)
             if v is not None:
-                out[node.targets[0].id] = v
+                out[target] = v
     return out
 
 

@@ -29,6 +29,12 @@ tests/test_twincheck.py's mutation fixtures):
                            columnar third surface, PR 11)
   kernel-cc-drift:<hook>   congestion-control literal drift between the
                            scalar on_ack twins and the batched kernel
+  shim-abi-drift:<NAME>    a shim fast-plane ABI constant (ring header
+                           layout, clock-page words, readiness/oplog
+                           regions, protocol sentinels) differs between
+                           native/shring.h + native/shim/shim.c and the
+                           worker twin (shadow_tpu/native/managed.py,
+                           vfs.py, core/time.py) — PR 13
   extract:<what>           an audit anchor disappeared (refactor moved a
                            contract surface: update the auditor WITH it)
 """
@@ -75,6 +81,59 @@ TOR_CELL_PAIRS = [
     ("CREATE", "TC_CREATE"), ("CREATED", "TC_CREATED"),
     ("EXTEND", "TC_EXTEND"), ("EXTENDED", "TC_EXTENDED"),
     ("BEGIN", "TC_BEGIN"), ("DATA", "TC_DATA"), ("END", "TC_END"),
+]
+
+#: shim fast-plane ABI: (python module key, python name, C define).
+#: The C side is native/shring.h plus shim.c's own protocol defines;
+#: the Python side is the worker twin that packs/reads the same shared
+#: pages.  Any drift here silently corrupts the in-shim fast path (the
+#: shim and worker would disagree about where a counter or ring field
+#: lives), so every mirrored constant is audited by name.
+SHIM_ABI_PAIRS = [
+    # clock-page u64 word indices + flag bit (shim increments, worker folds)
+    ("managed", "SHIM_PAGE_FLAGS", "SHIM_PAGE_FLAGS"),
+    ("managed", "SHIM_PAGE_CLS_TIME", "SHIM_PAGE_CLS_TIME"),
+    ("managed", "SHIM_PAGE_CLS_IDENT", "SHIM_PAGE_CLS_IDENT"),
+    ("managed", "SHIM_PAGE_CLS_RING_R", "SHIM_PAGE_CLS_RING_R"),
+    ("managed", "SHIM_PAGE_CLS_RING_W", "SHIM_PAGE_CLS_RING_W"),
+    ("managed", "SHIM_PAGE_CLS_READY", "SHIM_PAGE_CLS_READY"),
+    ("managed", "SHIM_PAGE_OPLOG_N", "SHIM_PAGE_OPLOG_N"),
+    ("managed", "SHIM_PAGE_F_FAST", "SHIM_PAGE_F_FAST"),
+    # per-vfd readiness bytes (worker publishes, shim's poll consumes)
+    ("managed", "SHIM_READY_OFF", "SHIM_READY_OFF"),
+    ("managed", "SHIM_READY_LEN", "SHIM_READY_LEN"),
+    ("managed", "SHIM_READY_VALID", "SHIM_READY_VALID"),
+    ("managed", "SHIM_READY_IN", "SHIM_READY_IN"),
+    ("managed", "SHIM_READY_OUT", "SHIM_READY_OUT"),
+    ("managed", "SHIM_READY_HUP", "SHIM_READY_HUP"),
+    ("managed", "SHIM_READY_ERR", "SHIM_READY_ERR"),
+    # socket-op log (shim appends, worker replays at the round fold)
+    ("managed", "SHIM_OPLOG_OFF", "SHIM_OPLOG_OFF"),
+    ("managed", "SHIM_OPLOG_MAX", "SHIM_OPLOG_MAX"),
+    ("managed", "SHIM_OP_RECV", "SHIM_OP_RECV"),
+    ("managed", "SHIM_OP_SEND", "SHIM_OP_SEND"),
+    # struct shring socket extensions (flags word + tx write budget)
+    ("managed", "SHRING_OFF_FLAGS", "SHRING_OFF_FLAGS"),
+    ("managed", "SHRING_OFF_WBUDGET", "SHRING_OFF_WBUDGET"),
+    ("managed", "SHRING_F_HUP", "SHRING_F_HUP"),
+    ("managed", "SHRING_F_ERR", "SHRING_F_ERR"),
+    ("managed", "SHRING_F_SOCK", "SHRING_F_SOCK"),
+    ("managed", "SHRING_CAP_MIN", "SHRING_CAP_MIN"),
+    ("managed", "SHRING_CAP_MAX", "SHRING_CAP_MAX"),
+    # wire protocol sentinels (different spellings across the twins)
+    ("managed", "SHIM_IPC_FD", "SHIM_IPC_FD"),
+    ("managed", "VFD_BASE", "SHIM_VFD_BASE"),
+    ("managed", "MAPRING", "SHIM_RET_MAPRING"),
+    ("vfs", "RETRY_NATIVE", "SHIM_RET_NATIVE"),
+    ("time", "EMULATED_EPOCH", "SHIM_EMULATED_EPOCH_NS"),
+]
+
+#: mmap'd ring layout twins carried as class attributes on the worker
+#: side: (python class, attr, C define)
+SHIM_RING_ATTR_PAIRS = [
+    ("RingPipeBuf", "HDR", "SHRING_HDR"),
+    ("RingPipeBuf", "MAGIC", "SHRING_MAGIC"),
+    ("PipeBuf", "CAP", "SHRING_CAP"),
 ]
 
 #: CEp struct fields deliberately NOT in _export_state — rebuild-time
@@ -373,5 +432,57 @@ def audit(root) -> list:
              "reference per call and its NULL return is typically "
              "unchecked — pre-intern in PyInit (INTERN table): %s" % text,
              line)
+
+    # 11. shim fast-plane ABI ----------------------------------------------
+    # native/shring.h + shim.c define the shared-page layout the guest
+    # shim writes; shadow_tpu/native/managed.py mirrors every offset,
+    # word index and flag bit to read/arm the same pages.  Disagreement
+    # is silent corruption (a counter folded from the wrong word, a
+    # budget armed at the wrong offset), so the mirror is audited by
+    # name.
+    shring_path = root / "native" / "shring.h"
+    shim_path = root / "native" / "shim" / "shim.c"
+    managed_path = root / "shadow_tpu" / "native" / "managed.py"
+    shimdef = managed_tree = None
+    try:
+        shimdef = C.resolve_defines(shring_path.read_text())
+        shimdef.update(C.resolve_defines(shim_path.read_text()))
+    except OSError as e:
+        fail("extract:shim-abi", shring_path, str(e))
+    try:
+        managed_tree = P.parse(managed_path)
+        envs["managed"] = P.module_constants(managed_tree)
+        envs["vfs"] = P.module_constants(
+            P.parse(root / "shadow_tpu" / "native" / "vfs.py"))
+    except (OSError, P.ExtractError, SyntaxError) as e:
+        fail("extract:shim-abi", managed_path, str(e))
+    if shimdef is not None and managed_tree is not None:
+        for mod, pyname, cname in SHIM_ABI_PAIRS:
+            pv = envs[mod].get(pyname)
+            cv = shimdef.get(cname)
+            if pv is None:
+                fail("shim-abi-drift:%s" % pyname, managed_path,
+                     "shim ABI constant %s not found on the Python side "
+                     "(module %r)" % (pyname, mod))
+            elif cv is None:
+                fail("shim-abi-drift:%s" % pyname, shring_path,
+                     "shim ABI constant %s has no C define %s in "
+                     "shring.h/shim.c" % (pyname, cname))
+            elif pv != cv:
+                fail("shim-abi-drift:%s" % pyname, shring_path,
+                     "shim ABI drift: Python %s=%d but C %s=%d — shim "
+                     "and worker would disagree about the shared-page "
+                     "layout" % (pyname, pv, cname, cv))
+        for clsname, attr, cname in SHIM_RING_ATTR_PAIRS:
+            cv = shimdef.get(cname)
+            try:
+                pv = P.class_attr(P.class_def(managed_tree, clsname), attr)
+            except P.ExtractError as e:
+                fail("extract:shim-abi", managed_path, str(e))
+                continue
+            if cv is None or pv != cv:
+                fail("shim-abi-drift:%s" % cname, shring_path,
+                     "ring layout drift: %s.%s=%r but C %s=%r" %
+                     (clsname, attr, pv, cname, cv))
 
     return findings
