@@ -1793,6 +1793,45 @@ def main() -> None:
         f"{to['wall_delta_pct_noisy']}%; {to['samples']} samples, "
         f"{to['flows_recorded']} flows)")
 
+    # live endpoint overhead on the headline config (live-ops PR
+    # acceptance: an attached follower must be ~free — the endpoint is a
+    # wall-clock plane with drop-oldest queues, so a slow reader sheds
+    # records instead of stalling rounds). Same convention as the
+    # telemetry row: published on every run, loud when it regresses.
+    import threading as _threading
+
+    live_sock = "/tmp/shadow-bench-live.sock"
+    live_drained = [0]
+
+    def _live_drain():
+        from shadow_tpu import live as _live_mod
+        try:
+            for _ in _live_mod.stream_records(live_sock, timeout=60):
+                live_drained[0] += 1
+        except OSError:
+            pass
+
+    _live_reader = _threading.Thread(target=_live_drain, daemon=True)
+    _live_reader.start()
+    liver = run_config(args.config, "tpu_batch", "tpu-live",
+                       {"general.live_endpoint": live_sock,
+                        "general.heartbeat_interval": "2s"})
+    _live_reader.join(timeout=10)
+    live_rel = liver["wall_seconds"] / tpu["wall_seconds"] - 1
+    detail["tgen_1k"]["live_overhead"] = {
+        "live_overhead_rel": round(live_rel, 4),
+        "wall_seconds_with_live": round(liver["wall_seconds"], 3),
+        "wall_seconds_median_without": round(tpu["wall_seconds"], 3),
+        "records_streamed": live_drained[0],
+    }
+    if live_rel > 0.05:
+        log(f"WARNING tgen_1k: live endpoint overhead {live_rel:.1%} > 5% "
+            f"— the wall-clock plane is leaking into the round loop "
+            f"(an attached follower should be ~free under drop-oldest)")
+    log(f"live endpoint overhead on tgen_1k: {live_rel:+.1%} wall vs "
+        f"detached median ({live_drained[0]} records streamed to an "
+        f"attached follower)")
+
     # results must be identical across policies — a benchmark that diverged
     # would be measuring two different simulations
     for k in ("events", "units_sent", "units_dropped", "bytes_sent"):

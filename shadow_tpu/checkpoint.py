@@ -99,6 +99,13 @@ VOLATILE_CONFIG_KEYS = (
     ("general", "progress"),
     ("general", "heartbeat_interval"),
     ("general", "log_level"),
+    # the live-operations plane (shadow_tpu/live.py) is pure wall-clock:
+    # the endpoint streams records and accepts commands, but commands only
+    # touch sim state via the recorded commands.jsonl, which replays via
+    # replay_commands — so both keys are run-location policy, not
+    # simulation semantics
+    ("general", "live_endpoint"),
+    ("general", "replay_commands"),
     ("experimental", "native_colcore"),
     # the columnar transport engine is the same kind of toggle: every
     # path is bit-identical (tests/test_devtransport.py), engagement is

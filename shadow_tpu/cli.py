@@ -76,6 +76,20 @@ def build_parser() -> argparse.ArgumentParser:
         "tools/bisect_divergence.py",
     )
     p.add_argument(
+        "--live-endpoint", metavar="PATH",
+        help="bind an AF_UNIX live-operations endpoint "
+        "(general.live_endpoint): stream heartbeats/metrics/flow "
+        "snapshots and accept runtime fault commands, applied at the "
+        "next round boundary and logged to commands.jsonl; 'auto' = "
+        "<data-directory>/live.sock",
+    )
+    p.add_argument(
+        "--replay-commands", metavar="FILE",
+        help="replay a recorded commands.jsonl (general.replay_commands): "
+        "re-applies each command at its original round boundary, "
+        "reproducing an interactively driven run byte-identically",
+    )
+    p.add_argument(
         "--set",
         action="append",
         default=[],
@@ -110,6 +124,8 @@ def overrides_from_args(args: argparse.Namespace) -> dict:
         "state_digest_every": "general.state_digest_every",
         "sample_every": "telemetry.sample_every",
         "metrics_dir": "telemetry.metrics_dir",
+        "live_endpoint": "general.live_endpoint",
+        "replay_commands": "general.replay_commands",
     }
     for attr, key in flag_map.items():
         val = getattr(args, attr)

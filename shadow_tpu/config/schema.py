@@ -102,6 +102,16 @@ class GeneralOptions:
     #: byte-identical at ANY shard count (tests/test_shards.py); 1 = the
     #: single-process controller, unchanged.
     sim_shards: int = 1
+    #: live operations plane (shadow_tpu/live.py): bind an AF_UNIX live
+    #: endpoint streaming heartbeats/metrics/flow snapshots and accepting
+    #: runtime fault commands. "auto" = <data_directory>/live.sock.
+    #: Volatile: a pure wall-clock plane with zero effect on results
+    #: (commands act only via the recorded commands.jsonl).
+    live_endpoint: Optional[str] = None
+    #: replay a recorded commands.jsonl: each command re-applies at the
+    #: same round boundary it originally hit, so an interactively driven
+    #: run replays byte-identically from config + command log. Volatile.
+    replay_commands: Optional[str] = None
 
 
 @dataclass
@@ -461,6 +471,12 @@ def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
     g.sim_shards = int(gen.get("sim_shards", 1))
     _require(1 <= g.sim_shards <= 64,
              "general.sim_shards must be in [1, 64]")
+    if gen.get("live_endpoint") is not None:
+        g.live_endpoint = str(gen["live_endpoint"])
+        _require(bool(g.live_endpoint),
+                 "general.live_endpoint must be a socket path or 'auto'")
+    if gen.get("replay_commands") is not None:
+        g.replay_commands = str(gen["replay_commands"])
 
     if doc.get("network"):
         cfg.network = doc["network"]

@@ -125,7 +125,11 @@ def build_timeline(cfg: ConfigOptions, graph, by_name: dict,
     so the timeline is identical under every policy and data plane.
     """
     actions: list[FaultAction] = []
-    for ev in cfg.faults.events:
+    # cfg.faults is None when the injector exists only for runtime live
+    # commands (live.ensure_fault_injector): empty config timeline.
+    events = cfg.faults.events if cfg.faults is not None else []
+    churn = cfg.faults.churn if cfg.faults is not None else []
+    for ev in events:
         a = FaultAction(t=ev.time, kind=ev.kind,
                         latency_factor=ev.latency_factor,
                         loss_add=ev.loss_add,
@@ -142,7 +146,7 @@ def build_timeline(cfg: ConfigOptions, graph, by_name: dict,
             actions.append(FaultAction(
                 t=ev.time + ev.duration, kind=end_kind, src=a.src,
                 dst=a.dst, host_ids=a.host_ids, ref=a))
-    for ch in cfg.faults.churn:
+    for ch in churn:
         for hid in _resolve_hosts(ch.hosts, by_name):
             rng = fault_rng(cfg.general.seed, hid)
             t = ch.start_time
@@ -217,6 +221,19 @@ class FaultInjector:
         """Time of the next unapplied action (a skip-ahead wake-up)."""
         return self.actions[self.idx].t if self.idx < len(self.actions) \
             else T_NEVER
+
+    def insert_runtime(self, acts: list[FaultAction]) -> None:
+        """Insert live-command actions (live.materialize_command) into the
+        unapplied tail, keeping it t-sorted.  A runtime action lands AFTER
+        existing actions with the same t (command application is ordered
+        after the config timeline at a shared boundary), and never before
+        ``idx`` — an action due now is picked up by the ``apply_due`` call
+        at this same boundary."""
+        for a in acts:
+            i = len(self.actions)
+            while i > self.idx and self.actions[i - 1].t > a.t:
+                i -= 1
+            self.actions.insert(i, a)
 
     def apply_due(self, now: SimTime) -> None:
         """Apply every action with t <= now. Called by the controller at

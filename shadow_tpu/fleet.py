@@ -369,6 +369,10 @@ def _member_config(config_path: str, overrides: dict, sweep_dir,
     over = dict(overrides or {})
     over["general.seed"] = int(seed)
     over["general.data_directory"] = str(seed_dir(sweep_dir, seed))
+    # members never bind a live endpoint: M concurrent seeds would race
+    # on one socket path, and a sweep is a batch artifact. The sweep
+    # itself can expose a status-only endpoint (--live-endpoint).
+    over["general.live_endpoint"] = None
     # cache_doc: one worker parses the (possibly multi-hundred-host)
     # YAML once per process, not once per seed — the compose step alone
     # cost more than the tor_400 round loop
@@ -527,7 +531,8 @@ class FleetRunner:
     def __init__(self, config_path: str, seeds: list, jobs: int,
                  sweep_dir, overrides: dict = None, resume: bool = False,
                  max_rss_mb: int = None, pin_cores: bool = True,
-                 device_service: bool = True, quiet: bool = False) -> None:
+                 device_service: bool = True, quiet: bool = False,
+                 live_endpoint: str = None) -> None:
         self.config_path = str(config_path)
         self.seeds = [int(s) for s in seeds]
         if not self.seeds:
@@ -548,6 +553,24 @@ class FleetRunner:
         self._conns: list = []
         self._inflight: dict = {}  # worker idx -> seed
         self._respawns = 0
+        # sweep-level live endpoint (shadow_tpu/live.py): STATUS ONLY —
+        # per-seed lifecycle records for dashboards. Runtime commands are
+        # refused by name: a sweep is a batch of independent replayable
+        # runs, and mutating one seed mid-sweep would fork its identity.
+        self.live = None
+        if live_endpoint:
+            from shadow_tpu import live as _live
+
+            self.live = _live.LiveServer(
+                _live.resolve_endpoint(live_endpoint, self.sweep_dir),
+                refuse=lambda norm: (
+                    f"sweep endpoint is status-only: {norm['cmd']!r} "
+                    f"would fork one seed's identity mid-sweep — attach "
+                    f"to a single run's live_endpoint instead"))
+
+    def _publish(self, rec: dict) -> None:
+        if self.live is not None:
+            self.live.publish(rec)
 
     def _log(self, msg: str) -> None:
         if not self.quiet:
@@ -734,6 +757,11 @@ class FleetRunner:
         self._log(f"sweep done: {n_ok}/{len(self.seeds)} seeds ok, "
                   f"{len(failed)} failed, wall {wall:.1f}s -> "
                   f"{self.sweep_dir / SWEEP_SUMMARY}")
+        if self.live is not None:
+            self._publish({"type": "end", "ok": n_ok,
+                           "failed": len(failed),
+                           "wall_seconds": round(wall, 1)})
+            self.live.close()
         return summary
 
     def _dispatch_loop(self, pending: list, failed: dict) -> None:
@@ -768,6 +796,9 @@ class FleetRunner:
                 self._log(f"seed {seed} -> worker {k} "
                           f"({len(pending)} queued, "
                           f"{len(self._inflight)} resident)")
+                self._publish({"type": "seed_dispatched", "seed": seed,
+                               "worker": k, "queued": len(pending),
+                               "resident": len(self._inflight)})
             live = [c for c in self._conns if c is not None]
             if not live:
                 break
@@ -788,6 +819,10 @@ class FleetRunner:
                     self._log(f"seed {seed} ok "
                               f"({man['wall_seconds']}s wall, "
                               f"{man['events']} events)")
+                    self._publish({"type": "seed_done", "seed": seed,
+                                   "wall_seconds": man["wall_seconds"],
+                                   "events": man["events"],
+                                   "rounds": man["rounds"]})
                 elif op == "failed":
                     _, seed, err, tb = msg
                     failed[seed] = err
@@ -795,6 +830,8 @@ class FleetRunner:
                     idle.append(k)
                     self._log(f"seed {seed} FAILED: {err} — sweep "
                               f"continues")
+                    self._publish({"type": "seed_failed", "seed": seed,
+                                   "error": err})
                 else:
                     self._inflight.pop(k, None)
                     idle.append(k)
@@ -815,6 +852,8 @@ class FleetRunner:
             except OSError:
                 pass
             self._log(f"seed {seed} FAILED: {err} — respawning worker")
+            self._publish({"type": "seed_failed", "seed": seed,
+                           "error": err})
         try:
             self._conns[k].close()
         except OSError:
@@ -1051,6 +1090,11 @@ def build_parser() -> argparse.ArgumentParser:
     ps.add_argument("--no-telemetry", action="store_true",
                     help="do not auto-enable telemetry (no flow "
                     "percentiles or CIs in the sweep summary)")
+    ps.add_argument("--live-endpoint", metavar="PATH",
+                    help="bind a STATUS-ONLY AF_UNIX endpoint streaming "
+                    "per-seed lifecycle records (dispatched/done/failed); "
+                    "runtime commands are refused — 'auto' = "
+                    "<sweep-dir>/live.sock")
     ps.add_argument("--quiet", action="store_true",
                     help="no progress lines on stderr")
     ps.add_argument("--json", action="store_true",
@@ -1113,7 +1157,8 @@ def main(argv=None) -> int:
             args.config, seeds, args.jobs, sweep_dir, overrides=over,
             resume=args.resume, max_rss_mb=args.max_rss_mb,
             pin_cores=not args.no_pin,
-            device_service=not args.no_device_service, quiet=args.quiet)
+            device_service=not args.no_device_service, quiet=args.quiet,
+            live_endpoint=args.live_endpoint)
         summary = runner.run()
     except FileNotFoundError as exc:
         print(f"fleet: config file not found: "

@@ -222,6 +222,13 @@ class TelemetryCollector:
         if f is None:
             f = self._fh[name] = open(self._dir(controller) / name, "a")
         f.write("\n".join(lines) + "\n")
+        # live-operations tee (shadow_tpu/live.py): followers receive the
+        # artifact lines verbatim as they are written. Wall-clock plane
+        # only — publish never blocks and drops on slow readers, so the
+        # on-disk streams stay the source of truth
+        srv = getattr(controller, "live", None)
+        if srv is not None:
+            srv.publish_stream(name, lines)
 
     def _flows_name(self) -> str:
         return (FLOWS_FILE if self.shard is None
@@ -441,6 +448,14 @@ class TelemetryCollector:
                                   controller.rounds, t)
         self.sync(controller)  # flows land before the sample's write
         self._append(controller, METRICS_FILE, [line])
+        srv = getattr(controller, "live", None)
+        if srv is not None:
+            # flow-group percentile snapshot at the sample grid point:
+            # the same reduction as the end-of-run summary, so a follower
+            # watches the distributions converge live
+            srv.publish({"type": "flows_snapshot", "t": t,
+                         "round": controller.rounds,
+                         "flows": self.summary()["flows"]})
 
     # -- end of run --------------------------------------------------------
     def finalize(self, controller) -> None:

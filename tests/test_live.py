@@ -1,0 +1,495 @@
+"""Live operations plane (shadow_tpu/live.py).
+
+The load-bearing property: a run driven interactively through the live
+endpoint — runtime fault commands, pause/resume, checkpoint_now, stop —
+is REPLAYABLE byte-identically from its config plus the recorded
+commands.jsonl, across scheduler policies, the C/Python twin planes,
+and shard counts; and the endpoint itself (streaming + an attached
+follower) never perturbs the simulation. On top: the time-travel
+debugger (``python -m shadow_tpu.live jump``) reproduces recorded state
+digests, and ``bisect_divergence --json`` feeds it a divergent round.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import shutil
+import threading
+import time as _walltime  # detlint: ok(wallclock): test harness pacing only
+from pathlib import Path
+
+import pytest
+import yaml
+
+from shadow_tpu import live as lv
+from shadow_tpu.config.schema import parse_config
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.parallel import shards as sh
+
+ROOT = Path(__file__).resolve().parents[1]
+CHURN_YAML = ROOT / "examples" / "gossip_churn.yaml"
+
+#: two-node bulk stream: long enough (sim seconds) that an immediately
+#: sent command always lands mid-transfer, short enough to run a matrix
+BASE = """
+general:
+  stop_time: 120s
+  seed: 3
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 1 host_bandwidth_up "20 Mbit" host_bandwidth_down "20 Mbit" ]
+        edge [ source 0 target 1 latency "25 ms" ]
+        edge [ source 0 target 0 latency "5 ms" ]
+        edge [ source 1 target 1 latency "5 ms" ]
+      ]
+hosts:
+  server:
+    network_node_id: 0
+    processes:
+      - path: pyapp:shadow_tpu.models.tgen:TGenServer
+        args: ["8080"]
+  client:
+    network_node_id: 1
+    processes:
+      - path: pyapp:shadow_tpu.models.tgen:TGenClient
+        args: ["16 MB", "1", serial, "8080", server]
+        start_time: 1s
+"""
+
+LINK_DOWN = {"cmd": "link_down", "src_nodes": [0], "dst_nodes": [1],
+             "duration": "3s"}
+
+
+def _base_cfg(tag: str, over: dict = None):
+    doc = yaml.safe_load(BASE)
+    dd = f"/tmp/st-live-{tag}"
+    shutil.rmtree(dd, ignore_errors=True)
+    return parse_config(doc, {"general.data_directory": dd,
+                              "general.state_digest_every": 50,
+                              "telemetry.sample_every": "5s",
+                              **(over or {})})
+
+
+def _tree(tag: str, require=True) -> dict:
+    out = {}
+    base = Path(f"/tmp/st-live-{tag}")
+    for p in sorted((base / "hosts").rglob("*")):
+        if p.is_file():
+            out[str(p.relative_to(base))] = hashlib.sha256(
+                p.read_bytes()).hexdigest()
+    if require:
+        assert out, f"no host artifacts under /tmp/st-live-{tag}"
+    return out
+
+
+def _stream(tag: str, name: str) -> str:
+    return Path(f"/tmp/st-live-{tag}/{name}").read_text()
+
+
+def _sim_cmds(log_text: str) -> list:
+    """The sim-visible command records: replay skips (and does not
+    re-log) wall_only pause/resume entries, so replay logs must equal
+    the live log FILTERED to these."""
+    return [ln for ln in log_text.splitlines()
+            if not json.loads(ln).get("wall_only")]
+
+
+def _live_run(tag: str, cmds: list, over: dict = None,
+              collect_stream: bool = False):
+    """Run BASE with a live endpoint; a sibling thread sends ``cmds``
+    in order as soon as the socket binds. Returns (summary, acks,
+    records) — records only populated when ``collect_stream``."""
+    sock = f"/tmp/st-live-{tag}.sock"
+    cfg = _base_cfg(tag, {"general.live_endpoint": sock, **(over or {})})
+    acks: list = []
+    records: list = []
+
+    def _drive():
+        for c in cmds:
+            acks.append(lv.send_command(sock, c, timeout=60))
+
+    def _follow():
+        for rec in lv.stream_records(sock, timeout=60):
+            records.append(rec)
+
+    threads = [threading.Thread(target=_drive, daemon=True)]
+    if collect_stream:
+        threads.append(threading.Thread(target=_follow, daemon=True))
+    for t in threads:
+        t.start()
+    summary = Controller(cfg, mirror_log=False).run()
+    for t in threads:
+        t.join(timeout=10)
+    return summary, acks, records
+
+
+def _replay_run(tag: str, log_path: str, over: dict = None) -> dict:
+    cfg = _base_cfg(tag, {"general.replay_commands": log_path,
+                          **(over or {})})
+    return Controller(cfg, mirror_log=False).run()
+
+
+# -- command validation + the canonical log -----------------------------------
+
+def test_normalize_command():
+    n = lv.normalize_command(dict(LINK_DOWN))
+    assert n["cmd"] == "link_down"
+    # canonical durations are explicit-unit strings: a bare int would be
+    # re-parsed as SECONDS by parse_time on the replay side
+    assert n["duration"] == "3000000000 ns"
+    # idempotent: normalizing the canonical form is a fixed point
+    assert lv.normalize_command(dict(n)) == n
+    with pytest.raises(ValueError, match="unknown command"):
+        lv.normalize_command({"cmd": "reboot_host"})
+    with pytest.raises(ValueError, match="unknown keys"):
+        lv.normalize_command({**LINK_DOWN, "sneaky": 1})
+    with pytest.raises(ValueError, match="unknown command"):
+        lv.normalize_command({"src_nodes": [0]})
+    with pytest.raises(ValueError, match="no parameters"):
+        lv.normalize_command({"cmd": "pause", "duration": "1s"})
+    assert lv.normalize_command({"cmd": "pause"}) == {"cmd": "pause"}
+
+
+def test_command_log_roundtrip(tmp_path):
+    n = lv.normalize_command(dict(LINK_DOWN))
+    lines = [lv.format_command_record(n, 1, 10, 50_000_000),
+             lv.format_command_record({"cmd": "pause"}, 2, 20, 90_000_000,
+                                      wall_only=True)]
+    p = tmp_path / "commands.jsonl"
+    p.write_text("\n".join(lines) + "\n")
+    recs = lv.load_command_log(p)
+    assert [r["seq"] for r in recs] == [1, 2]
+    assert recs[0]["cmd"] == n
+    assert recs[1]["wall_only"] is True
+    # application order is file order; t must be non-decreasing
+    p.write_text("\n".join(reversed(lines)) + "\n")
+    with pytest.raises(ValueError, match="goes backwards"):
+        lv.load_command_log(p)
+
+
+def test_server_refuse_ack_and_broadcast(tmp_path):
+    sock = str(tmp_path / "s.sock")
+    srv = lv.LiveServer(sock, refuse=lambda n: (
+        "not here" if n["cmd"] == "pause" else None))
+    try:
+        got = []
+        t = threading.Thread(
+            target=lambda: got.extend(lv.stream_records(sock, timeout=10)),
+            daemon=True)
+        t.start()
+        for _ in range(500):  # wait for the follower's hello
+            if got:
+                break
+            _walltime.sleep(0.01)
+        assert got and got[0]["type"] == "hello"
+        assert lv.send_command(sock, {"cmd": "pause"})["type"] == "error"
+        assert lv.send_command(sock, {"cmd": "bogus"})["type"] == "error"
+        ack = lv.send_command(sock, dict(LINK_DOWN))
+        assert ack["type"] == "ack"
+        assert ack["cmd"]["duration"] == "3000000000 ns"
+        # the refused + malformed commands never reached the queue
+        assert [c["cmd"] for c in srv.poll_commands()] == ["link_down"]
+        srv.publish({"type": "hb", "t": 1})
+        srv.publish_stream("metrics.jsonl", ['{"kind":"sample"}'])
+    finally:
+        srv.close()
+    t.join(timeout=10)
+    kinds = [r["type"] for r in got]
+    assert "hb" in kinds and "stream" in kinds
+
+
+def test_endpoint_path_too_long(tmp_path):
+    with pytest.raises(ValueError, match="AF_UNIX"):
+        lv.LiveServer(str(tmp_path / ("x" * 120) / "live.sock"))
+
+
+# -- live run vs replay: the byte-identity matrix -----------------------------
+
+def test_live_replay_identity_matrix():
+    """One interactively driven run (pause + link_down + resume, streamed
+    to a follower), replayed from its commands.jsonl across scheduler
+    policies and the C/Python twin planes: trees, digest streams,
+    metrics, and the sim-visible command log are all byte-identical."""
+    live_over = {"experimental.scheduler_policy": "tpu_batch",
+                 "experimental.native_colcore": True,
+                 "general.heartbeat_interval": "2s"}
+    s1, acks, recs = _live_run(
+        "mx-live", [{"cmd": "pause"}, dict(LINK_DOWN), {"cmd": "resume"}],
+        over=live_over, collect_stream=True)
+    assert [a["type"] for a in acks] == ["ack"] * 3
+    # the command plane reached the sim: down + scheduled heal applied
+    assert s1["fault_transitions_applied"] >= 2
+    log = "/tmp/st-live-mx-live/commands.jsonl"
+    cl = Path(log).read_text()
+    recs_log = [json.loads(x) for x in cl.splitlines()]
+    assert [r["cmd"]["cmd"] for r in recs_log] == \
+        ["pause", "link_down", "resume"]
+    assert recs_log[0].get("wall_only") and recs_log[2].get("wall_only")
+    # pause wall-blocked the boundary: all three landed on the same one
+    assert len({r["t"] for r in recs_log}) == 1
+    # the follower saw the lifecycle: hello, heartbeats, the commands,
+    # stream tees, and the end record
+    kinds = {r["type"] for r in recs}
+    assert {"hello", "hb", "command", "stream", "end"} <= kinds
+    t1 = _tree("mx-live")
+    d1 = _stream("mx-live", "state_digests.jsonl")
+    m1 = _stream("mx-live", "metrics.jsonl")
+    for tag, over in (
+            ("mx-r-pyplane", {"experimental.scheduler_policy": "tpu_batch",
+                              "experimental.native_colcore": False}),
+            ("mx-r-tpc", {"experimental.scheduler_policy":
+                          "thread_per_core",
+                          "experimental.native_colcore": True}),
+            ("mx-r-tpc-py", {"experimental.scheduler_policy":
+                             "thread_per_core",
+                             "experimental.native_colcore": False})):
+        s2 = _replay_run(tag, log, over)
+        assert _tree(tag) == t1, f"tree diverged: {tag}"
+        assert _stream(tag, "state_digests.jsonl") == d1, tag
+        assert _stream(tag, "metrics.jsonl") == m1, tag
+        assert _sim_cmds(_stream(tag, "commands.jsonl")) == \
+            _sim_cmds(cl), tag
+        assert s2["fault_transitions_applied"] == \
+            s1["fault_transitions_applied"]
+
+
+def test_live_noop_endpoint_is_transparent():
+    """A bound endpoint with no commands (follower attached) changes
+    nothing: tree and digests equal the detached run, and no
+    commands.jsonl is written."""
+    s_live, _, _ = _live_run("noop-live", [], collect_stream=True)
+    cfg = _base_cfg("noop-off")
+    s_off = Controller(cfg, mirror_log=False).run()
+    assert _tree("noop-live") == _tree("noop-off")
+    assert _stream("noop-live", "state_digests.jsonl") == \
+        _stream("noop-off", "state_digests.jsonl")
+    assert not Path("/tmp/st-live-noop-live/commands.jsonl").exists()
+    assert s_live["rounds"] == s_off["rounds"]
+
+
+def test_live_stop_command_and_replay():
+    """A live ``stop`` ends the run gracefully at a round boundary
+    (interrupt_signal live_stop, partial summary) and is recorded —
+    replaying the log reproduces the same truncated run."""
+    s1, acks, _ = _live_run("stop-live", [{"cmd": "stop"}])
+    assert acks[0]["type"] == "ack"
+    assert s1["exit_reason"] == "interrupted"
+    assert s1["interrupt_signal"] == "live_stop"
+    cl = _stream("stop-live", "commands.jsonl")
+    assert json.loads(cl)["cmd"]["cmd"] == "stop"
+    s2 = _replay_run("stop-replay", "/tmp/st-live-stop-live/commands.jsonl")
+    assert s2["exit_reason"] == "interrupted"
+    assert s2["rounds"] == s1["rounds"]
+    assert _tree("stop-replay", require=False) == \
+        _tree("stop-live", require=False)
+    assert _stream("stop-replay", "commands.jsonl") == cl
+    # the stop may land before the first digest sample; the two runs
+    # must agree on whether one was written
+    p1 = Path("/tmp/st-live-stop-live/state_digests.jsonl")
+    p2 = Path("/tmp/st-live-stop-replay/state_digests.jsonl")
+    assert p1.exists() == p2.exists()
+    if p1.exists():
+        assert p1.read_text() == p2.read_text()
+
+
+def test_checkpoint_now_and_mid_command_resume():
+    """checkpoint_now + a 6s link_down: the on-demand checkpoint lands
+    inside the fault window (scheduled heal pending in the snapshot).
+    Resuming from it with the recorded log replays nothing (every
+    recorded boundary <= the snapshot) yet the heal still fires — tree
+    and digest suffix are identical to the uninterrupted live run."""
+    from shadow_tpu import checkpoint as ckpt
+
+    down = {**LINK_DOWN, "duration": "6s"}
+    # pause pins every command to ONE boundary B: the snapshot is taken
+    # at B (wall timing decides B, and that choice is recorded)
+    s1, acks, _ = _live_run("ck-live", [{"cmd": "pause"}, down,
+                                        {"cmd": "checkpoint_now"},
+                                        {"cmd": "resume"}])
+    assert [a["type"] for a in acks] == ["ack"] * 4
+    ckpts = sorted(Path("/tmp/st-live-ck-live/checkpoints").glob("*.ckpt"))
+    assert ckpts, "checkpoint_now wrote nothing"
+    h = ckpt.read_header(str(ckpts[0]))
+    t_down = next(json.loads(ln)["t"]
+                  for ln in _stream("ck-live", "commands.jsonl").splitlines()
+                  if json.loads(ln)["cmd"]["cmd"] == "link_down")
+    # the snapshot was taken at the fault's own boundary, 6s before the
+    # scheduled heal: the fault window brackets it
+    assert int(h["sim_time_ns"]) == t_down < t_down + 6_000_000_000
+    t1 = _tree("ck-live")
+    d1 = _stream("ck-live", "state_digests.jsonl").splitlines()
+    log = "/tmp/st-live-ck-live/commands.jsonl"
+    cfg = _base_cfg("ck-res", {"general.replay_commands": log})
+    ctl, resume_at = ckpt.load_checkpoint(str(ckpts[0]), cfg,
+                                          mirror_log=False)
+    s2 = ctl.run(resume_at=resume_at)
+    assert _tree("ck-res") == t1
+    d2 = _stream("ck-res", "state_digests.jsonl").splitlines()
+    assert d2 == d1[-len(d2):], "resumed digest stream diverged"
+    assert s2["exit_reason"] == "completed"
+    # no commands re-logged on resume: every recorded boundary <= the
+    # snapshot had already applied before it was taken
+    assert not Path("/tmp/st-live-ck-res/commands.jsonl").exists()
+
+
+# -- sharded: live fault at shards=2, replayed at 2 and 1 ---------------------
+
+def _churn_cfg(tag: str, over: dict):
+    doc = yaml.safe_load(CHURN_YAML.read_text())
+    dd = f"/tmp/st-live-{tag}"
+    shutil.rmtree(dd, ignore_errors=True)
+    return parse_config(doc, {
+        "general.data_directory": dd,
+        "general.stop_time": "8s",
+        "general.state_digest_every": 50,
+        "telemetry.sample_every": "5s",
+        "experimental.scheduler_policy": "tpu_batch",
+        "experimental.native_colcore": True,
+        **over})
+
+
+def test_live_sharded_replay_identity():
+    """THE acceptance leg: a live fault injected into a sharded (N=2, C
+    engine) churn run — on top of the config's own fault timeline —
+    replays byte-identically at shards=2 AND shards=1; pause is refused
+    by name on the sharded endpoint."""
+    sock = "/tmp/st-live-sh.sock"
+    acks: list = []
+
+    def _drive():
+        acks.append(lv.send_command(sock, {**LINK_DOWN, "duration": "2s"},
+                                    timeout=60))
+        acks.append(lv.send_command(sock, {"cmd": "pause"}, timeout=60))
+
+    t = threading.Thread(target=_drive, daemon=True)
+    t.start()
+    s1 = sh.run_sharded(_churn_cfg("sh-live",
+                                   {"general.live_endpoint": sock,
+                                    "general.sim_shards": 2}),
+                        mirror_log=False)
+    t.join(timeout=10)
+    assert acks[0]["type"] == "ack"
+    assert acks[1]["type"] == "error"
+    assert "single-process" in acks[1]["error"]
+    log = "/tmp/st-live-sh-live/commands.jsonl"
+    cl = Path(log).read_text()
+    assert json.loads(cl)["cmd"]["cmd"] == "link_down"
+    t1, d1 = _tree("sh-live"), _stream("sh-live", "state_digests.jsonl")
+    m1 = _stream("sh-live", "metrics.jsonl")
+    s2 = sh.run_sharded(_churn_cfg("sh-r2",
+                                   {"general.replay_commands": log,
+                                    "general.sim_shards": 2}),
+                        mirror_log=False)
+    s3 = Controller(_churn_cfg("sh-r1", {"general.replay_commands": log}),
+                    mirror_log=False).run()
+    for tag in ("sh-r2", "sh-r1"):
+        assert _tree(tag) == t1, f"tree diverged: {tag}"
+        assert _stream(tag, "state_digests.jsonl") == d1, tag
+        assert _stream(tag, "metrics.jsonl") == m1, tag
+        assert _stream(tag, "commands.jsonl") == cl, tag
+    assert s2["rounds"] == s1["rounds"] == s3["rounds"]
+
+
+# -- time travel + bisect -----------------------------------------------------
+
+def test_jump_reproduces_recorded_digest(tmp_path):
+    """jump --round R: restore the nearest checkpoint, re-execute to R,
+    and digest-verify against the recorded stream — then again with the
+    checkpoints hidden (from-scratch fallback), on a commanded run."""
+    s1, acks, _ = _live_run("jump-live", [dict(LINK_DOWN)],
+                            over={"general.checkpoint_every": "4s"})
+    assert acks[0]["type"] == "ack"
+    run_dir = Path("/tmp/st-live-jump-live")
+    digs = [json.loads(x) for x in
+            _stream("jump-live", "state_digests.jsonl").splitlines()]
+    target = digs[-1]["round"]  # after the heal, deep into the run
+    cfg_path = tmp_path / "jump.yaml"
+    cfg_path.write_text(BASE)
+    out: list = []
+    rc = lv.jump(run_dir, target, cfg_path, out=out.append,
+                 inspect_dir=tmp_path / "jump-ck")
+    assert rc == 0, "\n".join(out)
+    assert any("restored" in ln for ln in out), out  # used a checkpoint
+    assert any("[MATCH]" in ln for ln in out)
+    # hide the checkpoints: the jump re-executes from round 0 instead
+    # (replaying the same command log) and still reproduces the digest
+    hidden = run_dir / "checkpoints.hidden"
+    (run_dir / "checkpoints").rename(hidden)
+    try:
+        out2: list = []
+        rc2 = lv.jump(run_dir, target, cfg_path, out=out2.append,
+                      inspect_dir=tmp_path / "jump-scratch")
+        assert rc2 == 0, "\n".join(out2)
+        assert any("re-executing from round 0" in ln for ln in out2)
+        assert any("[MATCH]" in ln for ln in out2)
+    finally:
+        hidden.rename(run_dir / "checkpoints")
+
+
+def test_bisect_json_and_jump_handoff(tmp_path, capsys):
+    """bisect_divergence --json names the first divergent round as one
+    machine-readable record, and the jump CLI's --from-bisect reader
+    accepts it."""
+    import sys
+    sys.path.insert(0, str(ROOT / "tools"))
+    try:
+        import bisect_divergence as bd
+    finally:
+        sys.path.pop(0)
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    recs = [{"round": r, "t": r * 10, "digest": f"d{r}",
+             "hosts": {"h0": f"x{r}", "h1": f"y{r}"}} for r in (50, 100, 150)]
+    a.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    recs[1] = {**recs[1], "digest": "DIFF",
+               "hosts": {"h0": "DIFF", "h1": "y100"}}
+    b.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    assert bd.main(["--json", str(a), str(b)]) == 1
+    rec = json.loads(capsys.readouterr().out)
+    assert rec == {"kind": "digest", "round": 100, "t": 1000,
+                   "hosts": ["h0"], "last_match": 50}
+    assert bd.main(["--json", str(a), str(a)]) == 0
+    ident = json.loads(capsys.readouterr().out)
+    assert ident["kind"] == "identical" and ident["last_round"] == 150
+    # the handoff: _read_bisect picks the record out of mixed output
+    src = tmp_path / "bisect.out"
+    src.write_text("noise line\n" + json.dumps(rec) + "\n")
+    assert lv._read_bisect(str(src))["round"] == 100
+
+
+# -- config plumbing ----------------------------------------------------------
+
+def test_live_config_keys_are_volatile():
+    """live_endpoint/replay_commands must never enter checkpoint config
+    identity (a replay resume would refuse otherwise), and the schema
+    accepts both keys."""
+    from shadow_tpu.checkpoint import VOLATILE_CONFIG_KEYS
+
+    assert ("general", "live_endpoint") in VOLATILE_CONFIG_KEYS
+    assert ("general", "replay_commands") in VOLATILE_CONFIG_KEYS
+    cfg = _base_cfg("schema", {"general.live_endpoint": "auto",
+                               "general.replay_commands": "/tmp/x.jsonl"})
+    assert cfg.general.live_endpoint == "auto"
+    assert cfg.general.replay_commands == "/tmp/x.jsonl"
+    assert lv.resolve_endpoint("auto", "/data/run") == "/data/run/live.sock"
+    assert lv.resolve_endpoint("/tmp/s.sock", "/data/run") == "/tmp/s.sock"
+
+
+def test_fleet_members_never_bind():
+    """_member_config forces live_endpoint off: M concurrent seeds must
+    not race on one socket path."""
+    from shadow_tpu.fleet import _member_config
+
+    cfg_path = Path("/tmp/st-live-fleet.yaml")
+    cfg_path.write_text(BASE)
+    cfg = _member_config(str(cfg_path),
+                         {"general.live_endpoint": "/tmp/x.sock"},
+                         Path("/tmp/st-live-fleet-sweep"), 3)
+    assert cfg.general.live_endpoint is None
+    assert cfg.general.seed == 3

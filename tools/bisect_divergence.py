@@ -19,6 +19,14 @@ Usage:
     python tools/bisect_divergence.py A/state_digests.jsonl B/state_digests.jsonl
     python tools/bisect_divergence.py --window-rounds K A.jsonl B.jsonl
     python tools/bisect_divergence.py --shard K A_datadir B_datadir
+    python tools/bisect_divergence.py --json A.jsonl B.jsonl
+
+``--json`` prints ONE machine-readable JSON line instead of the report:
+``{"kind": "digest", "round": R, "t": NS, "hosts": [...], "shard": K,
+"last_match": R0}`` on divergence (``kind`` is one of digest/missing/
+extra/sampling), ``{"kind": "identical", ...}`` on a match. The exit
+status is unchanged, and the record feeds the time-travel debugger
+directly: ``python -m shadow_tpu.live jump RUN_DIR --from-bisect -``.
 
 ``--shard K`` (for runs made with ``general.sim_shards`` > 1) compares
 the shard-tagged sidecar streams ``state_digests.shard<K>.jsonl`` the
@@ -129,8 +137,13 @@ def _shard_path(path: str, shard: int) -> str:
 def main(argv) -> int:
     window_rounds = 0
     shard = None
-    while argv and argv[0] in ("--window-rounds", "--shard"):
+    as_json = False
+    while argv and argv[0] in ("--window-rounds", "--shard", "--json"):
         flag = argv[0]
+        if flag == "--json":
+            as_json = True
+            argv = argv[1:]
+            continue
         if len(argv) < 2:
             print(__doc__, file=sys.stderr)
             return 2
@@ -155,10 +168,31 @@ def main(argv) -> int:
         argv = [_shard_path(argv[0], shard), _shard_path(argv[1], shard)]
     recs_a, recs_b = load_stream(argv[0]), load_stream(argv[1])
     d = compare(recs_a, recs_b)
+    # the shard a divergence localizes to (sidecar streams carry it)
+    shard_tag = shard if shard is not None else (
+        recs_a[0].get("shard") if recs_a else None)
     if d is None:
-        print(f"identical: {len(recs_a)} sentinel records agree "
-              f"(through round {recs_a[-1]['round']})")
+        if as_json:
+            print(json.dumps({"kind": "identical", "records": len(recs_a),
+                              "last_round": recs_a[-1]["round"],
+                              **({"shard": shard_tag}
+                                 if shard_tag is not None else {})},
+                             sort_keys=True))
+        else:
+            print(f"identical: {len(recs_a)} sentinel records agree "
+                  f"(through round {recs_a[-1]['round']})")
         return 0
+    if as_json:
+        out = {"kind": d["kind"], "round": d["round"], "t": d.get("t"),
+               "hosts": d.get("hosts", []),
+               "last_match": d["last_match"],
+               **({"shard": shard_tag} if shard_tag is not None else {})}
+        if window_rounds and d["kind"] == "digest":
+            w, lo, hi = window_of(d["round"], window_rounds)
+            out["window"] = {"index": w, "first_round": lo,
+                            "last_round": hi}
+        print(json.dumps(out, sort_keys=True))
+        return 1
     # shard-tagged streams (sim_shards sidecars): name the shard in the
     # report — the first divergent round AND shard, not just the round
     tag = ""

@@ -20,9 +20,20 @@ to the tables an experiment wants on one screen —
 
 Usage:
     python tools/metrics_report.py <data_dir | metrics.jsonl> [--json]
+    python tools/metrics_report.py --follow <data_dir | live.sock>
 
 ``--json`` emits the machine-readable report dict instead of tables
 (tools/ci.sh uses it as a parse gate).
+
+``--follow`` attaches to a RUNNING simulation's live endpoint
+(``general.live_endpoint`` / ``--live-endpoint``) and renders the
+telemetry stream as it happens: heartbeats (sim/wall rate, per-phase
+wall), metrics.jsonl lines as they are written, flow-group percentile
+snapshots, per-shard status, and applied runtime commands. The argument
+is the run's data directory (its ``live.sock``) or an explicit socket
+path. ``--follow-max N`` detaches after N records (CI gates);
+``--json`` with ``--follow`` prints the raw records verbatim instead of
+rendering. The follower is read-only and never perturbs the simulation.
 """
 
 from __future__ import annotations
@@ -270,12 +281,99 @@ def _fmt_table(rows: list, cols: list) -> str:
     return "\n".join(lines)
 
 
+def _render_live(rec: dict, out) -> None:
+    """One human line per live record (the --follow renderer)."""
+    t = rec.get("type")
+    if t == "hello":
+        out(f"attached: pid {rec.get('pid')} (protocol v{rec.get('v')})")
+    elif t == "hb":
+        wall = rec.get("wall") or {}
+        shards = rec.get("shards", 1)
+        out(f"hb  sim {rec['t'] / 1e9:.1f}s  round {rec['round']}  "
+            f"events {rec['events']}  sent {rec['units_sent']}  "
+            f"dropped {rec['units_dropped']}"
+            + (f"  shards {shards}" if shards != 1 else "")
+            + (f"  {wall.get('rate', 0):.2f} sim-s/s" if wall else "")
+            + (f"  [{rec['dev']}]" if "dev" in rec else ""))
+    elif t == "shard_status":
+        out(f"  shard {rec['shard']}: events {rec['events']}  "
+            f"sent {rec['units_sent']}  dropped {rec['units_dropped']}"
+            + (f"  [{rec['dev']}]" if "dev" in rec else ""))
+    elif t == "stream":
+        try:
+            inner = json.loads(rec["line"])
+        except ValueError:
+            inner = {}
+        kind = inner.get("kind")
+        if kind == "fault":
+            out(f"fault: {inner.get('action')} at sim "
+                f"{inner.get('t', 0) / 1e9:.3f}s "
+                f"{({k: v for k, v in inner.items() if k in ('src_nodes', 'dst_nodes', 'hosts')})}")
+        elif kind == "sample":
+            out(f"sample @ sim {inner.get('t', 0) / 1e9:.1f}s "
+                f"({rec['stream']})")
+    elif t == "flows_snapshot":
+        for name, row in sorted((rec.get("flows") or {}).items()):
+            out(f"  flows[{name}]: n {row.get('count', 0)} "
+                f"ok {row.get('ok', 0)} failed {row.get('failed', 0)}"
+                + (f" p50 {row['p50_ms']}ms p99 {row['p99_ms']}ms"
+                   if "p50_ms" in row else ""))
+    elif t == "command":
+        cmd = rec.get("cmd") or {}
+        out(f"command applied: {cmd.get('cmd')} at round "
+            f"{rec.get('round')} (sim {rec.get('t', 0) / 1e9:.3f}s, "
+            f"seq {rec.get('seq')})")
+    elif t == "end":
+        out(f"run ended: {rec.get('exit_reason')} after "
+            f"{rec.get('rounds')} rounds (sim {rec.get('t', 0) / 1e9:.1f}s)")
+    elif t in ("seed_dispatched", "seed_done", "seed_failed"):
+        out(f"{t.replace('_', ' ')}: seed {rec.get('seed')}"
+            + (f" ({rec.get('error')})" if t == "seed_failed" else ""))
+
+
+def follow(path: str, max_records=None, as_json: bool = False,
+           timeout: float = 30.0, out=print) -> int:
+    """Attach to a live endpoint and render its stream until the run
+    ends (or ``max_records`` records have been seen)."""
+    from shadow_tpu import live as _live
+
+    addr = _live.default_endpoint(path)
+    n = 0
+    try:
+        for rec in _live.stream_records(addr, timeout=timeout):
+            if as_json:
+                out(json.dumps(rec, sort_keys=True))
+            else:
+                _render_live(rec, out)
+            n += 1
+            if rec.get("type") == "end":
+                return 0
+            if max_records is not None and n >= max_records:
+                return 0
+    except OSError as exc:
+        print(f"metrics_report: cannot attach to {addr}: {exc}",
+              file=sys.stderr)
+        return 2
+    return 0  # endpoint closed (run finished while we were draining)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("path", help="run data directory (or metrics.jsonl)")
     ap.add_argument("--json", action="store_true",
                     help="emit the machine-readable report dict")
+    ap.add_argument("--follow", action="store_true",
+                    help="attach to a running simulation's live endpoint "
+                    "and render the stream (path = data dir or socket)")
+    ap.add_argument("--follow-max", type=int, default=None, metavar="N",
+                    help="with --follow: detach after N records")
+    ap.add_argument("--follow-timeout", type=float, default=30.0,
+                    metavar="S", help="with --follow: connect/read "
+                    "timeout in wall seconds")
     args = ap.parse_args(argv)
+    if args.follow:
+        return follow(args.path, max_records=args.follow_max,
+                      as_json=args.json, timeout=args.follow_timeout)
     p = Path(args.path)
     if p.is_dir():
         metrics, flows = p / "metrics.jsonl", p / "flows.jsonl"
