@@ -477,27 +477,16 @@ def _shim_audit_table(ctl, counters, top_n: int = 10) -> dict:
     }
 
 
-def real_curl_1k(n_servers: int = 50, n_clients: int = 200,
-                 fetches: int = 5, nbytes: int = 50_000,
-                 reps: int = 3) -> dict:
-    """Real-binary benchmark at benchmark scale (VERDICT r4 item #5):
-    ``n_servers`` unmodified CPython http.server instances serve
-    ``n_clients`` unmodified distro curl clients (``fetches`` sequential
-    fetches each) over a 64-node random graph — and BOTH benchmark
-    policies run it, so the published ratio is architecture-honest for
-    managed real-binary workloads too, not just pyapp models. Every
-    transfer is validated (code=200 + exact byte count)."""
+def _curl_1k_doc(n_servers: int, n_clients: int, fetches: int,
+                 nbytes: int) -> dict:
+    """The real_curl_1k workload document — shared with
+    managed_ckpt_overhead, which A/Bs guest journaling on the exact
+    workload whose rate the headline real-binary row publishes."""
     import sys as _sys
-    import time as _t
     from pathlib import Path as _P
 
     import numpy as np
 
-    from shadow_tpu.config import parse_config
-    from shadow_tpu.core.controller import Controller
-
-    if not _P("/usr/bin/curl").exists():
-        return {"skipped": "no /usr/bin/curl"}
     assert n_servers <= 254, "server ips are drawn from one /24"
     _sys.path.insert(0, str(ROOT / "tools"))
     from gen_benchmarks import random_gml
@@ -531,11 +520,32 @@ def real_curl_1k(n_servers: int = 50, n_clients: int = 200,
                          + urls),
                 "start_time": f"{2000 + i * 97} ms",
                 "expected_final_state": {"exited": 0}}]}
-    doc = {
+    return {
         "general": {"stop_time": "60s", "seed": 23},
         "network": {"graph": {"type": "gml", "inline": gml}},
         "hosts": hosts,
     }
+
+
+def real_curl_1k(n_servers: int = 50, n_clients: int = 200,
+                 fetches: int = 5, nbytes: int = 50_000,
+                 reps: int = 3) -> dict:
+    """Real-binary benchmark at benchmark scale (VERDICT r4 item #5):
+    ``n_servers`` unmodified CPython http.server instances serve
+    ``n_clients`` unmodified distro curl clients (``fetches`` sequential
+    fetches each) over a 64-node random graph — and BOTH benchmark
+    policies run it, so the published ratio is architecture-honest for
+    managed real-binary workloads too, not just pyapp models. Every
+    transfer is validated (code=200 + exact byte count)."""
+    import time as _t
+    from pathlib import Path as _P
+
+    from shadow_tpu.config import parse_config
+    from shadow_tpu.core.controller import Controller
+
+    if not _P("/usr/bin/curl").exists():
+        return {"skipped": "no /usr/bin/curl"}
+    doc = _curl_1k_doc(n_servers, n_clients, fetches, nbytes)
 
     def run(policy, tag):
         cfg = parse_config(doc, {
@@ -609,6 +619,271 @@ def real_curl_1k(n_servers: int = 50, n_clients: int = 200,
     log(f"real_curl_1k ratio: {ratio:.2f}x "
         f"({out['transfers']} validated transfers per side; shim fast "
         f"ratio tpu={tpu_audit['fast_ratio']}, tpc={tpc_audit['fast_ratio']})")
+    return out
+
+
+def managed_ckpt_overhead(n_servers: int = 50, n_clients: int = 200,
+                          fetches: int = 5, nbytes: int = 50_000,
+                          reps: int = 3) -> dict:
+    """What does checkpointability COST a real-binary run? (Checkpoint
+    format v5 row.) A/Bs the guest syscall journal — the only per-syscall
+    work a v5-checkpointable run adds when it never actually snapshots —
+    on the real_curl_1k workload itself, journal forced on vs forced off
+    via SHADOW_TPU_GUEST_JOURNAL, interleaved median-of-reps. Then times
+    one actual checkpoint->resume cycle on examples/managed_smoke.yaml:
+    a reexec snapshot re-buys the prefix (O(prefix) restore by design),
+    so the row publishes resume wall beside the uninterrupted wall."""
+    import os as _os
+    import time as _t
+    from pathlib import Path as _P
+
+    from shadow_tpu import checkpoint as _ckpt
+    from shadow_tpu.config import load_config, parse_config
+    from shadow_tpu.core.controller import Controller
+
+    if not _P("/usr/bin/curl").exists():
+        return {"skipped": "no /usr/bin/curl"}
+    doc = _curl_1k_doc(n_servers, n_clients, fetches, nbytes)
+
+    def run(journal, tag):
+        cfg = parse_config(doc, {
+            "general.data_directory": _fresh_dir(f"/tmp/shadow-bench-{tag}"),
+            "experimental.scheduler_policy": "tpu_batch"})
+        prev = _os.environ.get("SHADOW_TPU_GUEST_JOURNAL")
+        _os.environ["SHADOW_TPU_GUEST_JOURNAL"] = "1" if journal else "0"
+        try:
+            ctl = Controller(cfg, mirror_log=False)
+            res = ctl.run()
+        finally:
+            if prev is None:
+                del _os.environ["SHADOW_TPU_GUEST_JOURNAL"]
+            else:
+                _os.environ["SHADOW_TPU_GUEST_JOURNAL"] = prev
+        ok = _count_curl_ok(f"/tmp/shadow-bench-{tag}", n_clients, nbytes)
+        assert ok == fetches * n_clients, (tag, ok, res["process_errors"][:3])
+        oplogs = list(
+            _P(f"/tmp/shadow-bench-{tag}/guest_oplogs").glob("*.jsonl"))
+        assert bool(oplogs) == journal, (tag, journal, len(oplogs))
+        row = {
+            "sim_sec_per_wall_sec": round(res["sim_sec_per_wall_sec"], 3),
+            "wall_seconds": round(res["wall_seconds"], 2),
+            "transfers_ok": ok,
+        }
+        if journal:
+            row["journal_files"] = len(oplogs)
+            row["journal_bytes"] = sum(p.stat().st_size for p in oplogs)
+        log(f"managed_ckpt_overhead[journal={'on' if journal else 'off'}]: "
+            f"{row['sim_sec_per_wall_sec']} sim-s/wall-s, "
+            f"{row['wall_seconds']}s loop wall")
+        return row
+
+    # interleaved median-of-reps, off/on alternating within each rep so
+    # box drift hits both arms alike (the real_curl_1k discipline)
+    off_rows, on_rows = [], []
+    for rep in range(reps):
+        off_rows.append(run(False, f"ckptov-off-{rep}"))
+        on_rows.append(run(True, f"ckptov-on-{rep}"))
+
+    def med(rows):
+        rates = sorted(r["sim_sec_per_wall_sec"] for r in rows)
+        m = rates[len(rates) // 2]
+        row = dict(next(r for r in rows if r["sim_sec_per_wall_sec"] == m))
+        row["raw_rates"] = rates
+        row["spread"] = round(rates[-1] - rates[0], 3)
+        return row
+
+    off, on = med(off_rows), med(on_rows)
+    overhead = 1.0 - on["sim_sec_per_wall_sec"] / off["sim_sec_per_wall_sec"]
+    out = {
+        "workload": f"real_curl_1k shape ({n_servers} http.server x "
+                    f"{n_clients} curl, {fetches} fetches each)",
+        "aggregation": f"median-of-{reps}, interleaved",
+        "journal_off": off,
+        "journal_on": on,
+        "journal_overhead_rel": round(overhead, 4),
+    }
+    if overhead > 0.10:
+        out.setdefault("warnings", []).append(
+            f"guest journaling costs {overhead:.1%} of the real-binary "
+            f"rate (> 10%) — the per-reply journal append is leaking into "
+            f"the syscall service path")
+        log(f"managed_ckpt_overhead WARNING: journaling overhead "
+            f"{overhead:.1%} > 10% of the real_curl_1k rate")
+    log(f"managed_ckpt_overhead: journaling costs {overhead:+.1%} "
+        f"({off['sim_sec_per_wall_sec']} -> {on['sim_sec_per_wall_sec']} "
+        f"sim-s/wall-s median)")
+
+    # one real checkpoint->resume cycle: how much wall does a v5 reexec
+    # restore re-buy? (managed_smoke: 300 kB tgen fetch, ~1.7 s sim)
+    smoke = (ROOT / "examples" / "managed_smoke.yaml").read_text().replace(
+        "native/build/", str(ROOT / "native" / "build") + "/")
+    smoke_yaml = _P("/tmp/shadow-bench-ckptov-smoke.yaml")
+    smoke_yaml.write_text(smoke)
+    t0 = _t.perf_counter()
+    Controller(load_config(str(smoke_yaml), {
+        "general.data_directory": _fresh_dir(
+            "/tmp/shadow-bench-ckptov-base")}), mirror_log=False).run()
+    base_wall = _t.perf_counter() - t0
+    src_dir = _fresh_dir("/tmp/shadow-bench-ckptov-src")
+    Controller(load_config(str(smoke_yaml), {
+        "general.data_directory": src_dir,
+        "general.checkpoint_every": "500 ms"}), mirror_log=False).run()
+    cks = sorted(_P(src_dir).glob("checkpoints/ckpt_*.ckpt"))
+    assert cks, f"no checkpoints written under {src_dir}"
+    t0 = _t.perf_counter()
+    ctl, resume_at = _ckpt.load_checkpoint(
+        cks[-1], cfg=load_config(str(smoke_yaml), {
+            "general.data_directory": _fresh_dir(
+                "/tmp/shadow-bench-ckptov-res")}), mirror_log=False)
+    res = ctl.run(resume_at=resume_at)
+    resume_wall = _t.perf_counter() - t0
+    assert res["process_errors"] == [], res["process_errors"]
+    hdr = _ckpt.read_header(cks[-1])
+    out["resume"] = {
+        "config": "examples/managed_smoke.yaml",
+        "snapshot_sim_ns": int(hdr["sim_time_ns"]),
+        "resume_wall_seconds": round(resume_wall, 3),
+        "uninterrupted_wall_seconds": round(base_wall, 3),
+        # a reexec restore re-runs the prefix, so ratio ~1 is the design
+        # point; >>1 would mean restore machinery is adding real cost
+        "resume_vs_uninterrupted": round(resume_wall / base_wall, 2),
+    }
+    log(f"managed_ckpt_overhead resume: v5 reexec restore from sim "
+        f"{hdr['sim_time_ns']} ns took {resume_wall:.2f}s wall vs "
+        f"{base_wall:.2f}s uninterrupted "
+        f"({out['resume']['resume_vs_uninterrupted']}x)")
+    return out
+
+
+def managed_fidelity_audit(n_clients: int = 24,
+                           nbytes: int = 100_000) -> dict:
+    """Model-fidelity audit (checkpoint-PR headline row): the SAME
+    topology runs the tgen protocol twice — once with the real C binaries
+    (tgen_srv streaming to ring_probe under the preload shim), once with
+    the Python model twins (models.tgen TGenServer/TGenClient) — and the
+    row publishes both fetch-latency distributions side by side. Both
+    latencies are sim-time observables, so each leg is deterministic and
+    runs once: the real client self-times its fetch through the
+    virtualized monotonic clock (``fetch_ns=`` on ring_probe stdout,
+    t0 before connect, t1 after EOF drain), the twin records
+    ``completion_times`` at the last payload byte. Client starts are
+    staggered wide enough that transfers never overlap — tgen_srv
+    accepts serially while the twin server is concurrent, and queueing
+    skew would otherwise masquerade as protocol infidelity."""
+    import re as _re
+    import subprocess
+    from pathlib import Path as _P
+
+    import numpy as np
+
+    from shadow_tpu.config import parse_config
+    from shadow_tpu.core.controller import Controller
+
+    build = ROOT / "native" / "build"
+    subprocess.run(["make", "-C", str(ROOT / "native")], check=True,
+                   capture_output=True)
+    import sys as _sys
+    _sys.path.insert(0, str(ROOT / "tools"))
+    from gen_benchmarks import random_gml
+
+    rng = np.random.default_rng(7)
+    g = 16
+    gml = random_gml(rng, g, min_lat_ms=5, max_lat_ms=60, max_loss=0.0,
+                     bw_choices=("50 Mbit", "100 Mbit", "1 Gbit"))
+    srv_node = int(rng.integers(0, g))
+    cli_nodes = [int(rng.integers(0, g)) for _ in range(n_clients)]
+    starts = [f"{2000 + i * 400} ms" for i in range(n_clients)]
+
+    def doc(real):
+        if real:
+            srv = {"path": str(build / "tgen_srv"),
+                   "args": ["8080", str(n_clients)],
+                   "expected_final_state": {"exited": 0}}
+            cli = lambda i: {"path": str(build / "ring_probe"),
+                             "args": ["11.0.0.1", "8080", str(nbytes)],
+                             "start_time": starts[i],
+                             "expected_final_state": {"exited": 0}}
+        else:
+            srv = {"path": "pyapp:shadow_tpu.models.tgen:TGenServer",
+                   "args": ["8080"]}
+            cli = lambda i: {
+                "path": "pyapp:shadow_tpu.models.tgen:TGenClient",
+                "args": [str(nbytes), "1", "serial", "8080", "srv"],
+                "start_time": starts[i],
+                "expected_final_state": {"exited": 0}}
+        hosts = {"srv": {"network_node_id": srv_node,
+                         "ip_addr": "11.0.0.1", "processes": [srv]}}
+        for i in range(n_clients):
+            hosts[f"cli{i}"] = {"network_node_id": cli_nodes[i],
+                                "processes": [cli(i)]}
+        return {
+            "general": {"stop_time": f"{4 + (n_clients * 400) // 1000}s",
+                        "seed": 7},
+            "network": {"graph": {"type": "gml", "inline": gml}},
+            "hosts": hosts,
+        }
+
+    # real leg: every client prints its self-timed fetch_ns
+    d = _fresh_dir("/tmp/shadow-bench-fidelity-real")
+    cfg = parse_config(doc(True), {"general.data_directory": d})
+    res = Controller(cfg, mirror_log=False).run()
+    assert res["process_errors"] == [], res["process_errors"][:3]
+    real_ns = []
+    for i in range(n_clients):
+        out = _P(f"{d}/hosts/cli{i}/ring_probe.0.stdout").read_text()
+        assert f"bytes={nbytes}" in out and "eof=1" in out, (i, out)
+        real_ns.append(int(_re.search(r"fetch_ns=(\d+)", out).group(1)))
+
+    # model-twin leg: same nodes, same stagger, same byte counts
+    d = _fresh_dir("/tmp/shadow-bench-fidelity-model")
+    cfg = parse_config(doc(False), {"general.data_directory": d})
+    ctl = Controller(cfg, mirror_log=False)
+    res = ctl.run()
+    assert res["process_errors"] == [], res["process_errors"][:3]
+    model_ns = []
+    for h in ctl.hosts:
+        if h.name.startswith("cli"):
+            (proc,) = h.processes
+            (elapsed,) = proc.app.completion_times
+            model_ns.append(int(elapsed))
+    assert len(model_ns) == n_clients, len(model_ns)
+
+    def pcts(ns):
+        s = sorted(ns)
+        p = lambda q: round(s[min(len(s) - 1, int(q * len(s)))] / 1e6, 3)
+        return {"p50_ms": p(0.50), "p90_ms": p(0.90), "p99_ms": p(0.99),
+                "min_ms": round(s[0] / 1e6, 3),
+                "max_ms": round(s[-1] / 1e6, 3)}
+
+    # clients pair 1:1 across legs (same index = same graph node, same
+    # start, same byte count), so the per-pair error IS the model gap
+    rel = sorted(r / m - 1.0 for r, m in zip(real_ns, model_ns))
+    out = {
+        "workload": f"{n_clients} single-fetch clients x {nbytes} B, "
+                    f"one serial tgen server, {g}-node random graph",
+        "real_binaries": pcts(real_ns),
+        "model_twin": pcts(model_ns),
+        "paired_rel_error": {
+            "median": round(rel[len(rel) // 2], 4),
+            "worst": round(max(rel, key=abs), 4),
+        },
+        "semantics": "real = ring_probe connect->EOF self-timed via the "
+                     "virtualized clock; twin = TGenClient connect->last "
+                     "payload byte (completion_times)",
+    }
+    if abs(out["paired_rel_error"]["median"]) > 0.25:
+        out.setdefault("warnings", []).append(
+            f"median real-vs-twin fetch latency gap "
+            f"{out['paired_rel_error']['median']:+.1%} (> 25%) — the "
+            f"Python twin is drifting from what the real protocol does "
+            f"on this transport")
+        log(f"managed_fidelity_audit WARNING: median real-vs-twin gap "
+            f"{out['paired_rel_error']['median']:+.1%} > 25%")
+    log(f"managed_fidelity_audit: real p50 "
+        f"{out['real_binaries']['p50_ms']} ms vs twin p50 "
+        f"{out['model_twin']['p50_ms']} ms "
+        f"(median paired gap {out['paired_rel_error']['median']:+.1%}, "
+        f"{n_clients} paired fetches)")
     return out
 
 
@@ -1870,6 +2145,8 @@ def main() -> None:
         detail["managed_dense_contended"] = managed_dense_contended()
         detail["real_curl"] = real_binary_bench()
         detail["real_curl_1k"] = real_curl_1k()
+        detail["managed_ckpt_overhead"] = managed_ckpt_overhead()
+        detail["managed_fidelity_audit"] = managed_fidelity_audit()
         detail["tor_100k"] = tor_100k()
         detail["tor_100k"]["tor_1_10_sharded"] = tor_sharded()
         detail["tor_400_sweep_10seed"] = tor_400_sweep()

@@ -40,6 +40,8 @@ int main(int argc, char **argv) {
     fprintf(stderr, "bad ip %s\n", argv[1]);
     return 2;
   }
+  struct timespec t0; /* fetch epoch: raw clock, same service as below */
+  syscall(SYS_clock_gettime, CLOCK_MONOTONIC, &t0);
   if (connect(fd, (struct sockaddr *)&addr, sizeof addr) != 0) {
     perror("connect");
     return 1;
@@ -69,8 +71,15 @@ int main(int argc, char **argv) {
   struct timespec ts;
   syscall(SYS_clock_gettime, CLOCK_MONOTONIC, &ts);
   close(fd);
+  /* fetch_ns: connect -> request -> payload -> EOF drain, measured BY
+   * THE GUEST through the virtualized monotonic clock — i.e. the fetch
+   * latency the real binary itself observes in simulated time (the
+   * model-fidelity audit in bench.py compares this against the Python
+   * tgen twin's completion_times on the same topology) */
   printf("ring-probe bytes=%ld recvs=%ld polls=%ld ready=%ld eof=%ld "
-         "mono_s=%ld\n",
-         got, recvs, polls, ready, eof_zero, (long)ts.tv_sec);
+         "mono_s=%ld fetch_ns=%lld\n",
+         got, recvs, polls, ready, eof_zero, (long)ts.tv_sec,
+         (long long)(ts.tv_sec - t0.tv_sec) * 1000000000LL +
+             (long long)(ts.tv_nsec - t0.tv_nsec));
   return 0;
 }

@@ -16,10 +16,25 @@ states, packed store batches) exports to plain Python structures through
 per-type ``_export_state`` reducers and rebuilds on load (the header's
 ``colcore`` ABI fingerprint refuses a mismatched build by name). The only
 non-snapshottable state is runtime plumbing (scheduler threads, the JAX
-device plane, the Core object itself, open pcap streams, real
-managed-process OS state), which is either rebuilt on restore (scheduler,
-device, C core — all result-transparent by existing invariants) or refused
-up front with a clear error (managed processes, pcap).
+device plane, the Core object itself, open pcap streams), which is rebuilt
+on restore (scheduler, device, C core — all result-transparent by existing
+invariants) or refused up front with a clear error (pcap).
+
+Managed (real-binary) configs cannot ride the pickle path — a guest is a
+live OS process whose kernel state (memory image, file table, thread
+stacks) no userspace snapshot can capture. Format v5 covers them anyway by
+leaning on the determinism contract instead: a managed checkpoint is a
+**re-execution snapshot** — a small JSON record of the round boundary
+(sim time, round count, canonical state digest, and a per-guest cursor
+into the journaled observation stream, ``guest_oplogs/``) plus the live
+commands applied so far. Restore rebuilds the controller from the config
+and re-executes deterministically from round 0; at the recorded boundary
+the recomputed state digest and guest journal cursors are verified against
+the snapshot (mismatch fails by name), after which the run simply
+continues — the guests are already live on the transport, so no splice is
+needed. The continuation is byte-identical to the uninterrupted run
+because the whole prefix is. Restore cost is O(prefix re-execution), not
+O(state) — the honest trade for real-binary fidelity.
 
 Before the state walk, ``engine.flush_all()`` materializes every in-flight
 loss-draw batch. Resolving draws early is result-identical by construction
@@ -83,9 +98,15 @@ FORMAT = "shadow_tpu-checkpoint"
 #: Version 4: the StreamSender SACK/rtx scoreboards became SORTED LISTS
 #: (canonical by construction for the columnar transport export,
 #: network/devtransport.py); a version-3 checkpoint would restore sets
-#: where the bisect-based scoreboard code expects lists. See
-#: MIGRATION.md.
-VERSION = 4
+#: where the bisect-based scoreboard code expects lists. Version 5:
+#: managed (real-binary) configs are checkpointable as re-execution
+#: snapshots (header ``mode: "reexec"`` + a JSON payload of round cursor,
+#: state digest, per-guest journal cursors, and applied live commands —
+#: no pickle); pure-pyapp configs keep the pickle payload unchanged. A
+#: pre-v5 checkpoint can never describe a managed run (older builds
+#: refused managed configs at save), so a managed-marked header below v5
+#: is refused by name. See MIGRATION.md.
+VERSION = 5
 #: config keys that may legitimately differ between the checkpointing run
 #: and the resuming invocation (run-location, snapshot policy, and the
 #: data-plane implementation toggle — never simulation semantics:
@@ -321,6 +342,15 @@ def config_digest(cfg) -> str:
     return hashlib.sha256(blob).hexdigest()
 
 
+def config_has_managed(cfg) -> bool:
+    """True when any configured process is a real managed executable (not
+    ``pyapp:``) — such configs checkpoint as re-execution snapshots."""
+    from shadow_tpu.host.process import PluginProcess
+
+    return any(not PluginProcess.is_plugin_path(popts.path)
+               for hopts in cfg.hosts for popts in hopts.processes)
+
+
 # -- save / load --------------------------------------------------------------
 
 def checkpoint_path(ckpt_dir: Path, sim_time: int,
@@ -347,6 +377,12 @@ def save_checkpoint(controller, now: int) -> Path:
     path = checkpoint_path(
         ckpt_dir, now,
         shard=controller.shard_id if n_shards > 1 else None)
+    if config_has_managed(controller.cfg):
+        if n_shards > 1:
+            raise CheckpointError(
+                "managed re-execution checkpoints are single-process only "
+                "(sim_shards=1); sharded managed runs cannot checkpoint")
+        return _save_reexec(controller, now, path)
     # colcore build/ABI fingerprint: when the C engine is attached the
     # payload carries C-exported state, and resuming it on a mismatched
     # colcore build must fail fast by name instead of diverging silently
@@ -386,6 +422,51 @@ def save_checkpoint(controller, now: int) -> Path:
     return path
 
 
+def _save_reexec(controller, now: int, path: Path) -> Path:
+    """Write a v5 re-execution snapshot for a managed config: a JSON
+    header + JSON payload (no pickle). The payload pins everything the
+    restore must reproduce and verify — the round cursor, the canonical
+    state digest at this boundary, each guest's journal cursor, and the
+    live commands applied so far (embedded so the restore re-applies them
+    at the same boundaries without needing the original run directory)."""
+    g, hosts = state_digest(controller, now)
+    commands = []
+    cmd_log = Path(controller.data_dir) / "commands.jsonl"
+    if cmd_log.is_file():
+        from shadow_tpu.live import load_command_log
+
+        commands = [r for r in load_command_log(cmd_log) if r["t"] <= now]
+    header = {
+        "format": FORMAT,
+        "version": VERSION,
+        "mode": "reexec",
+        "managed": True,
+        "python": list(sys.version_info[:2]),
+        "sim_time_ns": now,
+        "rounds": controller.rounds,
+        "events": controller.events,
+        "config_digest": config_digest(controller.cfg),
+        "colcore": None,  # no exported C state rides a reexec snapshot
+        "sim_shards": 1,
+    }
+    payload = {
+        "digest": g,
+        "hosts": hosts,
+        "cursors": controller.guest_journal_cursors(),
+        "commands": commands,
+    }
+    tmp = path.with_suffix(".tmp")
+    try:
+        with open(tmp, "wb") as f:
+            f.write(json.dumps(header, sort_keys=True).encode() + b"\n")
+            f.write(json.dumps(payload, sort_keys=True).encode() + b"\n")
+    except BaseException:
+        tmp.unlink(missing_ok=True)
+        raise
+    os.replace(tmp, path)
+    return path
+
+
 def read_header(path) -> dict:
     with open(path, "rb") as f:
         line = f.readline()
@@ -407,10 +488,22 @@ def load_checkpoint(path, cfg=None, mirror_log: bool = True):
     volatile keys are applied to the restored controller.
     """
     header = read_header(path)
+    if header.get("managed") and int(header.get("version") or 0) < 5:
+        # can only be a hand-rolled or corrupted artifact: every build
+        # that could SAVE a managed checkpoint already wrote format v5
+        # re-execution cursors. Name the real requirement instead of the
+        # generic version complaint.
+        raise CheckpointError(
+            f"{path}: managed guests require checkpoint format v5 "
+            f"(deterministic re-execution cursors); this file claims "
+            f"version {header.get('version')} — re-checkpoint the run "
+            f"with a current build")
     if header.get("version") != VERSION:
         raise CheckpointError(
             f"{path}: checkpoint version {header.get('version')} != "
             f"supported {VERSION}")
+    if header.get("mode") == "reexec":
+        return _load_reexec(path, header, cfg, mirror_log)
     if tuple(header.get("python", ())) != tuple(sys.version_info[:2]):
         raise CheckpointError(
             f"{path}: written by Python "
@@ -497,6 +590,72 @@ def load_checkpoint(path, cfg=None, mirror_log: bool = True):
         f"resumed from {path}: sim time {now} ns, round {controller.rounds}, "
         f"{controller.events} events")
     return controller, now
+
+
+def _load_reexec(path, header, cfg, mirror_log: bool):
+    """Restore a managed re-execution snapshot: rebuild the controller
+    from the config and hand back ``(controller, None)`` — the caller's
+    ``run(resume_at=None)`` then re-executes the deterministic prefix from
+    round 0. The snapshot's round cursor, state digest, and per-guest
+    journal cursors are armed on the controller and verified when the
+    round loop reaches the recorded boundary (divergence fails by name);
+    the run keeps going from there, byte-identical to the uninterrupted
+    run. Live commands recorded up to the boundary ride the snapshot and
+    are re-applied at their original boundaries via the replay plane."""
+    if cfg is None:
+        raise CheckpointError(
+            f"{path}: a managed re-execution snapshot rebuilds the "
+            f"simulation from its config — pass the config to "
+            f"load_checkpoint (the CLI's --resume-from does)")
+    if int(getattr(cfg.general, "sim_shards", 1)) != 1:
+        raise CheckpointError(
+            f"{path}: managed re-execution snapshots resume at "
+            f"sim_shards=1 only")
+    want, got = header["config_digest"], config_digest(cfg)
+    if want != got:
+        raise CheckpointError(
+            f"{path}: config mismatch — the checkpoint was written "
+            f"under a different simulation config (digest {want[:12]} "
+            f"vs {got[:12]}). Resume with the original config; only "
+            f"data_directory / checkpoint / digest / logging keys may "
+            f"differ.")
+    with open(path, "rb") as f:
+        f.readline()
+        try:
+            payload = json.loads(f.readline())
+        except ValueError as exc:
+            raise CheckpointError(
+                f"{path}: corrupt re-execution snapshot payload") from exc
+    commands = payload.get("commands") or []
+    if commands and not cfg.general.replay_commands:
+        # the resume invocation has no command log of its own: replay the
+        # embedded records so runtime faults land on the same boundaries
+        ddir = Path(cfg.general.data_directory)
+        ddir.mkdir(parents=True, exist_ok=True)
+        replay = ddir / "reexec_commands.jsonl"
+        with open(replay, "w") as f:
+            for rec in commands:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+        cfg.general.replay_commands = str(replay)
+    from shadow_tpu.core.controller import Controller
+
+    controller = Controller(cfg, mirror_log=mirror_log)
+    if payload.get("cursors") and controller.guest_journal_dir is None:
+        # the resume invocation may not itself checkpoint, but cursor
+        # verification needs the re-executed guests journaled
+        controller.guest_journal_dir = controller.data_dir / "guest_oplogs"
+    controller._reexec_verify = {
+        "path": str(path),
+        "t": int(header["sim_time_ns"]),
+        "rounds": int(header["rounds"]),
+        "digest": payload["digest"],
+        "cursors": payload.get("cursors") or {},
+    }
+    controller.log.info(
+        f"restoring {path} by deterministic re-execution: re-running "
+        f"rounds 0..{header['rounds']} (sim {header['sim_time_ns']} ns), "
+        f"digest-verified at the snapshot boundary")
+    return controller, None
 
 
 def _apply_telemetry_resume(controller, want, now: int) -> None:
@@ -698,22 +857,19 @@ def emit_digest(controller, sim_now: int) -> None:
 def validate_config_checkpointable(cfg) -> None:
     """THE checkpointability policy, single source of truth — pure config
     inspection, so it can fail at build time before anything is
-    constructed. Refused: real managed-process guests (live OS process
-    state cannot be snapshotted) and pcap hosts (captures stream to disk
-    mid-run). See README 'Checkpoint & resume'."""
-    from shadow_tpu.host.process import PluginProcess
-
+    constructed. Refused: pcap hosts (captures stream to disk mid-run).
+    Managed (real-binary) configs are checkpointable since format v5 —
+    they snapshot as re-execution cursors, not pickles — but only at
+    sim_shards=1. See README 'Checkpoint & resume'."""
     for hopts in cfg.hosts:
         if hopts.pcap_enabled:
             raise ValueError(
                 f"checkpoint_every is unsupported with pcap capture: host "
                 f"{hopts.name!r} has pcap_enabled (captures stream to disk "
                 f"mid-run); disable one of the two")
-        for popts in hopts.processes:
-            if not PluginProcess.is_plugin_path(popts.path):
-                raise ValueError(
-                    f"checkpoint_every is unsupported with managed native "
-                    f"processes: host {hopts.name!r} runs {popts.path!r} "
-                    f"(real OS process state cannot be snapshotted — see "
-                    f"README 'Checkpoint & resume'); use pyapp: workloads "
-                    f"or disable checkpointing")
+    if config_has_managed(cfg) \
+            and int(getattr(cfg.general, "sim_shards", 1)) != 1:
+        raise ValueError(
+            "checkpoint_every with managed native processes requires "
+            "sim_shards=1: a re-execution snapshot re-runs the whole "
+            "simulation prefix in one process")

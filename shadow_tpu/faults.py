@@ -190,18 +190,10 @@ class FaultInjector:
         stop = cfg.general.stop_time
         self.actions = build_timeline(cfg, self.graph, controller._by_name,
                                       stop)
-        # host lifecycle events need the plugin process model (a crash of a
-        # real managed executable would have to kill a live OS process
-        # mid-round — out of scope; fail at build, not mid-simulation)
-        for a in self.actions:
-            if a.kind in ("host_down", "host_up"):
-                for hid in a.host_ids:
-                    for p in controller.hosts[hid].processes:
-                        if not hasattr(p, "kill"):
-                            raise ValueError(
-                                f"faults: host {controller.hosts[hid].name!r} "
-                                f"runs a managed executable; host_down/churn "
-                                f"support pyapp processes only")
+        # host lifecycle events cover both process models: pyapp plugins
+        # and managed executables share the kill/spawn crash contract
+        # (ManagedProcess.kill SIGKILLs + reaps the real guest at the
+        # boundary; Host.reboot respawns a fresh instance)
         self.idx = 0
         self.applied = 0
         #: telemetry hook (telemetry/collector.py::record_fault): called

@@ -379,6 +379,42 @@ def _member_config(config_path: str, overrides: dict, sweep_dir,
     return load_config(config_path, over, cache_doc=True)
 
 
+def _reap_stale_guests(d) -> int:
+    """SIGKILL real-binary guests leaked by an interrupted managed member
+    run. A worker that died mid-run (SIGKILL, OOM) never reaped the
+    executables it spawned; their pids live in the seed directory's
+    ``guest_pids.jsonl`` side plane. Pids get recycled on a busy box, so
+    each one is verified against the recorded clock-page path via
+    ``/proc/<pid>/environ`` before the kill: only a process that still
+    carries OUR shm path in its environment is one of ours."""
+    import signal
+
+    p = Path(d) / "guest_pids.jsonl"
+    if not p.is_file():
+        return 0
+    killed = 0
+    for raw in p.read_text().splitlines():
+        try:
+            rec = json.loads(raw)
+            pid, shm = int(rec["pid"]), str(rec.get("shm") or "")
+        except (ValueError, KeyError, TypeError):
+            continue
+        if pid <= 1 or not shm:
+            continue
+        try:
+            env = Path(f"/proc/{pid}/environ").read_bytes()
+        except OSError:
+            continue  # already gone (the common case)
+        if shm.encode() not in env:
+            continue  # pid recycled by an unrelated process: hands off
+        try:
+            os.kill(pid, signal.SIGKILL)
+            killed += 1
+        except OSError:
+            pass
+    return killed
+
+
 def _run_one_seed(config_path: str, overrides: dict, sweep_dir,
                   seed: int) -> dict:
     """Run one member simulation into its per-seed directory and write
@@ -394,10 +430,28 @@ def _run_one_seed(config_path: str, overrides: dict, sweep_dir,
             f"chaos hook: seed {seed} configured to fail ({CHAOS_ENV})")
     d = seed_dir(sweep_dir, seed)
     # a fresh member run owns its directory: stale partial output from an
-    # earlier attempt must not survive into the hashes
+    # earlier attempt must not survive into the hashes — and a managed
+    # attempt that died mid-run may have leaked real guest processes
+    # that would fight the re-run for ptrace/SIGSTOP control; reap them
+    # before the tree goes away (the pid registry lives in it)
+    stale = _reap_stale_guests(d)
+    if stale:
+        print(f"fleet: seed {seed}: reaped {stale} stale guest "
+              f"process(es) from an interrupted earlier attempt",
+              file=sys.stderr, flush=True)
     shutil.rmtree(d, ignore_errors=True)
     t0 = _walltime.perf_counter()
     cfg = _member_config(config_path, overrides, sweep_dir, seed)
+    # mark the attempt in-flight BEFORE spawning anything: if this worker
+    # dies mid-run, --resume sees status "running" (not "ok") and treats
+    # the seed as failed instead of trusting the partial tree
+    d.mkdir(parents=True, exist_ok=True)
+    _write_json(d / SEED_MANIFEST, {
+        "format": MANIFEST_FORMAT,
+        "seed": int(seed),
+        "status": "running",
+        "config_digest": _ckpt.config_digest(cfg),
+    })
     ctl = Controller(cfg, mirror_log=False)
     result = ctl.run()
     if ctl.telemetry is not None:

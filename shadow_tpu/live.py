@@ -167,16 +167,12 @@ def materialize_command(controller, norm, t):
         a.dst = _resolve_nodes(norm.get("dst_nodes") or [], controller.graph,
                                all_but=a.src)
     else:
+        # host lifecycle works for BOTH process models: pyapp plugins and
+        # managed executables expose the same kill/spawn crash contract
+        # (ManagedProcess.kill SIGKILLs + reaps the real guest; reboot
+        # respawns a fresh instance, deterministically at the boundary)
         a.host_ids = _resolve_hosts(norm.get("hosts") or [],
                                     controller._by_name)
-        for hid in a.host_ids:
-            h = controller.hosts[hid]
-            for p in h.processes:
-                if not hasattr(p, "kill"):
-                    raise ValueError(
-                        f"live command {kind!r}: host {h.name!r} runs a "
-                        f"managed executable; host lifecycle commands "
-                        f"support pyapp processes only")
     acts = [a]
     dur = norm.get("duration")
     if dur is not None:

@@ -27,6 +27,7 @@ accept (server side) intentionally return -ENOSYS until implemented.
 
 from __future__ import annotations
 
+import hashlib
 import mmap
 import os
 import socket
@@ -643,6 +644,47 @@ class GuestThread:
         self.joined = False  # slot recyclable only once dead AND joined
 
 
+class GuestJournal:
+    """Append-only record of one managed guest's observation stream: every
+    worker reply (turn grant) with its result, issuing thread slot, and the
+    emulated clock word published alongside it. Two consumers: (a) the
+    running ``(n, sha256)`` cursor is the guest's position in its
+    replayable history — recorded in v5 re-execution snapshots
+    (shadow_tpu/checkpoint.py) and verified when a restore's re-executed
+    prefix reaches the snapshot boundary; (b) the jsonl file itself
+    (``<data_dir>/guest_oplogs/``) is byte-identical run to run, so a
+    cursor mismatch can be diffed down to the first divergent grant. Pure
+    side plane: nothing here feeds simulation state, so journaling on/off
+    cannot change results (the bench's ``managed_ckpt_overhead`` row
+    measures its wall cost)."""
+
+    __slots__ = ("path", "n", "_h", "_f")
+
+    def __init__(self, path: Path) -> None:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        self.path = path
+        self.n = 0
+        self._h = hashlib.sha256()
+        self._f = open(path, "a")
+
+    def record(self, slot: int, ret: int, clk: int) -> None:
+        self.n += 1
+        self._h.update(b"%d|%d|%d|%d\n" % (self.n, slot, ret, clk))
+        if self._f is not None:
+            self._f.write('{"n":%d,"slot":%d,"ret":%d,"clk":%d}\n'
+                          % (self.n, slot, ret, clk))
+
+    def cursor(self) -> dict:
+        if self._f is not None:
+            self._f.flush()
+        return {"n": self.n, "sha": self._h.hexdigest()}
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
 class ManagedProcess(ProcessLifecycle):
     """Lifecycle + syscall service for one real executable in the sim.
 
@@ -656,6 +698,11 @@ class ManagedProcess(ProcessLifecycle):
         self.name = f"{Path(opts.path).name}.{index}"
         self.exit_code: Optional[int] = None
         self.running = False
+        self.spawned = False  # ever spawned (host reboot respects start_time)
+        #: observation journal (GuestJournal) when the controller armed
+        #: re-execution snapshots; survives crash/respawn — one stream
+        #: per record
+        self._journal = None
         self.app = None  # parity with PluginProcess (no plugin object)
         self.proc: Optional[subprocess.Popen] = None
         self.mem: Optional[ProcessMemory] = None
@@ -804,11 +851,50 @@ class ManagedProcess(ProcessLifecycle):
             old.close()
 
     # -- lifecycle ---------------------------------------------------------
+    def _reset_for_respawn(self) -> None:
+        """A host reboot respawns this record as a fresh instance
+        (Host.reboot -> spawn after kill): drop every per-life table the
+        crashed guest left behind, exactly as __init__ built them. The
+        observation journal (if any) deliberately survives — a respawned
+        guest's grants continue the same per-record stream, which is what
+        makes crash/reboot runs re-execution-checkpointable."""
+        self.proc = None
+        self.real_pid = None
+        self.mem = None
+        self.sock = None
+        self.fds = {}
+        self._next_vfd = VFD_BASE
+        self._files = {}
+        self.threads = {}
+        self._cur = None
+        self._next_slot = 1
+        self._ready = []
+        self._pumping = False
+        self.futexes = {}
+        self.fd_cloexec = set()
+        self._ring_offered = set()
+        self._sock_rings = {}
+        self._oplog_vs = {}
+        self._ready_watch = set()
+        self._spin_t = -1
+        self._spin_n = 0
+        self._exit_hint = None
+        self._signal_hint = None
+        self.children = []
+        self._embryos = {}
+        self._unapplied = 0
+        self.audit_native = set()
+        self.native_vfs = set()
+        self.vfs = HostVFS(self)
+        self.vfs.on_mutate = self._ino_mutate
+
     def spawn(self) -> None:
         lib = _shim_lib()
         if not lib.exists():
             raise FileNotFoundError(
                 f"{lib} missing — build the native shim first: make -C native")
+        if self.spawned:
+            self._reset_for_respawn()
         self._new_clock_page()
         ddir = self._time_path.parent  # hosts/<name>/ (capture files etc.)
         # detlint: ok(envread): guests inherit the operator environment
@@ -861,8 +947,17 @@ class ManagedProcess(ProcessLifecycle):
         self._cur = self.threads[0]
         self.mem = ProcessMemory(self.proc.pid)
         self.running = True
+        self.spawned = True
         self.host.counters.add("processes_spawned", 1)
         self._open_strace()
+        ctl = self.host.controller
+        jdir = getattr(ctl, "guest_journal_dir", None)
+        if jdir is not None and self._journal is None:
+            self._journal = GuestJournal(
+                jdir / f"{self.host.name}.{self.name}.guest_oplog.jsonl")
+        note = getattr(ctl, "note_guest_pid", None)
+        if note is not None:  # hand-rolled controllers in tests lack it
+            note(self)
 
         # handshake with a real-time bound: a binary the preload cannot
         # enter (static link, setuid) would otherwise hang the scheduler
@@ -1044,6 +1139,9 @@ class ManagedProcess(ProcessLifecycle):
         self.sock = parent
         self.threads = {0: GuestThread(0, parent)}
         main = self.threads[0]
+        note = getattr(self.host.controller, "note_guest_pid", None)
+        if note is not None:
+            note(self)
         self._ring_offered.clear()  # the replacement shim starts unmapped
         self._sock_rings.clear()  # re-offered on first use (same rings)
         self._ready_watch.clear()  # fresh page: readiness region is zero
@@ -1082,9 +1180,15 @@ class ManagedProcess(ProcessLifecycle):
         return nr, args
 
     def _reply(self, th: GuestThread, ret: int) -> None:
-        self._time_map[:8] = struct.pack("<q", emulated(self.host.now))
+        clk = emulated(self.host.now)
+        self._time_map[:8] = struct.pack("<q", clk)
         if self._fast_plane and self.parent_proc is None:
             self._refresh_fast_state()
+        if self._journal is not None:
+            # every grant the guest will ever observe passes through here
+            # (strict turn-taking): the journal cursor after this record
+            # IS the guest's position in its replayable history
+            self._journal.record(th.slot, ret, clk)
         th.sock.sendall(struct.pack("<q", ret))
 
     def _refresh_fast_state(self) -> None:
@@ -1424,9 +1528,11 @@ class ManagedProcess(ProcessLifecycle):
         self._signal_hint = -9  # killed by the watchdog
         self._kill_now()
         self._exited()
-        # the host is going down: reap sibling MANAGED guests first —
-        # Host.crash only kills processes exposing .kill (pyapp plugins);
-        # a sibling's live OS process must not outlive its 'down' host
+        # the host is going down: reap sibling MANAGED guests first, with
+        # exit accounting — the stall killed the whole host, and a
+        # sibling's live OS process must not outlive it (Host.crash's
+        # .kill sweep would leave them respawnable, but a watchdog-downed
+        # host records its guests as dead, not power-cycled)
         for p in host.processes:
             if p is not self:
                 reap = getattr(p, "reap", None)
@@ -1658,10 +1764,18 @@ class ManagedProcess(ProcessLifecycle):
         self._cur = self.threads[0]
         self.parent_proc = parent
         self.running = True
+        self.spawned = True
         self._open_strace()
+        jdir = getattr(ctl, "guest_journal_dir", None)
+        if jdir is not None:
+            self._journal = GuestJournal(
+                jdir / f"{host.name}.{self.name}.guest_oplog.jsonl")
         host.processes.append(self)
         ctl.processes.append(self)
         host.counters.add("processes_spawned", 1)
+        note = getattr(ctl, "note_guest_pid", None)
+        if note is not None:
+            note(self)
         return self
 
     def _kick(self) -> None:
@@ -2529,6 +2643,23 @@ class ManagedProcess(ProcessLifecycle):
                     f"+++ native passthrough: {sorted(self.audit_native)} "
                     "+++\n")
             self._strace.write(f"+++ exited with {code} +++\n")
+        self._teardown()
+        if self._journal is not None:
+            # terminal: exit_code is about to be set, so this record can
+            # never respawn (Host.reboot skips exited processes) — the
+            # journal stream is complete
+            self._journal.close()
+        self.finish(code)
+        if (self.parent_proc is not None and self.parent_proc.running):
+            self.parent_proc._child_exited(self)
+
+    def _teardown(self) -> None:
+        """Release every worker-side runtime handle of the current guest
+        life: capture files, strace stream, fd-table references, thread
+        channels, embryo channels, the IPC socket. Shared by ``_exited``
+        (process death — exit accounting follows) and ``kill`` (host
+        crash — no exit status, the record stays respawnable)."""
+        if self._strace is not None:
             self._strace.close()
             self._strace = None
         for f in self._files.values():
@@ -2550,9 +2681,35 @@ class ManagedProcess(ProcessLifecycle):
         if self.sock is not None:
             self.sock.close()
             self.sock = None
-        self.finish(code)
-        if (self.parent_proc is not None and self.parent_proc.running):
-            self.parent_proc._child_exited(self)
+
+    def kill(self) -> None:
+        """Host crash (shadow_tpu/faults.py host_down/churn, live
+        ``host_down``): the guest dies with its host. SIGKILL + reap the
+        real OS process, release every worker-side handle, and record NO
+        exit status — in the simulated world the host lost power, the
+        process neither exited nor was signaled (the same contract as
+        PluginProcess.kill), so ``Host.reboot`` respawns a fresh instance
+        via spawn(). Deterministic: crashes apply at round boundaries,
+        where every guest is parked between turns, and ``Host.crash`` has
+        already torn down the transport side before processes are killed
+        (endpoint.close on a crashed endpoint is a no-op)."""
+        if not self.running:
+            return
+        self._kill_now()
+        if self.proc is not None:
+            self.proc.wait()  # reap now: the zombie pid was ours until here
+            self.proc = None
+        if self._strace is not None:
+            self._strace.write("+++ killed: host crash +++\n")
+        self._teardown()
+        self.running = False
+        if self.parent_proc is not None:
+            # a fork child dies for good with its host: the rebooted
+            # PARENT re-forks deterministically, so this record must not
+            # respawn as a fresh top-level guest — record the signal
+            # death (exit_code set directly: nothing "exited" in the
+            # simulated world, so no processes_exited accounting)
+            self.exit_code = -9
 
     # -- syscall emulation -------------------------------------------------
     def _service(self, nr: int, args):

@@ -229,18 +229,39 @@ def test_load_rejects_garbage_and_wrong_python(tmp_path):
         ckpt.load_checkpoint(trunc)
 
 
-def test_checkpoint_rejects_managed_processes_and_pcap(tmp_path):
+def test_checkpoint_rejects_pcap_and_sharded_managed(tmp_path):
+    """Managed configs are checkpointable since format v5 (re-execution
+    snapshots) — but only single-process: the sharded combination is
+    refused up front. The pcap refusal is unchanged."""
     d = yaml.safe_load(BASE)
     d["hosts"]["server"]["processes"][0]["path"] = "/bin/sh"
     cfg = parse_config(d, {
         "general.data_directory": str(tmp_path / "mg"),
         "general.checkpoint_every": "1s"})
-    with pytest.raises(ValueError, match="managed native processes"):
-        Controller(cfg, mirror_log=False)
+    ckpt.validate_config_checkpointable(cfg)  # no longer refused
+    shard = parse_config(d, {
+        "general.data_directory": str(tmp_path / "mg2"),
+        "general.checkpoint_every": "1s",
+        "general.sim_shards": 2})
+    with pytest.raises(ValueError, match="sim_shards=1"):
+        ckpt.validate_config_checkpointable(shard)
     cfg = _cfg(tmp_path, "pc", **{"general.checkpoint_every": "1s",
                                   "hosts.server.pcap_enabled": True})
     with pytest.raises(ValueError, match="pcap"):
         Controller(cfg, mirror_log=False)
+
+
+def test_load_refuses_pre_v5_managed_checkpoint_by_name(tmp_path):
+    """A managed-marked header below format v5 predates re-execution
+    cursors: refused with a message naming the required version, before
+    the generic version gate gets a chance to confuse the story."""
+    old = tmp_path / "old_managed.ckpt"
+    header = {"format": ckpt.FORMAT, "version": 4, "managed": True,
+              "python": list(sys.version_info[:2]), "config_digest": "x",
+              "sim_time_ns": 0}
+    old.write_bytes(json.dumps(header).encode() + b"\n")
+    with pytest.raises(ckpt.CheckpointError, match="v5"):
+        ckpt.load_checkpoint(old)
 
 
 # -- graceful shutdown -------------------------------------------------------
@@ -400,6 +421,172 @@ def test_watchdog_converts_held_turn_to_host_down(tmp_path):
     assert host.counters.get("guest_watchdog_kills") == 1
     assert host.counters.get("host_crashes") == 1
     assert any("guest watchdog" in ln for ln in host._log_lines)
+
+
+# -- managed guests: re-execution checkpoints (format v5) --------------------
+
+BUILD = ROOT / "native" / "build"
+
+
+def _managed_missing() -> list:
+    """Why the real-binary matrix legs cannot run here (empty = they can):
+    the same kernel-capability probe the shim suite uses, plus the build
+    artifacts themselves."""
+    missing = []
+    for b in ("libshadow_shim.so", "tgen_srv", "ring_probe"):
+        if not (BUILD / b).is_file():
+            missing.append(f"native/build/{b}")
+    if not missing:
+        try:
+            from test_native_shim import _env_caps_missing
+            missing += _env_caps_missing()
+        except ImportError as e:
+            missing.append(f"capability probe unavailable ({e})")
+    return missing
+
+
+_MANAGED_MISSING = _managed_missing()
+managed_only = pytest.mark.skipif(
+    bool(_MANAGED_MISSING),
+    reason="managed guest plane unavailable: "
+           + ", ".join(map(str, _MANAGED_MISSING)))
+
+#: real unmodified binaries mid-transfer: tgen_srv streams 300 kB to
+#: ring_probe (the shim fast plane's dedicated client), finishing around
+#: sim 1.7s — so a 500ms checkpoint cadence lands snapshots squarely
+#: inside the transfer
+MANAGED_BASE = f"""
+general:
+  stop_time: 30s
+  seed: 11
+network:
+  graph:
+    type: gml
+    inline: |
+      graph [
+        directed 0
+        node [ id 0 host_bandwidth_up "100 Mbit" host_bandwidth_down "100 Mbit" ]
+        node [ id 1 host_bandwidth_up "50 Mbit" host_bandwidth_down "50 Mbit" ]
+        edge [ source 0 target 1 latency "20 ms" ]
+        edge [ source 0 target 0 latency "5 ms" ]
+        edge [ source 1 target 1 latency "5 ms" ]
+      ]
+hosts:
+  server:
+    network_node_id: 0
+    ip_addr: 11.0.0.1
+    processes:
+      - path: {BUILD / "tgen_srv"}
+        args: ["8080", "1"]
+        expected_final_state: {{exited: 0}}
+  client:
+    network_node_id: 1
+    processes:
+      - path: {BUILD / "ring_probe"}
+        args: ["11.0.0.1", "8080", "300000"]
+        start_time: 1s
+        expected_final_state: {{exited: 0}}
+"""
+
+
+@pytest.fixture(params=["fastpath_on", "fastpath_off"])
+def shim_fastpath(request, monkeypatch):
+    """Both sides of the shim fast plane: the module global gates the
+    worker side (read per-process at spawn), the env var gates the C shim
+    inside the child."""
+    on = request.param == "fastpath_on"
+    import shadow_tpu.native.managed as managed
+
+    monkeypatch.setenv("SHADOW_TPU_SHIM_FASTPATH", "1" if on else "0")
+    monkeypatch.setattr(managed, "_FASTPATH_ON", on)
+    return on
+
+
+@managed_only
+def test_managed_reexec_checkpoint_resume_identity(tmp_path, shim_fastpath):
+    """The headline v5 property, with real binaries on both fast-plane
+    legs: a checkpoint taken mid-transfer resumes by re-execution into
+    the uninterrupted run's exact host tree, summary, and digest stream —
+    and the snapshot boundary is digest- and journal-cursor-verified."""
+    dig = {"general.state_digest_every": 5}
+    full_s, full_t = _run(tmp_path, "full", doc=MANAGED_BASE, **dig)
+    assert full_s["process_errors"] == []
+    src_s, src_t = _run(tmp_path, "src", doc=MANAGED_BASE,
+                        **{"general.checkpoint_every": "500 ms", **dig})
+    assert src_s == full_s  # journaling + snapshots are transparent
+    assert src_t == full_t
+    paths = _checkpoints(tmp_path, "src")
+    hdr = ckpt.read_header(paths[0])
+    assert hdr["mode"] == "reexec" and hdr["managed"] is True
+    assert hdr["version"] == 5
+    # the mid-transfer snapshot carries a journal cursor per live guest
+    assert list((tmp_path / "src" / "guest_oplogs").glob("*.jsonl"))
+    res_s, res_t = _resume(tmp_path, "res", paths[0], doc=MANAGED_BASE,
+                           **dig)
+    assert res_t == full_t
+    assert res_s == full_s
+    assert ((tmp_path / "res" / ckpt.DIGEST_FILE).read_bytes()
+            == (tmp_path / "full" / ckpt.DIGEST_FILE).read_bytes())
+
+
+@managed_only
+def test_managed_reexec_detects_divergence(tmp_path):
+    """A reexec snapshot resumed under a DIFFERENT observation stream
+    must fail loudly at the boundary, not silently continue: corrupt the
+    recorded state digest and expect the by-name divergence error."""
+    _run(tmp_path, "src", doc=MANAGED_BASE,
+         **{"general.checkpoint_every": "500 ms"})
+    p = _checkpoints(tmp_path, "src")[0]
+    header, payload = p.read_text().splitlines()[:2]
+    doc = json.loads(payload)
+    doc["digest"] = "0" * len(doc["digest"])
+    tampered = tmp_path / "tampered.ckpt"
+    tampered.write_text(header + "\n" + json.dumps(doc) + "\n")
+    cfg = _cfg(tmp_path, "res", doc=MANAGED_BASE)
+    ctl, resume_at = ckpt.load_checkpoint(tampered, cfg, mirror_log=False)
+    try:
+        with pytest.raises(ckpt.CheckpointError, match="diverged"):
+            ctl.run(resume_at=resume_at)
+    finally:
+        # the abort path skips _finalize: reap the real guests ourselves
+        for p in ctl.processes:
+            p.kill()
+        ctl.scheduler.shutdown()
+
+
+@managed_only
+def test_managed_host_down_respawns_and_stays_deterministic(tmp_path):
+    """Live host lifecycle on a managed host (the old by-name refusal):
+    a replayed host_down mid-transfer SIGKILLs the real guest, host_up
+    respawns a fresh instance, and the whole faulted run is byte-stable
+    under --replay-commands."""
+    cmds = tmp_path / "cmds.jsonl"
+    cmds.write_text(json.dumps(
+        {"cmd": {"cmd": "host_down", "hosts": ["client"],
+                 "duration": "300000000 ns"},
+         "round": 0, "seq": 0, "t": 1_200_000_000}) + "\n")
+    ov = {"general.replay_commands": str(cmds),
+          "general.state_digest_every": 5}
+    runs = []
+    for tag in ("a", "b"):
+        s, t = _run(tmp_path, tag, doc=MANAGED_BASE, **ov)
+        assert s["counters"]["host_crashes"] == 1
+        assert s["counters"]["host_boots"] == 1
+        # 3 spawns = server + client + the post-reboot client respawn
+        assert s["counters"]["processes_spawned"] == 3
+        runs.append((s, t))
+    assert runs[0] == runs[1]
+    assert ((tmp_path / "a" / ckpt.DIGEST_FILE).read_bytes()
+            == (tmp_path / "b" / ckpt.DIGEST_FILE).read_bytes())
+    # and a checkpoint taken AFTER the fault embeds the command stream:
+    # resuming it replays the crash/respawn prefix identically
+    _run(tmp_path, "src", doc=MANAGED_BASE,
+         **{"general.checkpoint_every": "500 ms", **ov})
+    late = _checkpoints(tmp_path, "src")[-1]
+    res_s, res_t = _resume(tmp_path, "res", late, doc=MANAGED_BASE,
+                           **{"general.state_digest_every": 5})
+    assert res_t == runs[0][1]
+    assert res_s == runs[0][0]
 
 
 # -- schema --------------------------------------------------------------

@@ -197,6 +197,108 @@ def test_fleet_resume_skips_completed(tmp_path):
     assert s3["skipped_resume"] == []
 
 
+def test_reap_stale_guests_is_pid_reuse_safe(tmp_path):
+    """``_reap_stale_guests`` kills exactly the recorded guests that
+    still carry our clock-page path in their environment. A recycled pid
+    (live process, unrelated env), a long-dead pid, and a garbage record
+    are all left alone — the registry must never let a resume shoot an
+    innocent process."""
+    import os
+    import subprocess
+
+    shm = str(tmp_path / "hosts" / "h" / "p.0.clock")
+    ours = subprocess.Popen(["sleep", "300"],
+                            env={"SHADOW_TIME_SHM": shm})
+    other = subprocess.Popen(["sleep", "300"], env={"PATH": os.environ["PATH"]})
+    try:
+        reg = tmp_path / "guest_pids.jsonl"
+        reg.write_text(
+            json.dumps({"pid": ours.pid, "host": "h", "proc": "p.0",
+                        "shm": shm}) + "\n"
+            + json.dumps({"pid": other.pid, "host": "h", "proc": "q.0",
+                          "shm": shm}) + "\n"          # pid recycled
+            + json.dumps({"pid": 2 ** 22 + 12345, "host": "h",
+                          "proc": "r.0", "shm": shm}) + "\n"  # long dead
+            + "not json\n")
+        assert fleet._reap_stale_guests(tmp_path) == 1
+        assert ours.wait(timeout=10) == -9
+        assert other.poll() is None, "reaped an unrelated process!"
+    finally:
+        other.kill()
+        other.wait()
+        if ours.poll() is None:
+            ours.kill()
+            ours.wait()
+    # empty dir: a no-op, not an error
+    assert fleet._reap_stale_guests(tmp_path / "nope") == 0
+
+
+def _managed_fleet_yaml(tmp_path) -> Path:
+    """managed_smoke.yaml with binary paths made absolute (the example
+    keeps them repo-root-relative for ci.sh; fleet workers inherit
+    whatever cwd pytest ran from)."""
+    doc = yaml.safe_load((ROOT / "examples" / "managed_smoke.yaml")
+                         .read_text())
+    for h in doc["hosts"].values():
+        for p in h["processes"]:
+            p["path"] = str(ROOT / p["path"])
+    out = tmp_path / "managed_fleet.yaml"
+    out.write_text(yaml.safe_dump(doc))
+    return out
+
+
+def test_fleet_managed_sweep_and_partial_run_resume(tmp_path):
+    """A multi-seed managed (real-binary) sweep completes end-to-end,
+    and --resume treats a seed dir left mid-run by a dead worker (status
+    "running" + stale guest pids) as failed: the leaked guest is reaped
+    and the seed re-runs to ok."""
+    from test_checkpoint import _MANAGED_MISSING
+
+    if _MANAGED_MISSING:
+        pytest.skip("managed guest plane unavailable: "
+                    + ", ".join(map(str, _MANAGED_MISSING)))
+    import subprocess
+
+    cfgp = _managed_fleet_yaml(tmp_path)
+    sweep_dir = tmp_path / "sweep"
+    over = {"general.state_digest_every": 10}
+    s1 = fleet.FleetRunner(str(cfgp), [11, 12], jobs=2,
+                           sweep_dir=sweep_dir, overrides=over,
+                           quiet=True).run()
+    assert s1["completed"] == [11, 12]
+    for s in (11, 12):
+        man = json.loads((fleet.seed_dir(sweep_dir, s)
+                          / fleet.SEED_MANIFEST).read_text())
+        assert man["status"] == "ok"
+        assert man["process_errors"] == []
+    # forge the interrupted-attempt state a SIGKILLed worker leaves
+    d = fleet.seed_dir(sweep_dir, 12)
+    man = json.loads((d / fleet.SEED_MANIFEST).read_text())
+    (d / fleet.SEED_MANIFEST).write_text(json.dumps(
+        {"format": man["format"], "seed": 12, "status": "running",
+         "config_digest": man["config_digest"]}))
+    shm = str(d / "hosts" / "server" / "tgen_srv.0.clock")
+    stale = subprocess.Popen(["sleep", "300"],
+                             env={"SHADOW_TIME_SHM": shm})
+    try:
+        (d / "guest_pids.jsonl").write_text(json.dumps(
+            {"pid": stale.pid, "host": "server", "proc": "tgen_srv.0",
+             "shm": shm}) + "\n")
+        s2 = fleet.FleetRunner(str(cfgp), [11, 12], jobs=2,
+                               sweep_dir=sweep_dir, overrides=over,
+                               resume=True, quiet=True).run()
+        assert s2["skipped_resume"] == [11]  # the ok seed stood
+        assert s2["completed"] == [11, 12]
+        assert stale.wait(timeout=10) == -9, "stale guest not reaped"
+    finally:
+        if stale.poll() is None:
+            stale.kill()
+            stale.wait()
+    man = json.loads((d / fleet.SEED_MANIFEST).read_text())
+    assert man["status"] == "ok"
+    assert man["process_errors"] == []
+
+
 def test_fleet_member_failure_contained(tmp_path, monkeypatch):
     """One crashed seed is reported and the sweep continues — the
     "survives member failure" contract, driven through the chaos hook."""

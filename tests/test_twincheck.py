@@ -91,7 +91,7 @@ def test_abi_bump_without_migration_entry(tree):
 
 
 def test_version_bump_without_migration_entry(tree):
-    mutate(tree, "shadow_tpu/checkpoint.py", "VERSION = 4", "VERSION = 9")
+    mutate(tree, "shadow_tpu/checkpoint.py", "VERSION = 5", "VERSION = 9")
     assert "version-migration" in rules(twin_audit.audit(tree))
 
 

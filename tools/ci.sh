@@ -432,6 +432,46 @@ print(f"managed smoke OK: transfer byte-exact both legs, "
       f"the fast leg, observables bit-identical fast on/off")
 EOF
 
+echo "== managed-checkpoint smoke (managed_smoke.yaml: reexec snapshot mid-transfer, resume, identity) =="
+mckrun() {   # $1 = tag, rest = extra args
+    local tag=$1; shift
+    rm -rf "/tmp/ci-mckpt-$tag"
+    python -m shadow_tpu examples/managed_smoke.yaml --quiet --json-summary \
+        --data-directory "/tmp/ci-mckpt-$tag" --state-digest-every 5 "$@" \
+        > "/tmp/ci-mckpt-$tag.raw.json"
+    python -c 'import json,sys; from shadow_tpu.core.controller import VOLATILE_SUMMARY_KEYS as V; d=json.load(open(sys.argv[1])); [d.pop(k, None) for k in V]; print(json.dumps(d,sort_keys=True))' \
+        "/tmp/ci-mckpt-$tag.raw.json" > "/tmp/ci-mckpt-$tag.json"
+    # *.clock excluded for the same reason as the managed gate above
+    (cd "/tmp/ci-mckpt-$tag" && find hosts -type f ! -name "*.clock" \
+        | sort | xargs sha256sum) > "/tmp/ci-mckpt-$tag.hashes"
+}
+mckrun full
+mckrun src --checkpoint-every 500ms
+ck=$(ls /tmp/ci-mckpt-src/checkpoints/ckpt_*.ckpt | head -1)
+echo "resuming managed run from $ck (re-execution)"
+mckrun resume --resume-from "$ck"
+# the checkpointing run itself is unperturbed, and the resumed run
+# reproduces the uninterrupted one: summaries, host trees, digest stream
+diff /tmp/ci-mckpt-full.json /tmp/ci-mckpt-src.json
+diff /tmp/ci-mckpt-full.hashes /tmp/ci-mckpt-src.hashes
+diff /tmp/ci-mckpt-full.json /tmp/ci-mckpt-resume.json
+diff /tmp/ci-mckpt-full.hashes /tmp/ci-mckpt-resume.hashes
+cmp /tmp/ci-mckpt-full/state_digests.jsonl /tmp/ci-mckpt-resume/state_digests.jsonl
+python - "$ck" <<'EOF'
+import json, sys
+hdr = json.loads(open(sys.argv[1]).readline())
+assert hdr["mode"] == "reexec" and hdr["managed"] is True, hdr
+assert hdr["version"] == 5, hdr
+payload = json.loads(open(sys.argv[1]).read().splitlines()[1])
+assert payload["cursors"], "snapshot carries no guest journal cursors"
+for tag in ("full", "src", "resume"):
+    s = json.load(open(f"/tmp/ci-mckpt-{tag}.raw.json"))
+    assert s["process_errors"] == [], (tag, s["process_errors"])
+print(f"managed-checkpoint smoke OK: v5 reexec snapshot "
+      f"({len(payload['cursors'])} journal cursor(s)) resumed "
+      f"byte-identical — trees, summaries, digest stream")
+EOF
+
 echo "== live-ops smoke (gossip_churn: --follow attach + live link_down + replay tree-hash identity) =="
 rm -rf /tmp/ci-live /tmp/ci-live-replay /tmp/ci-live.sock
 # follower first: it retries the connect until the run binds the socket
