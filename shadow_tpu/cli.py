@@ -90,6 +90,14 @@ def build_parser() -> argparse.ArgumentParser:
         "reproducing an interactively driven run byte-identically",
     )
     p.add_argument(
+        "--supervise", action="store_true",
+        help="run under the self-healing supervisor (general.supervise): "
+        "liveness watchdogs name dead/wedged workers, the run auto-resumes "
+        "from the newest complete checkpoint with a bounded restart budget, "
+        "and an unrecoverable crash writes crash_report.json; tune with "
+        "--set general.supervise.max_restarts / .backoff",
+    )
+    p.add_argument(
         "--set",
         action="append",
         default=[],
@@ -131,6 +139,9 @@ def overrides_from_args(args: argparse.Namespace) -> dict:
         val = getattr(args, attr)
         if val is not None:
             ov[key] = val
+    if args.supervise:
+        # boolean flag: schema normalizes True -> defaults
+        ov["general.supervise"] = True
     for item in args.set:
         if "=" not in item:
             print(f"shadow_tpu: --set expects KEY=VALUE, got {item!r}",
@@ -179,7 +190,28 @@ def main(argv=None) -> int:
         ))
         return 0
 
-    if cfg.general.sim_shards > 1:
+    if cfg.general.supervise is not None:
+        # self-healing path (shadow_tpu/supervise.py): wraps the sharded or
+        # single-process run in restart-on-failure with checkpoint resume;
+        # the recovered result is byte-identical to an uninterrupted run
+        from shadow_tpu.checkpoint import CheckpointError
+        from shadow_tpu.supervise import SupervisorGaveUp, run_supervised
+
+        try:
+            result = run_supervised(cfg, mirror_log=not args.quiet,
+                                    resume_from=args.resume_from or None)
+        except FileNotFoundError as exc:
+            print(f"shadow_tpu: checkpoint not found: {exc}", file=sys.stderr)
+            return 2
+        except (ValueError, CheckpointError) as exc:
+            print(f"shadow_tpu: {exc}", file=sys.stderr)
+            return 2
+        except SupervisorGaveUp as exc:
+            # restart budget exhausted or unrecoverable failure class; the
+            # structured post-mortem is in <data_dir>/crash_report.json
+            print(f"shadow_tpu: {exc}", file=sys.stderr)
+            return 1
+    elif cfg.general.sim_shards > 1:
         # multi-process host partitioning (shadow_tpu/parallel/shards.py):
         # the parent coordinator replaces the single-process controller;
         # results are byte-identical at any shard count

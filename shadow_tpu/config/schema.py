@@ -112,6 +112,14 @@ class GeneralOptions:
     #: same round boundary it originally hit, so an interactively driven
     #: run replays byte-identically from config + command log. Volatile.
     replay_commands: Optional[str] = None
+    #: supervised self-healing (shadow_tpu/supervise.py): run under a
+    #: supervisor that detects dead/wedged workers and stalled guests,
+    #: auto-resumes from the newest complete checkpoint with a bounded
+    #: restart budget, and writes crash_report.json when the budget is
+    #: exhausted. ``{}`` / ``true`` = defaults (max_restarts 3, backoff
+    #: 1.0 s); None = off. Volatile: pure wall-clock policy — a
+    #: recovered run is byte-identical to an uninterrupted one.
+    supervise: Optional[dict] = None
 
 
 @dataclass
@@ -477,6 +485,26 @@ def parse_config(doc: dict, overrides: Optional[dict] = None) -> ConfigOptions:
                  "general.live_endpoint must be a socket path or 'auto'")
     if gen.get("replay_commands") is not None:
         g.replay_commands = str(gen["replay_commands"])
+    if gen.get("supervise") is not None:
+        sup = gen["supervise"]
+        if sup is True:
+            sup = {}
+        elif sup is False:
+            sup = None
+        if sup is not None:
+            _require(isinstance(sup, dict),
+                     "general.supervise must be a mapping (or true/false)")
+            unknown = set(sup) - {"max_restarts", "backoff"}
+            _require(not unknown,
+                     f"unknown general.supervise key(s) {sorted(unknown)}; "
+                     f"known: max_restarts, backoff")
+            sup = {"max_restarts": int(sup.get("max_restarts", 3)),
+                   "backoff": float(sup.get("backoff", 1.0))}
+            _require(sup["max_restarts"] >= 0,
+                     "general.supervise.max_restarts must be >= 0")
+            _require(sup["backoff"] >= 0,
+                     "general.supervise.backoff must be >= 0")
+        g.supervise = sup
 
     if doc.get("network"):
         cfg.network = doc["network"]

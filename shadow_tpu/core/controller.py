@@ -59,7 +59,7 @@ VOLATILE_SUMMARY_KEYS = ("wall_seconds", "sim_sec_per_wall_sec",
                          "phase_wall", "max_rss_mb", "device",
                          "device_windows_dispatched", "sim_shards",
                          "shards", "device_transport",
-                         "device_transport_engaged")
+                         "device_transport_engaged", "supervisor")
 
 
 class Controller:
@@ -84,6 +84,16 @@ class Controller:
     _live_seq = 0
     _replay_cmds = ()
     _replay_idx = 0
+
+    #: supervision plane (shadow_tpu/supervise.py). ``_supervised`` is
+    #: set by run_supervised: guest-watchdog stalls then escalate to the
+    #: supervisor (GuestStallError via ``_stall_escalate`` at the next
+    #: boundary) instead of the unsupervised host_down conversion.
+    #: ``_chaos`` is the env-armed fault injector (wall-clock plane;
+    #: class defaults keep old checkpoints restorable).
+    _supervised = False
+    _stall_escalate = None
+    _chaos = None
 
     def owns(self, hid: int) -> bool:
         return self.n_shards == 1 or hid % self.n_shards == self.shard_id
@@ -635,6 +645,16 @@ class Controller:
         gc_was_enabled = _gc.isenabled()
         _gc.disable()
         next_gc = _GC_EVERY_ROUNDS
+        # chaos harness (shadow_tpu/supervise.py): deterministic-round
+        # fault injection, armed only through the environment — one dict
+        # probe per run when off, one int compare per round when on
+        import os as _os
+
+        if _os.environ.get("SHADOW_TPU_CHAOS"):
+            from shadow_tpu.supervise import ChaosInjector
+
+            self._chaos = ChaosInjector.from_env(
+                self.data_dir, shard=self.shard_id, in_process=True)
         t0 = _walltime.perf_counter()
         dyn = cfg.experimental.use_dynamic_runahead
         faults = self.faults
@@ -686,6 +706,17 @@ class Controller:
         # total, and the round grid are identical to the scalar twin's
         devt = getattr(self.engine, "devt", None)
         while now < stop:
+            if self._chaos is not None:
+                self._chaos.maybe_fire(self.rounds, self)
+            if self._stall_escalate is not None:
+                # a managed guest stalled past its watchdog deadline
+                # under supervision: surface it at this boundary (before
+                # anything is emitted for the next round) so the
+                # supervisor can tear down and recover by re-execution
+                from shadow_tpu.supervise import GuestStallError
+
+                msg, self._stall_escalate = self._stall_escalate, None
+                raise GuestStallError(msg)
             if self.live is not None \
                     or self._replay_idx < len(self._replay_cmds):
                 # live-operations command plane (shadow_tpu/live.py):

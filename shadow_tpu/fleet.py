@@ -33,9 +33,15 @@ config.yaml --seeds 10 --jobs M``) packs M concurrent seeded simulations:
   ``data_directory = <sweep_dir>/seed_<s>`` — its host log tree, flow and
   metric streams, and digest stream land there, byte-identical to the
   same seed run standalone (tests/test_fleet.py).
-- Failure containment: a seed that raises (or a worker process that dies)
-  is recorded as failed in its manifest and the sweep continues; the
-  worker (or a respawned one) moves on to the next seed.
+- Failure containment with bounded retries: a seed that raises, a worker
+  process that dies, and a member that wedges past the EMA-derived stall
+  deadline (``_check_members``) are all routed through one retry budget
+  (``--retries``, supervise.py discipline) before counting as failed in
+  the manifest; the sweep continues either way. A member over the
+  per-member RSS ceiling (``--member-max-rss-mb``) is killed and NOT
+  retried — a leak leaks again. SIGINT mid-sweep tears down coherently:
+  in-flight members killed, leaked guests reaped, seeds recorded
+  ``interrupted``, and the partial summary stays a valid artifact.
 - ``--resume``: a partially-completed sweep re-runs only the seeds whose
   per-seed manifest is missing, failed, or was produced under a different
   config (checkpoint.config_digest identity).
@@ -76,8 +82,24 @@ SUMMARY_FORMAT = "shadow_tpu-sweep-summary"
 
 #: chaos hook for the failure-path gates (tests/test_fleet.py, ci.sh):
 #: comma-separated seeds that raise instead of running — exercising the
-#: crashed-member path without needing a genuinely broken config
+#: crashed-member path without needing a genuinely broken config. Unlike
+#: the KILL/WEDGE hooks below this one fires on EVERY attempt, so a
+#: chaos-failed seed exhausts its retry budget and lands in ``failed``.
 CHAOS_ENV = "SHADOW_TPU_FLEET_CHAOS_SEEDS"
+
+#: harder chaos hooks (shadow_tpu/supervise.py discipline): the worker
+#: SIGKILLs itself / wedges forever just before running the listed seed.
+#: Once-only via an O_EXCL marker under <sweep_dir>/chaos/, so the
+#: retried attempt runs clean and the sweep converges — this is how
+#: ci.sh proves detection + retry, not just failure accounting.
+CHAOS_KILL_ENV = "SHADOW_TPU_FLEET_CHAOS_KILL_SEEDS"
+CHAOS_WEDGE_ENV = "SHADOW_TPU_FLEET_CHAOS_WEDGE_SEEDS"
+
+#: fixed member-stall deadline override (wall seconds). Default policy is
+#: EMA-derived: max(supervise.stall_deadline_s(completed-seed wall EMA),
+#: 60) once at least one seed has completed — before that there is no
+#: basis for a deadline and members may run arbitrarily long.
+FLEET_STALL_ENV = "SHADOW_TPU_FLEET_STALL_S"
 
 #: member-side service discovery (read by network/devroute.py)
 SERVICE_ENV = "SHADOW_TPU_DRAW_SERVICE"
@@ -415,6 +437,34 @@ def _reap_stale_guests(d) -> int:
     return killed
 
 
+def _fleet_chaos(sweep_dir, seed: int) -> None:
+    """Worker-side hard-failure injection (CHAOS_KILL_ENV/CHAOS_WEDGE_ENV):
+    die or hang just before running the listed seed, once per sweep. The
+    O_EXCL marker is claimed BEFORE firing so recovery converges — the
+    parent detects the dead/wedged member, respawns, retries the seed,
+    and the second attempt finds the marker already claimed."""
+    import signal as _signal
+
+    for env, kind in ((CHAOS_KILL_ENV, "kill"), (CHAOS_WEDGE_ENV, "wedge")):
+        spec = os.environ.get(env, "")  # detlint: ok(envread): loop var over the SHADOW_TPU_FLEET_CHAOS_* module constants
+        if not spec or str(seed) not in spec.split(","):
+            continue
+        mark_dir = Path(sweep_dir) / "chaos"
+        mark_dir.mkdir(parents=True, exist_ok=True)
+        try:
+            fd = os.open(mark_dir / f"{kind}.s{seed}.fired",
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.close(fd)
+        except FileExistsError:
+            continue  # fired on an earlier attempt: this retry runs clean
+        print(f"fleet: CHAOS {kind} firing in worker for seed {seed}",
+              file=sys.stderr, flush=True)
+        if kind == "kill":
+            os.kill(os.getpid(), _signal.SIGKILL)
+        while True:  # wedge: hold the seed forever without progress
+            _walltime.sleep(3600)
+
+
 def _run_one_seed(config_path: str, overrides: dict, sweep_dir,
                   seed: int) -> dict:
     """Run one member simulation into its per-seed directory and write
@@ -428,6 +478,7 @@ def _run_one_seed(config_path: str, overrides: dict, sweep_dir,
     if chaos and str(seed) in chaos.split(","):
         raise RuntimeError(
             f"chaos hook: seed {seed} configured to fail ({CHAOS_ENV})")
+    _fleet_chaos(sweep_dir, seed)
     d = seed_dir(sweep_dir, seed)
     # a fresh member run owns its directory: stale partial output from an
     # earlier attempt must not survive into the hashes — and a managed
@@ -586,7 +637,8 @@ class FleetRunner:
                  sweep_dir, overrides: dict = None, resume: bool = False,
                  max_rss_mb: int = None, pin_cores: bool = True,
                  device_service: bool = True, quiet: bool = False,
-                 live_endpoint: str = None) -> None:
+                 live_endpoint: str = None, retries: int = 1,
+                 member_max_rss_mb: int = 0) -> None:
         self.config_path = str(config_path)
         self.seeds = [int(s) for s in seeds]
         if not self.seeds:
@@ -607,6 +659,18 @@ class FleetRunner:
         self._conns: list = []
         self._inflight: dict = {}  # worker idx -> seed
         self._respawns = 0
+        #: bounded retry budget per seed (the supervisor discipline —
+        #: supervise.run_supervised): a crashed, wedged, or raising seed
+        #: is requeued up to ``retries`` times before it counts as failed
+        self.retries = max(0, int(retries))
+        #: per-member RSS ceiling (MB, 0 = off): a member over it is
+        #: KILLED (failed manifest + crash report, no retry — a leak
+        #: leaks again), unlike max_rss_mb which only delays admission
+        self.member_max_rss_mb = max(0, int(member_max_rss_mb or 0))
+        self._attempts: dict = {}  # seed -> dispatch attempts so far
+        self._inflight_t: dict = {}  # worker idx -> dispatch monotonic
+        self._seed_wall_ema = 0.0  # completed-seed wall EMA (stall basis)
+        self._interrupted = False
         # sweep-level live endpoint (shadow_tpu/live.py): STATUS ONLY —
         # per-seed lifecycle records for dashboards. Runtime commands are
         # refused by name: a sweep is a batch of independent replayable
@@ -775,7 +839,28 @@ class FleetRunner:
                         target=_build_server, name="fleet-draw-server",
                         daemon=True)
                     server_thread.start()
-                self._dispatch_loop(pending, failed)
+                try:
+                    self._dispatch_loop(pending, failed)
+                except KeyboardInterrupt:
+                    # mid-sweep interrupt: tear down coherently instead
+                    # of unwinding through worker pipes — kill in-flight
+                    # members, reap the guests they leaked, record their
+                    # seeds as interrupted. The summary below is a valid
+                    # partial artifact; --resume finishes the sweep.
+                    self._interrupted = True
+                    self._log("interrupted — tearing down in-flight "
+                              "members")
+                    for k in list(self._inflight):
+                        seed = self._inflight[k]
+                        self._kill_member(k)
+                        try:
+                            _write_failed_manifest(self.sweep_dir, seed,
+                                                   "interrupted")
+                        except OSError:
+                            pass
+                        failed[seed] = "interrupted"
+                    self._inflight.clear()
+                    self._inflight_t.clear()
         finally:
             if server_thread is not None:
                 server_thread.join(timeout=120)
@@ -800,6 +885,10 @@ class FleetRunner:
             "skipped_resume": sorted(skipped),
             "failed": {str(s): failed[s] for s in sorted(failed)},
             "sweep_wall_seconds": round(wall, 3),
+            "exit_reason": ("interrupted" if self._interrupted
+                            else "completed"),
+            "retries": self.retries,
+            "respawns": self._respawns,
             **({"draw_service": {
                 "served_batches": self._server.served_batches,
                 "served_units": self._server.served_units,
@@ -847,6 +936,8 @@ class FleetRunner:
                     self._on_worker_death(k, pending, failed, idle)
                     continue
                 self._inflight[k] = seed
+                self._inflight_t[k] = _walltime.monotonic()
+                self._attempts[seed] = self._attempts.get(seed, 0) + 1
                 self._log(f"seed {seed} -> worker {k} "
                           f"({len(pending)} queued, "
                           f"{len(self._inflight)} resident)")
@@ -857,6 +948,7 @@ class FleetRunner:
             if not live:
                 break
             ready = _mpwait(live, timeout=0.5)
+            self._check_members(pending, failed, idle)
             for conn in ready:
                 k = self._conns.index(conn)
                 try:
@@ -869,6 +961,14 @@ class FleetRunner:
                 if op == "done":
                     _, seed, man = msg
                     self._inflight.pop(k, None)
+                    t0 = self._inflight_t.pop(k, None)
+                    if t0 is not None:
+                        # completed-seed wall EMA: the basis the member
+                        # stall deadline is derived from
+                        dt = _walltime.monotonic() - t0
+                        self._seed_wall_ema = (
+                            dt if self._seed_wall_ema == 0.0
+                            else 0.7 * self._seed_wall_ema + 0.3 * dt)
                     idle.append(k)
                     self._log(f"seed {seed} ok "
                               f"({man['wall_seconds']}s wall, "
@@ -879,35 +979,133 @@ class FleetRunner:
                                    "rounds": man["rounds"]})
                 elif op == "failed":
                     _, seed, err, tb = msg
-                    failed[seed] = err
                     self._inflight.pop(k, None)
+                    self._inflight_t.pop(k, None)
                     idle.append(k)
-                    self._log(f"seed {seed} FAILED: {err} — sweep "
-                              f"continues")
-                    self._publish({"type": "seed_failed", "seed": seed,
-                                   "error": err})
+                    self._seed_failed(seed, err, pending, failed)
                 else:
                     self._inflight.pop(k, None)
+                    self._inflight_t.pop(k, None)
                     idle.append(k)
 
+    def _seed_failed(self, seed: int, err: str, pending: list,
+                     failed: dict) -> None:
+        """One attempt at a seed failed (member raised, died, wedged, or
+        hit a ceiling). Bounded retry budget, the supervisor discipline:
+        requeue while attempts remain, else record failed — the final
+        failed manifest is whatever the last attempt wrote."""
+        attempts = self._attempts.get(seed, 1)
+        if attempts <= self.retries:
+            left = self.retries - attempts + 1
+            self._log(f"seed {seed} attempt {attempts} failed: {err} — "
+                      f"retrying ({left} retr{'y' if left == 1 else 'ies'}"
+                      f" left)")
+            self._publish({"type": "seed_retry", "seed": seed,
+                           "attempt": attempts, "error": err})
+            pending.append(seed)
+            return
+        failed[seed] = err
+        self._log(f"seed {seed} FAILED after {attempts} attempt(s): "
+                  f"{err} — sweep continues")
+        self._publish({"type": "seed_failed", "seed": seed, "error": err,
+                       "attempts": attempts})
+
+    def _member_deadline_s(self):
+        """Wall seconds an in-flight member may run before it counts as
+        wedged; None = no deadline yet (no completed-seed EMA basis)."""
+        fixed = float(os.environ.get(FLEET_STALL_ENV, "0") or 0.0)
+        if fixed > 0:
+            return fixed
+        if self._seed_wall_ema <= 0.0:
+            return None
+        from shadow_tpu.supervise import stall_deadline_s
+
+        # the supervise deadline curve over the seed-wall EMA, floored at
+        # a minute: seeds are whole simulations, not rounds
+        return max(stall_deadline_s(self._seed_wall_ema), 60.0)
+
+    def _kill_member(self, k: int) -> None:
+        """SIGKILL worker k and reap any real-binary guests its in-flight
+        managed seed leaked (guest_pids.jsonl side plane)."""
+        p = self._procs[k]
+        try:
+            if p is not None and p.is_alive():
+                p.kill()
+        except (OSError, AttributeError):
+            pass
+        if p is not None:
+            p.join(timeout=10)
+        seed = self._inflight.get(k)
+        if seed is not None:
+            _reap_stale_guests(seed_dir(self.sweep_dir, seed))
+
+    def _check_members(self, pending: list, failed: dict,
+                       idle: list) -> None:
+        """Liveness + resource policing of in-flight members, once per
+        dispatch-loop tick: (a) a member past the stall deadline is
+        wedged — kill it and retry the seed on a fresh worker; (b) a
+        member over the per-member RSS ceiling is leaking — kill it,
+        write a crash report, and do NOT retry (a leak leaks again)."""
+        if not self._inflight:
+            return
+        deadline = self._member_deadline_s()
+        now = _walltime.monotonic()
+        for k in list(self._inflight):
+            seed = self._inflight[k]
+            p = self._procs[k]
+            if self.member_max_rss_mb and p is not None and p.is_alive():
+                rss = _proc_rss_mb(p.pid)
+                if rss > self.member_max_rss_mb:
+                    err = (f"member RSS {rss:.0f} MB over the per-member "
+                           f"ceiling {self.member_max_rss_mb} MB — killed")
+                    self._log(f"seed {seed}: {err}")
+                    self._kill_member(k)
+                    from shadow_tpu import supervise as _sup
+
+                    d = seed_dir(self.sweep_dir, seed)
+                    d.mkdir(parents=True, exist_ok=True)
+                    try:
+                        _sup.write_crash_report(
+                            d, "member_rss_ceiling",
+                            extra={"seed": int(seed),
+                                   "rss_mb": round(rss, 1),
+                                   "ceiling_mb": self.member_max_rss_mb})
+                    except OSError:
+                        pass
+                    # exhaust the budget: an OOM-class failure is not
+                    # transient, rerunning it just OOMs the box later
+                    self._attempts[seed] = self.retries + 1
+                    self._on_worker_death(k, pending, failed, idle,
+                                          reason=err)
+                    continue
+            t0 = self._inflight_t.get(k)
+            if deadline is None or t0 is None or now - t0 <= deadline:
+                continue
+            err = (f"member wedged: no completion after {now - t0:.1f}s "
+                   f"(deadline {deadline:.1f}s) — killed by the fleet "
+                   f"watchdog")
+            self._log(f"seed {seed}: {err}")
+            self._kill_member(k)
+            self._on_worker_death(k, pending, failed, idle, reason=err)
+
     def _on_worker_death(self, k: int, pending: list,
-                         failed: dict, idle: list) -> None:
-        """A worker process died (hard crash, OOM kill): record its
-        in-flight seed as failed and respawn so the rest of the sweep
-        continues — one crashed seed never sinks the fleet."""
+                         failed: dict, idle: list,
+                         reason: str = None) -> None:
+        """A worker process died (hard crash, OOM kill, or the fleet
+        watchdog killed it): route its in-flight seed through the retry
+        budget and respawn so the rest of the sweep continues — one
+        crashed seed never sinks the fleet."""
         p = self._procs[k]
         code = p.exitcode if p is not None else None
         seed = self._inflight.pop(k, None)
+        self._inflight_t.pop(k, None)
         if seed is not None:
-            err = f"worker process died (exit code {code})"
-            failed[seed] = err
+            err = reason or f"worker process died (exit code {code})"
             try:
                 _write_failed_manifest(self.sweep_dir, seed, err)
             except OSError:
                 pass
-            self._log(f"seed {seed} FAILED: {err} — respawning worker")
-            self._publish({"type": "seed_failed", "seed": seed,
-                           "error": err})
+            self._seed_failed(seed, err, pending, failed)
         try:
             self._conns[k].close()
         except OSError:
@@ -915,7 +1113,8 @@ class FleetRunner:
         self._conns[k] = None
         self._procs[k] = None
         self._respawns += 1
-        if self._respawns > 2 * (len(self.seeds) + self.jobs):
+        if self._respawns > 2 * (len(self.seeds) * (self.retries + 1)
+                                 + self.jobs):
             raise RuntimeError(
                 "fleet: worker respawn limit exceeded — the environment "
                 "is killing workers faster than seeds can run")
@@ -1136,6 +1335,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="admission guard: pause handing out new seeds "
                     "while fleet RSS exceeds this (default: 80%% of "
                     "MemTotal; 0 disables)")
+    ps.add_argument("--member-max-rss-mb", type=int, default=0,
+                    metavar="MB",
+                    help="per-member RSS ceiling: a member over it is "
+                    "killed, its seed recorded failed with a crash "
+                    "report, and NOT retried (default 0 = off)")
+    ps.add_argument("--retries", type=int, default=1, metavar="N",
+                    help="bounded retry budget per seed: a crashed or "
+                    "wedged seed is requeued up to N times before it "
+                    "counts as failed (default 1; 0 disables)")
     ps.add_argument("--no-pin", action="store_true",
                     help="do not pin worker processes to cores")
     ps.add_argument("--no-device-service", action="store_true",
@@ -1212,7 +1420,8 @@ def main(argv=None) -> int:
             resume=args.resume, max_rss_mb=args.max_rss_mb,
             pin_cores=not args.no_pin,
             device_service=not args.no_device_service, quiet=args.quiet,
-            live_endpoint=args.live_endpoint)
+            live_endpoint=args.live_endpoint, retries=args.retries,
+            member_max_rss_mb=args.member_max_rss_mb)
         summary = runner.run()
     except FileNotFoundError as exc:
         print(f"fleet: config file not found: "
@@ -1222,6 +1431,9 @@ def main(argv=None) -> int:
         print(f"fleet: {exc}", file=sys.stderr)
         return 2
     print(json.dumps(summary) if args.json else render_report(summary))
+    if summary.get("exit_reason") == "interrupted":
+        return 130  # conventional SIGINT status; the summary above is a
+        # valid partial artifact and --resume finishes the sweep
     return 0 if not summary["failed"] else 1
 
 

@@ -7,7 +7,11 @@ Three capabilities over one AF_UNIX endpoint (``general.live_endpoint``):
   broadcasts newline-framed JSON records: heartbeats, raw
   ``metrics.jsonl``/``flows.jsonl`` lines as they are written, flow-group
   percentile snapshots, applied commands, and per-shard/per-seed status.
-  ``tools/metrics_report.py --follow`` renders them live.
+  ``tools/metrics_report.py --follow`` renders them live.  Supervised
+  runs (``--supervise``, shadow_tpu/supervise.py) additionally publish
+  ``{"type": "supervisor", "event": "restart", ...}`` records naming the
+  failure, the restart attempt, and the checkpoint being resumed from;
+  fleet sweeps publish ``seed_retry`` alongside ``seed_failed``.
 
 * **Runtime fault commands** — clients send the ``faults:`` timeline
   verbs (``link_down``/``link_up``/``link_degrade``/``host_down``/

@@ -756,6 +756,13 @@ class ManagedProcess(ProcessLifecycle):
         #: the host downed (spin-wait livelock containment; 0 = off)
         self._turn_timeout = float(
             host.controller.cfg.experimental.guest_turn_timeout or 0.0)
+        #: shim-fastpath liveness: SockRing positions at the last watchdog
+        #: timeout. A guest streaming through its rings in-shim makes no
+        #: syscalls for whole timeout windows, yet is doing real work —
+        #: the watchdog only fires once the rings are frozen across a full
+        #: window too (ring movement, NOT the clock-page ops counter: a
+        #: spin-wait livelock advances ops forever and would never fire)
+        self._shim_prog = None
         # reference: max_unapplied_cpu_latency — modeled syscall latency
         # accumulates and is applied to the clock in batches of this size
         # (fewer, coarser clock bumps; 0 = apply each immediately)
@@ -1416,6 +1423,14 @@ class ManagedProcess(ProcessLifecycle):
         while True:
             req = self._read_req(th)
             if req is _TIMEDOUT:
+                prog = self._ring_progress()
+                if prog is not None and prog != self._shim_prog:
+                    # the shim moved its fast-plane rings (consumed RX /
+                    # filled TX in-shim) during the window: the guest is
+                    # streaming without syscalls, not livelocked — re-arm
+                    # and keep waiting
+                    self._shim_prog = prog
+                    continue
                 self._watchdog_fire(th)
                 return
             if req is None:
@@ -1508,6 +1523,25 @@ class ManagedProcess(ProcessLifecycle):
                 return
             self.host.counters.add("syscalls", 1)
 
+    def _ring_progress(self):
+        """Shim-side SockRing cursor snapshot for the watchdog: RX read
+        positions and TX write positions are the two cursors ONLY the shim
+        advances (in-shim reads/writes, oplogged for later replay), so a
+        change between two timeout windows proves the guest is alive in
+        the fast plane. None when no live rings exist — then a silent
+        guest has no syscall-free way to make progress and the watchdog
+        fires on the first timeout, exactly as before the fast plane."""
+        snap = None
+        for fd, sr in self._sock_rings.items():
+            if sr.dead:
+                continue
+            rx_r = struct.unpack_from("<Q", sr.rx, 8)[0]
+            tx_w = struct.unpack_from("<Q", sr.tx, 16)[0]
+            if snap is None:
+                snap = []
+            snap.append((fd, rx_r, tx_w))
+        return None if snap is None else tuple(snap)
+
     def _watchdog_fire(self, th: GuestThread) -> None:
         """The guest held its turn past experimental.guest_turn_timeout
         wall seconds without making a syscall — a userspace spin-wait
@@ -1518,10 +1552,29 @@ class ManagedProcess(ProcessLifecycle):
         A stalled guest stalls every run, so the conversion is observed
         reproducibly; only the wall instant of detection varies."""
         host = self.host
+        ctl = host.controller
+        if getattr(ctl, "_supervised", False):
+            # supervised run (shadow_tpu/supervise.py): escalate instead of
+            # degrading in-sim. Kill the guest to unblock the pump, park
+            # the named reason on the controller — it raises GuestStallError
+            # at the next round boundary, and the supervisor restarts the
+            # whole run from its re-execution snapshot (or scratch), which
+            # regenerates every stream byte-identically. No in-sim
+            # accounting (counters, host.crash) may record the stall: the
+            # restarted run never saw it.
+            msg = (f"guest watchdog: {host.name}/{self.name} held its turn "
+                   f"for more than {self._turn_timeout:g}s wall without a "
+                   f"syscall or fast-plane ring progress (wedged guest) — "
+                   f"escalating to the supervisor")
+            ctl.log.error(msg)
+            ctl._stall_escalate = msg
+            self._kill_now()
+            self._exited()
+            return
         msg = (f"guest watchdog: {host.name}/{self.name} held its turn for "
                f"more than {self._turn_timeout:g}s wall without a syscall "
-               f"(spin-wait livelock?) — killing the guest and downing the "
-               f"host (host_down)")
+               f"or fast-plane ring progress (spin-wait livelock?) — "
+               f"killing the guest and downing the host (host_down)")
         host.controller.log.error(msg)
         host.log(msg, level="error")
         host.counters.add("guest_watchdog_kills", 1)

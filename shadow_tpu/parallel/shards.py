@@ -79,6 +79,9 @@ import numpy as np
 from shadow_tpu.core.controller import Controller, _GC_EVERY_ROUNDS
 from shadow_tpu.core.time import NS_PER_SEC, NS_PER_US, T_NEVER, format_time
 from shadow_tpu.host.process import PluginProcess
+from shadow_tpu.supervise import (STALL_CEILING_S, ChaosInjector,
+                                  ProgressPage, progress_name,
+                                  stall_deadline_s)
 from shadow_tpu.utils.counters import Counters
 from shadow_tpu.utils.logging import SimLogger
 
@@ -361,6 +364,17 @@ class _ShardWorker:
         self._exchange_wall = 0.0
         self._sync_wall = 0.0
         self._next_gc = _GC_EVERY_ROUNDS
+        #: liveness (shadow_tpu/supervise.py): this shard's slot on the
+        #: shared progress page — stamped at every round top, inside the
+        #: marker wait, and inside blocked ring writes, so only a shard
+        #: that is truly frozen ever goes stale (a shard merely blocked
+        #: on a wedged peer keeps stamping and the right one gets named)
+        self._prog = ProgressPage(progress_name(ring_tag), n_shards)
+        #: observed round-wall EMA, the base of the stall deadline
+        self._round_ema = 0.0
+        self._t_round_top = None
+        #: chaos harness (supervise.py): env-armed, this shard's events
+        self._chaos = ChaosInjector.from_env(ctl.data_dir, shard=shard_id)
         #: packed ingest (C engine attached): ring bytes parse straight
         #: into a CBatch — no tuple materialization per row
         self._packed_ingest = None
@@ -448,6 +462,7 @@ class _ShardWorker:
                 r.close()
             for r in self.rings_in.values():
                 r.close()
+            self._prog.close()
 
     # -- the free-running round loop ---------------------------------------
     def _free_run(self, m: dict) -> None:
@@ -474,6 +489,19 @@ class _ShardWorker:
         from shadow_tpu import checkpoint as _ckpt
 
         while now < stop:
+            # liveness: stamp the progress word FIRST (round + wall),
+            # then update the round-wall EMA the stall deadline derives
+            # from — chaos fires after the stamp so a wedge freezes an
+            # honest last-progress record for the peers to name
+            t_top = _walltime.monotonic()
+            if self._t_round_top is not None:
+                dt = t_top - self._t_round_top
+                self._round_ema = (dt if self._round_ema == 0.0
+                                   else 0.85 * self._round_ema + 0.15 * dt)
+            self._t_round_top = t_top
+            self._prog.stamp(self.k, ctl.rounds)
+            if self._chaos is not None:
+                self._chaos.maybe_fire(ctl.rounds, ctl)
             # ingest rows shipped at edges we have barrier-passed (the
             # markers for round `ctl.rounds` were consumed last
             # iteration, and rows precede markers in ring FIFO order,
@@ -745,12 +773,19 @@ class _ShardWorker:
     def _write_block(self, j: int, data: bytes) -> None:
         """Blocking ring write: while the peer's ring is full, keep
         draining our own inbound rings (the peer may itself be blocked
-        writing to us — draining is what guarantees global progress)."""
+        writing to us — draining is what guarantees global progress).
+        Stamping while blocked keeps this shard's liveness word fresh,
+        so a shard stuck behind a WEDGED peer is never misnamed as the
+        failure itself."""
         import os as _os
 
         ring = self.rings_out[j]
+        spins = 0
         while not ring.write(data):
             self._drain_rings()
+            spins += 1
+            if spins & 1023 == 0:
+                self._prog.stamp(self.k, self.ctl.rounds)
             _os.sched_yield()
 
     def _write_rows(self, j: int, rows: list) -> None:
@@ -793,11 +828,18 @@ class _ShardWorker:
     def _wait_markers(self, rnd: int) -> dict:
         """Spin (drain + sched_yield) until every peer's marker for
         ``rnd`` arrived. Checks the parent pipe for aborts on a coarse
-        cadence; a silent peer eventually raises instead of hanging."""
+        cadence. The wait is DEADLINED (supervise.stall_deadline_s over
+        the observed round-wall EMA): on expiry the missing peers'
+        progress stamps decide — a peer whose stamp is stale past the
+        deadline is dead or wedged and gets NAMED (shard id, last round,
+        stamp age); peers still stamping are merely slow and the wait
+        extends, up to the absolute ceiling."""
         import os as _os
 
         want = self.n - 1
-        deadline = _walltime.monotonic() + 3600.0
+        deadline_s = stall_deadline_s(self._round_ema)
+        t_wait0 = _walltime.monotonic()
+        deadline = t_wait0 + deadline_s
         spins = 0
         while True:
             got = self._markers.get(rnd)
@@ -806,6 +848,7 @@ class _ShardWorker:
             self._drain_rings()
             spins += 1
             if spins & 1023 == 0:
+                self._prog.stamp(self.k, rnd)  # waiting IS liveness
                 while self.conn.poll(0):
                     pm = self.conn.recv()
                     if pm[0] == "stop":
@@ -814,11 +857,40 @@ class _ShardWorker:
                         self._pending_cmds.append(pm[1])
                     elif pm[0] == "abort":
                         raise _PeerDied("parent aborted the run")
-                if _walltime.monotonic() > deadline:
-                    raise _PeerDied(
-                        f"shard {self.k}: no round-{rnd} marker from "
-                        f"peers within 3600s — a peer died or stalled")
+                now_w = _walltime.monotonic()
+                if now_w > deadline:
+                    stale = self._stale_peers(rnd, deadline_s)
+                    if stale:
+                        raise _PeerDied(
+                            f"shard {self.k}: no round-{rnd} marker "
+                            f"after {now_w - t_wait0:.1f}s (deadline "
+                            f"{deadline_s:.1f}s) — " + "; ".join(stale))
+                    if now_w - t_wait0 > STALL_CEILING_S:
+                        raise _PeerDied(
+                            f"shard {self.k}: no round-{rnd} marker "
+                            f"within {STALL_CEILING_S:.0f}s despite live "
+                            f"peer stamps — marker livelock")
+                    # every missing peer stamped recently: slow, not
+                    # dead — extend one deadline and keep waiting
+                    deadline = now_w + deadline_s
             _os.sched_yield()
+
+    def _stale_peers(self, rnd: int, deadline_s: float) -> list:
+        """Name the peers whose round-``rnd`` marker is missing AND whose
+        progress stamp is stale past the deadline."""
+        got = self._markers.get(rnd) or {}
+        out = []
+        for j in self.rings_in:
+            if j in got:
+                continue
+            age = self._prog.age_s(j)
+            if age > deadline_s:
+                r_j, _ns = self._prog.read(j)
+                out.append(
+                    f"shard {j} last stamped round {r_j} "
+                    f"{'never' if age == float('inf') else f'{age:.1f}s ago'}"
+                    f" (dead or wedged)")
+        return out
 
     def _checkpoint(self, now: int) -> None:
         from shadow_tpu import checkpoint as _ckpt
@@ -831,7 +903,9 @@ class _ShardWorker:
         if ctl.telemetry is not None:
             ctl.telemetry.sync(ctl)
         t0 = _walltime.perf_counter()
+        self._prog.stamp(self.k, ctl.rounds)  # a long save is not a stall
         path = _ckpt.save_checkpoint(ctl, now)
+        self._prog.stamp(self.k, ctl.rounds)
         ctl._ckpt_wall += _walltime.perf_counter() - t0
         self.conn.send(("ckpt_done", ctl.rounds, now, str(path)))
 
@@ -948,6 +1022,10 @@ class ShardedRun:
     (the exact decision twin of Controller._round_loop), merges output
     streams, and assembles the run summary."""
 
+    #: process-wide spawn counter: uniquifies ring/page tags across the
+    #: restart attempts a supervisor makes inside one wall second
+    _spawn_seq = 0
+
     def __init__(self, cfg, mirror_log: bool = True,
                  resume_from=None) -> None:
         validate_config_shardable(cfg)
@@ -1053,7 +1131,17 @@ class ShardedRun:
         ctx = mp.get_context("spawn")
         ring_bytes = int(os.environ.get("SHADOW_TPU_RING_BYTES",
                                         DEFAULT_RING_BYTES))
-        self._ring_tag = f"{os.getpid():x}{int(_walltime.time()) & 0xFFFF:x}"
+        # the spawn sequence keeps tags unique across supervised restart
+        # attempts inside one parent second (ring + page names collide
+        # otherwise if a prior attempt's unlink was lost)
+        seq = ShardedRun._spawn_seq
+        ShardedRun._spawn_seq += 1
+        self._ring_tag = (f"{os.getpid():x}"
+                          f"{int(_walltime.time()) & 0xFFFF:x}{seq:x}")
+        # liveness board (shadow_tpu/supervise.py): created before the
+        # workers so their attach in _ShardWorker.__init__ always finds it
+        self._prog = ProgressPage(progress_name(self._ring_tag), self.n,
+                                  create=True)
         self._rings = []
         for i in range(self.n):
             for j in range(self.n):
@@ -1108,6 +1196,10 @@ class ShardedRun:
         for r in getattr(self, "_rings", []):
             r.close()
             r.unlink()
+        if getattr(self, "_prog", None) is not None:
+            self._prog.close()
+            self._prog.unlink()
+            self._prog = None
 
     # -- stream assembly ---------------------------------------------------
     def _metrics_append(self, lines: list) -> None:
@@ -1292,6 +1384,9 @@ class ShardedRun:
         # parallel worker builds are warm-up (the single-process summary
         # likewise excludes Controller construction from wall_seconds)
         t0 = _walltime.perf_counter()
+        #: supervision MTTR anchor (supervise.run_supervised): the wall
+        #: instant this attempt reached ready, monotonic clock
+        self.t_first_ready = _walltime.monotonic()
         self._n_hosts = readies[0]["n_hosts"]
         self.events = sum(r["events"] for r in readies)
         mul = min(r["mul"] for r in readies)
@@ -1327,8 +1422,33 @@ class ShardedRun:
         telbuf: dict = {}   # round -> {shard: parts}
         self._last_seen = [self.rounds] * self.n
         stop_sent = [False] * self.n
+        # full-ring-stall backstop: peers name a SINGLE dead/wedged shard
+        # themselves (the _wait_markers deadline); only a stall of EVERY
+        # worker at once leaves no survivor to raise — the parent detects
+        # that from a frozen progress-page snapshot. The floor is larger
+        # than the worker-side one: simultaneous long checkpoints are the
+        # legitimate all-quiet case.
+        prog_snap = self._prog.snapshot()
+        prog_wall = _walltime.monotonic()
+        prog_ema = 0.0
+        parent_floor = float(os.environ.get(
+            "SHADOW_TPU_PARENT_STALL_FLOOR_S", "120"))
         try:
             while any(d is None for d in done):
+                snap = self._prog.snapshot()
+                now_w = _walltime.monotonic()
+                if snap != prog_snap:
+                    dt = now_w - prog_wall
+                    prog_ema = (dt if prog_ema == 0.0
+                                else 0.85 * prog_ema + 0.15 * dt)
+                    prog_snap, prog_wall = snap, now_w
+                elif (now_w - prog_wall
+                      > max(stall_deadline_s(prog_ema), parent_floor)):
+                    raise _ShardError(
+                        f"full-ring stall: no shard has stamped progress "
+                        f"for {now_w - prog_wall:.1f}s (rounds "
+                        f"{[r for r, _s in snap]}) — every worker is "
+                        f"wedged or the box is livelocked")
                 if self._interrupt is not None:
                     for k in range(self.n):
                         if done[k] is None and not stop_sent[k]:

@@ -325,6 +325,92 @@ def test_fleet_member_failure_contained(tmp_path, monkeypatch):
     assert s2["skipped_resume"] == [71]
 
 
+def test_fleet_crashed_seed_retried(tmp_path, monkeypatch):
+    """A worker SIGKILLed mid-seed (hard chaos hook) is detected via
+    pipe EOF, the worker respawned, and the seed RETRIED within its
+    bounded budget — the sweep converges with nothing failed."""
+    monkeypatch.setenv(fleet.CHAOS_KILL_ENV, "80")
+    sweep_dir = tmp_path / "sweep"
+    runner = fleet.FleetRunner(
+        str(CHURN_YAML), [80, 81], jobs=2, sweep_dir=sweep_dir,
+        overrides=dict(COMMON), quiet=True)
+    summary = runner.run()
+    assert summary["completed"] == [80, 81]
+    assert summary["failed"] == {}
+    assert summary["respawns"] == 1
+    assert (sweep_dir / "chaos" / "kill.s80.fired").is_file()
+    man = json.loads((fleet.seed_dir(sweep_dir, 80)
+                      / fleet.SEED_MANIFEST).read_text())
+    assert man["status"] == "ok"
+
+
+def test_fleet_wedged_member_detected_and_retried(tmp_path, monkeypatch):
+    """A member that wedges forever (hard chaos hook) trips the fleet
+    stall watchdog — killed, NAMED, respawned, seed retried to ok; the
+    sweep never hangs on one stuck worker."""
+    monkeypatch.setenv(fleet.CHAOS_WEDGE_ENV, "90")
+    monkeypatch.setenv(fleet.FLEET_STALL_ENV, "6")
+    sweep_dir = tmp_path / "sweep"
+    runner = fleet.FleetRunner(
+        str(CHURN_YAML), [90], jobs=1, sweep_dir=sweep_dir,
+        overrides=dict(COMMON), quiet=True)
+    summary = runner.run()
+    assert summary["completed"] == [90]
+    assert summary["failed"] == {}
+    assert summary["respawns"] == 1
+
+
+def test_fleet_sweep_interrupt_partial_summary(tmp_path):
+    """SIGINT mid-sweep: coherent teardown — in-flight members killed,
+    their seeds recorded "interrupted" in failed manifests, the partial
+    sweep_summary.json written with exit_reason interrupted, and the
+    conventional 130 exit status. --resume can finish such a sweep."""
+    import os
+    import signal as _signal
+    import subprocess
+    import sys
+    import time
+
+    sweep = tmp_path / "sweep"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "shadow_tpu.fleet", "sweep",
+         str(CHURN_YAML), "--seeds", "2", "--seed-base", "7",
+         "--jobs", "2", "--stop-time", "120s", "--sweep-dir", str(sweep),
+         "--no-device-service", "--quiet", "--json"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, cwd=str(ROOT))
+    try:
+        # both seeds dispatched (their "running" manifests exist) means
+        # the parent sits in the dispatch loop: the interrupt races
+        # in-flight members, not startup
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            mans = list(sweep.glob("seed_*/" + fleet.SEED_MANIFEST))
+            if len(mans) == 2:
+                break
+            assert proc.poll() is None, proc.stderr.read().decode()
+            time.sleep(0.05)
+        else:
+            pytest.fail("seeds not dispatched before the deadline")
+        time.sleep(0.5)  # let the members get into their round loops
+        os.kill(proc.pid, _signal.SIGINT)
+        out, err = proc.communicate(timeout=90)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 130, (out.decode(), err.decode())
+    summary = json.loads((sweep / fleet.SWEEP_SUMMARY).read_text())
+    assert summary["exit_reason"] == "interrupted"
+    assert summary["failed"]  # the in-flight seeds, named
+    for s, why in summary["failed"].items():
+        assert why == "interrupted"
+        man = json.loads((fleet.seed_dir(sweep, int(s))
+                          / fleet.SEED_MANIFEST).read_text())
+        assert man["status"] == "failed"
+        assert man["error"] == "interrupted"
+    # the printed summary is the same valid artifact
+    assert json.loads(out)["exit_reason"] == "interrupted"
+
+
 @pytest.mark.slow
 def test_draw_service_round_trip_and_fallback():
     """The shared draw service serves bit-identical flags and min-draws

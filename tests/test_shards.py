@@ -192,6 +192,54 @@ def test_shard_checkpoint_resume_and_refusal():
         ckpt.load_checkpoint(str(shard_file), cfg1, mirror_log=False)
 
 
+# -- signal-delivery races ----------------------------------------------------
+
+@pytest.mark.parametrize("signame", ["SIGINT", "SIGTERM"])
+def test_sharded_signal_mid_round(signame):
+    """SIGINT/SIGTERM landing mid-round in a sharded run: a valid
+    PARTIAL json summary (exit_reason interrupted, the signal named,
+    rounds counted), the conventional 128+N exit status, and no leaked
+    worker processes — never a hang or a traceback."""
+    import os
+    import signal as _signal
+    import subprocess
+    import sys
+    import time
+
+    tag = f"sig{signame[3].lower()}"
+    d = f"/tmp/st-shards-{tag}"
+    shutil.rmtree(d, ignore_errors=True)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "shadow_tpu", str(CHURN_YAML),
+         "--shards", "2", "--stop-time", "120s",
+         "--data-directory", d, "--state-digest-every", "20",
+         "--quiet", "--json-summary"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, cwd=str(ROOT))
+    try:
+        # wait for real mid-run progress (the merged digest stream is
+        # flowing), so the signal races an active round, not startup
+        digp = Path(d) / "state_digests.jsonl"
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if digp.is_file() and digp.stat().st_size > 0:
+                break
+            assert proc.poll() is None, proc.stderr.read().decode()
+            time.sleep(0.05)
+        else:
+            pytest.fail("no round progress before the deadline")
+        os.kill(proc.pid, getattr(_signal, signame))
+        out, err = proc.communicate(timeout=90)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    res = json.loads(out)
+    assert res["exit_reason"] == "interrupted", err.decode()
+    assert res["interrupt_signal"] == signame
+    assert res["rounds"] > 0
+    assert res["sim_shards"] == 2
+    assert proc.returncode == 128 + int(getattr(_signal, signame))
+
+
 # -- refusals -----------------------------------------------------------------
 
 def test_shard_config_refusals():

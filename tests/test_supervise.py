@@ -1,0 +1,368 @@
+"""Supervised self-healing runs (shadow_tpu/supervise.py).
+
+THE acceptance gates of the supervision PR:
+
+- chaos identity: a sharded run surviving injected worker SIGKILLs and a
+  ring-stall wedge under ``--supervise`` produces host trees, flow and
+  digest streams byte-identical to the uninterrupted run (auto-resume
+  from the newest complete shard manifest + stream rollback), and a
+  managed (real-binary) run surviving a guest wedge does the same via
+  its re-execution snapshot path;
+- detection is bounded: a killed or wedged peer is *named* within the
+  EMA-derived stall deadline, never hung forever (per-restart MTTR is
+  asserted against a generous CI bound);
+- below the checkpoint floor the supervisor degrades gracefully: a
+  structured ``crash_report.json`` and a named SupervisorGaveUp, not a
+  hang or a bare traceback.
+
+The pure pieces (spec parsing, deadline policy, the progress page, the
+stream rollback rules) get direct unit tests.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+from pathlib import Path
+
+import pytest
+import yaml
+
+from shadow_tpu import supervise as sup
+from shadow_tpu.config.schema import parse_config
+from shadow_tpu.core.controller import VOLATILE_SUMMARY_KEYS, Controller
+
+ROOT = Path(__file__).resolve().parent.parent
+CHURN_YAML = ROOT / "examples" / "gossip_churn.yaml"
+MANAGED_YAML = ROOT / "examples" / "managed_smoke.yaml"
+
+#: generous CI multiplier over the 2 s stall floor the chaos legs pin:
+#: detection + teardown + reap must land well inside this on any box
+DETECT_BOUND_S = 60.0
+
+
+def _cfg(tag: str, shards: int, extra: dict = None):
+    doc = yaml.safe_load(CHURN_YAML.read_text())
+    over = {
+        "general.data_directory": f"/tmp/st-sup-{tag}",
+        "general.stop_time": "5s",
+        "general.sim_shards": shards,
+        "general.state_digest_every": 50,
+        "telemetry.sample_every": "2s",
+        "experimental.scheduler_policy": "tpu_batch",
+        **(extra or {}),
+    }
+    over = {k: v for k, v in over.items() if v is not None}
+    shutil.rmtree(f"/tmp/st-sup-{tag}", ignore_errors=True)
+    return parse_config(doc, over)
+
+
+def _tree(tag: str) -> dict:
+    out = {}
+    base = Path(f"/tmp/st-sup-{tag}")
+    for p in sorted((base / "hosts").rglob("*")):
+        if p.is_file():
+            out[str(p.relative_to(base))] = hashlib.sha256(
+                p.read_bytes()).hexdigest()
+    assert out
+    return out
+
+
+def _streams(tag: str) -> dict:
+    base = Path(f"/tmp/st-sup-{tag}")
+    out = {}
+    for name in ("flows.jsonl", "metrics.jsonl", "state_digests.jsonl"):
+        p = base / name
+        if p.is_file():
+            out[name] = hashlib.sha256(p.read_bytes()).hexdigest()
+    return out
+
+
+def _clean(s: dict) -> dict:
+    s = dict(s)
+    for k in VOLATILE_SUMMARY_KEYS:
+        s.pop(k, None)
+    return s
+
+
+# -- spec parsing + deadline policy -------------------------------------------
+
+def test_parse_chaos():
+    assert sup.parse_chaos("kill@r500") == [
+        {"shard": 0, "kind": "kill", "round": 500}]
+    assert sup.parse_chaos(" s1:wedge@r900 , fail@r7,s0:guest_wedge@r2") == [
+        {"shard": 1, "kind": "wedge", "round": 900},
+        {"shard": 0, "kind": "fail", "round": 7},
+        {"shard": 0, "kind": "guest_wedge", "round": 2},
+    ]
+    assert sup.parse_chaos("") == []
+    with pytest.raises(ValueError, match="kind"):
+        sup.parse_chaos("explode@r5")
+    with pytest.raises(ValueError, match="r<round>"):
+        sup.parse_chaos("kill@500")
+    with pytest.raises(ValueError, match="expected"):
+        sup.parse_chaos("kill")
+    with pytest.raises(ValueError, match="shard"):
+        sup.parse_chaos("sX:kill@r5")
+
+
+def test_stall_deadline_policy(monkeypatch):
+    monkeypatch.delenv(sup.STALL_FLOOR_ENV, raising=False)
+    monkeypatch.delenv(sup.STALL_MULT_ENV, raising=False)
+    # floor wins while the EMA is tiny or unknown
+    assert sup.stall_deadline_s(0.0) == sup.DEFAULT_STALL_FLOOR_S
+    assert sup.stall_deadline_s(None) == sup.DEFAULT_STALL_FLOOR_S
+    # multiplier wins once rounds are slow enough
+    assert sup.stall_deadline_s(1.0) == sup.DEFAULT_STALL_MULT
+    # hard ceiling
+    assert sup.stall_deadline_s(1e9) == sup.STALL_CEILING_S
+    monkeypatch.setenv(sup.STALL_FLOOR_ENV, "3")
+    monkeypatch.setenv(sup.STALL_MULT_ENV, "10")
+    assert sup.stall_deadline_s(0.0) == 3.0
+    assert sup.stall_deadline_s(2.0) == 20.0
+
+
+def test_supervise_schema():
+    doc = yaml.safe_load(CHURN_YAML.read_text())
+    cfg = parse_config(doc, {"general.supervise": True})
+    assert cfg.general.supervise == {"max_restarts": 3, "backoff": 1.0}
+    assert sup.supervise_options(cfg)["max_restarts"] == 3
+    cfg = parse_config(doc, {"general.supervise": {"max_restarts": 0,
+                                                   "backoff": 0.5}})
+    assert cfg.general.supervise == {"max_restarts": 0, "backoff": 0.5}
+    with pytest.raises(ValueError, match="unknown general.supervise"):
+        parse_config(doc, {"general.supervise": {"retries": 2}})
+    with pytest.raises(ValueError, match="max_restarts"):
+        parse_config(doc, {"general.supervise": {"max_restarts": -1}})
+    cfg = parse_config(doc, {"general.supervise": False})
+    assert cfg.general.supervise is None
+
+
+# -- the progress page ---------------------------------------------------------
+
+def test_progress_page_roundtrip():
+    name = sup.progress_name(f"t{os.getpid():x}")
+    page = sup.ProgressPage(name, 3, create=True)
+    try:
+        assert page.read(0) == (0, 0)  # never stamped
+        assert page.age_s(0) == float("inf")
+        page.stamp(0, 41)
+        page.stamp(2, 7)
+        peer = sup.ProgressPage(name, 3)  # second attach, same segment
+        try:
+            r0, ns0 = peer.read(0)
+            assert r0 == 41 and ns0 > 0
+            assert peer.read(1) == (0, 0)
+            assert peer.read(2)[0] == 7
+            assert peer.age_s(0) < 5.0
+            snap = peer.snapshot()
+            assert [r for r, _ns in snap] == [41, 0, 7]
+        finally:
+            peer.close()
+        # restamp moves the round monotonically; the page is a word per
+        # shard, single writer each — last write wins
+        page.stamp(0, 42)
+        assert page.read(0)[0] == 42
+    finally:
+        page.close()
+        page.unlink()
+
+
+# -- stream rollback ------------------------------------------------------------
+
+def test_rollback_streams(tmp_path):
+    doc = yaml.safe_load(CHURN_YAML.read_text())
+    cfg = parse_config(doc, {
+        "general.data_directory": str(tmp_path),
+        "telemetry.sample_every": "1s"})
+    t0 = 2_000_000_000  # checkpoint boundary: round 100, t = 2 s
+
+    def _w(name, recs):
+        (tmp_path / name).write_text(
+            "".join(json.dumps(r) + "\n" for r in recs))
+
+    _w("state_digests.jsonl", [{"round": 50, "digest": "a"},
+                               {"round": 100, "digest": "b"},
+                               {"round": 150, "digest": "c"}])
+    _w("state_digests.shard0.jsonl", [{"round": 100, "digest": "b"},
+                                      {"round": 150, "digest": "c"}])
+    _w("flows.jsonl", [{"round": 99, "hid": 1}, {"round": 101, "hid": 2}])
+    _w("commands.jsonl", [{"t": t0, "cmd": "x"},
+                          {"t": t0 + 1, "cmd": "y"}])
+    _w("metrics.jsonl", [
+        {"kind": "meta", "v": 1},
+        {"kind": "sample", "t": t0, "round": 100},
+        {"kind": "sample", "t": t0 + 5, "round": 101},
+        {"kind": "fault", "t": t0, "round": 100},       # boundary: re-emits
+        {"kind": "fault", "t": t0 - 5, "round": 99},
+    ])
+    sup.rollback_streams(cfg, 100, t0)
+
+    def _r(name):
+        return [json.loads(x) for x in
+                (tmp_path / name).read_text().splitlines()]
+
+    assert [r["round"] for r in _r("state_digests.jsonl")] == [50, 100]
+    assert [r["round"] for r in _r("state_digests.shard0.jsonl")] == [100]
+    assert [r["round"] for r in _r("flows.jsonl")] == [99]
+    assert [r["t"] for r in _r("commands.jsonl")] == [t0]
+    kept = _r("metrics.jsonl")
+    assert [r["kind"] for r in kept] == ["meta", "sample", "fault"]
+    assert kept[2]["t"] == t0 - 5  # the boundary fault was dropped
+
+
+def test_crash_report_fields(tmp_path):
+    (tmp_path / "state_digests.jsonl").write_text(
+        json.dumps({"round": 70, "digest": "d"}) + "\n")
+    p = sup.write_crash_report(tmp_path, "boom", exc=RuntimeError("r"),
+                               attempt=2, max_restarts=1,
+                               extra={"worker": 1})
+    doc = json.loads(p.read_text())
+    assert doc["format"] == sup.REPORT_FORMAT
+    assert doc["reason"] == "boom"
+    assert doc["exc_type"] == "RuntimeError"
+    assert doc["attempt"] == 2 and doc["max_restarts"] == 1
+    assert doc["last_digest_round"] == 70 and doc["digest_cursor"] == 1
+    assert doc["worker"] == 1
+    assert isinstance(doc["rlimit_nofile"], list)
+
+
+# -- chaos identity: sharded ---------------------------------------------------
+
+def _chaos_env(monkeypatch, spec: str):
+    monkeypatch.setenv(sup.CHAOS_ENV, spec)
+    # tight deadlines so detection is seconds, not the CI-safe defaults
+    monkeypatch.setenv(sup.STALL_FLOOR_ENV, "2")
+    monkeypatch.setenv(sup.STALL_MULT_ENV, "20")
+
+
+@pytest.mark.parametrize("colcore", [True, False], ids=["c", "py"])
+def test_supervised_chaos_identity_sharded(monkeypatch, colcore):
+    """2 injected worker SIGKILLs + 1 ring-stall wedge on a 2-shard churn
+    run under supervision: every failure is detected within the bound and
+    named, and the recovered run's trees/streams are byte-identical to
+    the clean run's — with the C engine on AND off. Detection MTTR is
+    asserted per restart."""
+    monkeypatch.delenv(sup.CHAOS_ENV, raising=False)
+    from shadow_tpu.parallel import shards as sh
+
+    eng = {"experimental.native_colcore": colcore}
+    tc, th = f"cl{int(colcore)}", f"ch{int(colcore)}"
+    clean = sh.run_sharded(_cfg(tc, 2, extra=eng), mirror_log=False)
+    t_clean, s_clean = _tree(tc), _streams(tc)
+
+    _chaos_env(monkeypatch, "s0:kill@r300,s1:kill@r600,s0:wedge@r850")
+    cfg = _cfg(th, 2, extra={
+        **eng,
+        "general.checkpoint_every": "1s",
+        "general.supervise": {"max_restarts": 4, "backoff": 0.2}})
+    res = sup.run_supervised(cfg, mirror_log=False)
+
+    assert _tree(th) == t_clean
+    assert _streams(th) == s_clean
+    assert _clean(res) == _clean(clean)
+    svr = res["supervisor"]
+    assert svr["attempts"] == len(svr["restarts"]) + 1
+    assert len(svr["restarts"]) == 3
+    reasons = " | ".join(r["reason"] for r in svr["restarts"])
+    assert "died" in reasons            # SIGKILLed workers, named
+    assert "dead or wedged" in reasons  # the stale peer, named by shard
+    for r in svr["restarts"]:
+        # bounded detection: failure -> recovered attempt ready, with a
+        # generous CI multiplier over the pinned 2 s stall floor
+        assert r["mttr_s"] < DETECT_BOUND_S, r
+        assert r["resume"] != "scratch"  # checkpoints existed by then
+
+
+def test_supervised_single_kill_resumes(monkeypatch):
+    """Single-process path: an in-process chaos kill under supervision
+    converts to a recoverable failure (the supervisor must survive its
+    own process), the run auto-resumes from the newest single checkpoint
+    and converges to the clean run's bytes."""
+    monkeypatch.delenv(sup.CHAOS_ENV, raising=False)
+    clean = Controller(_cfg("s1cl", 1), mirror_log=False).run()
+    t_clean, s_clean = _tree("s1cl"), _streams("s1cl")
+
+    monkeypatch.setenv(sup.CHAOS_ENV, "kill@r600")
+    cfg = _cfg("s1ch", 1, extra={
+        "general.checkpoint_every": "1s",
+        "general.supervise": {"max_restarts": 2, "backoff": 0.1}})
+    res = sup.run_supervised(cfg, mirror_log=False)
+    assert _tree("s1ch") == t_clean
+    assert _streams("s1ch") == s_clean
+    assert _clean(res) == _clean(clean)
+    svr = res["supervisor"]
+    assert len(svr["restarts"]) == 1
+    assert "ChaosFailure" in svr["restarts"][0]["reason"]
+    assert svr["restarts"][0]["resume"].endswith(".ckpt")
+
+
+def test_supervisor_gives_up_below_checkpoint_floor(monkeypatch):
+    """No checkpoint to restart from and a zero budget: the supervisor
+    writes the structured crash report and raises a NAMED reason instead
+    of looping or hanging."""
+    monkeypatch.setenv(sup.CHAOS_ENV, "fail@r60")
+    cfg = _cfg("gu", 1, extra={
+        "general.stop_time": "2s",
+        "general.supervise": {"max_restarts": 0, "backoff": 0.0}})
+    with pytest.raises(sup.SupervisorGaveUp,
+                       match="restart budget exhausted"):
+        sup.run_supervised(cfg, mirror_log=False)
+    rep = json.loads(
+        (Path(cfg.general.data_directory) / sup.CRASH_REPORT).read_text())
+    assert rep["format"] == sup.REPORT_FORMAT
+    assert rep["exc_type"] == "ChaosFailure"
+    assert rep["attempt"] == 1 and rep["max_restarts"] == 0
+    assert rep["digest_cursor"] >= 1  # partial telemetry salvaged
+
+
+# -- chaos identity: managed guests --------------------------------------------
+
+def test_supervised_managed_guest_wedge_identity(monkeypatch, tmp_path):
+    """A managed (real-binary) run surviving one injected guest wedge
+    (SIGSTOP -> ring-progress watchdog -> supervisor escalation) matches
+    the clean run byte-for-byte: the restart re-executes from scratch and
+    determinism regenerates every stream."""
+    from test_checkpoint import _MANAGED_MISSING
+
+    if _MANAGED_MISSING:
+        pytest.skip("managed binaries not built: "
+                    + ", ".join(map(str, _MANAGED_MISSING)))
+    monkeypatch.delenv(sup.CHAOS_ENV, raising=False)
+    doc = yaml.safe_load(MANAGED_YAML.read_text())
+    for h in doc["hosts"].values():
+        for p in h["processes"]:
+            p["path"] = str(ROOT / p["path"])
+
+    def _mcfg(tag, extra=None):
+        d = f"/tmp/st-sup-{tag}"
+        shutil.rmtree(d, ignore_errors=True)
+        return parse_config(doc, {
+            "general.data_directory": d,
+            "general.state_digest_every": 5,
+            **(extra or {})})
+
+    clean = Controller(_mcfg("mcl"), mirror_log=False).run()
+    assert clean["process_errors"] == []
+    t_clean, s_clean = _tree("mcl"), _streams("mcl")
+
+    monkeypatch.setenv(sup.CHAOS_ENV, "guest_wedge@r25")
+    cfg = _mcfg("mch", extra={
+        "experimental.guest_turn_timeout": 1,
+        "general.supervise": {"max_restarts": 2, "backoff": 0.1}})
+    res = sup.run_supervised(cfg, mirror_log=False)
+    assert res["process_errors"] == []
+    assert _tree("mch") == t_clean
+    assert _streams("mch") == s_clean
+    assert _clean(res) == _clean(clean)
+    svr = res["supervisor"]
+    assert len(svr["restarts"]) == 1
+    r = svr["restarts"][0]
+    assert "GuestStallError" in r["reason"]
+    assert "ring_probe" in r["reason"]  # the wedged guest is NAMED
+    assert r["mttr_s"] < DETECT_BOUND_S
+    # the supervised escalation path must NOT count an unsupervised
+    # watchdog kill — the recovered run never saw the stall
+    assert res["counters"].get("guest_watchdog_kills", 0) == 0

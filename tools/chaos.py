@@ -1,0 +1,75 @@
+#!/usr/bin/env python3
+"""Chaos harness front-end for supervised runs (shadow_tpu/supervise.py).
+
+Infrastructure-level fault injection — worker SIGKILLs, ring-stall
+wedges, in-process failures, guest hangs — at deterministic ROUNDS, so
+recovery is proven, not asserted: a supervised run surviving the
+injected failures must converge to the same bytes as a failure-free run.
+(Complementary to the config `faults:` timeline, which injects
+SIMULATED failures the run is supposed to model, not survive.)
+
+Spec grammar (comma list): ``[s<K>:]<kind>@r<N>`` — kind in
+kill / wedge / fail / guest_wedge, fired once when shard K (default 0)
+reaches round N. Once-only across restarts via O_EXCL markers under
+``<data_dir>/chaos/``.
+
+Usage:
+    # validate + pretty-print a spec
+    python tools/chaos.py --parse 'kill@r500,s1:wedge@r900'
+
+    # run a command with SHADOW_TPU_CHAOS set (exec, no extra process)
+    python tools/chaos.py --spec 'kill@r500,s1:wedge@r900' -- \
+        python -m shadow_tpu examples/gossip_churn.yaml --shards 2 \
+        --checkpoint-every 1s --supervise
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from shadow_tpu.supervise import CHAOS_ENV, parse_chaos  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tools/chaos.py",
+        description="validate chaos specs / run commands under them")
+    p.add_argument("--parse", metavar="SPEC",
+                   help="parse SPEC, print the event list as JSON, exit")
+    p.add_argument("--spec", metavar="SPEC",
+                   help=f"set {CHAOS_ENV}=SPEC and exec the command "
+                   f"after '--'")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="command to exec under --spec (prefix with --)")
+    args = p.parse_args(argv)
+    if args.parse is None and args.spec is None:
+        p.error("one of --parse or --spec is required")
+    try:
+        events = parse_chaos(args.parse if args.parse is not None
+                             else args.spec)
+    except ValueError as exc:
+        print(f"chaos: {exc}", file=sys.stderr)
+        return 2
+    if args.parse is not None:
+        print(json.dumps(events, indent=1, sort_keys=True))
+        return 0
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        p.error("--spec needs a command after '--'")
+    os.environ[CHAOS_ENV] = args.spec
+    try:
+        os.execvp(cmd[0], cmd)
+    except OSError as exc:
+        print(f"chaos: cannot exec {cmd[0]}: {exc}", file=sys.stderr)
+        return 127
+
+
+if __name__ == "__main__":
+    sys.exit(main())

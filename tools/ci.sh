@@ -169,7 +169,7 @@ shrun() {
     python -m shadow_tpu examples/gossip_churn.yaml --quiet --json-summary \
         --data-directory "/tmp/ci-shard-$1" \
         --scheduler-policy tpu_batch --shards "$2" \
-        --set general.stop_time=25s \
+        --set general.stop_time=40s \
         --state-digest-every 100 --sample-every 5s \
         | python -c 'import json,sys; from shadow_tpu.core.controller import VOLATILE_SUMMARY_KEYS as V; d=json.load(sys.stdin); [d.pop(k, None) for k in V]; print(json.dumps(d,sort_keys=True))' \
         > "/tmp/ci-shard-$1.json"
@@ -182,6 +182,57 @@ shrun two 2
 diff /tmp/ci-shard-one.json /tmp/ci-shard-two.json
 diff /tmp/ci-shard-one.hashes /tmp/ci-shard-two.hashes
 echo "multi-shard smoke OK: shards=2 byte-identical to the single-process run (trees + flows + metrics + digests)"
+
+echo "== chaos self-healing smoke (supervised sharded run: 2 worker SIGKILLs + 1 ring-stall wedge auto-recover to the clean run's bytes; fleet: wedged member retried to ok) =="
+chrun() {
+    rm -rf "/tmp/ci-chaos-$1"
+    env SHADOW_TPU_CHAOS="$2" \
+        SHADOW_TPU_STALL_FLOOR_S=3 SHADOW_TPU_STALL_MULT=20 \
+        python -m shadow_tpu examples/gossip_churn.yaml --quiet --json-summary \
+        --data-directory "/tmp/ci-chaos-$1" \
+        --scheduler-policy tpu_batch --shards 2 \
+        --checkpoint-every 2s \
+        --set general.stop_time=40s \
+        --set "general.supervise={max_restarts: 4, backoff: 0.2}" \
+        --state-digest-every 100 --sample-every 5s \
+        > "/tmp/ci-chaos-$1.json"
+    (cd "/tmp/ci-chaos-$1" && find hosts -type f | sort | xargs sha256sum && \
+     sha256sum flows.jsonl metrics.jsonl state_digests.jsonl) \
+        > "/tmp/ci-chaos-$1.hashes"
+}
+chrun clean ""
+chrun hurt "s0:kill@r700,s1:kill@r1400,s0:wedge@r2000"
+diff /tmp/ci-chaos-clean.hashes /tmp/ci-chaos-hurt.hashes
+python - <<'EOF'
+import json
+
+d = json.load(open("/tmp/ci-chaos-hurt.json"))
+s = d["supervisor"]
+assert len(s["restarts"]) == 3, s  # every injection recovered from
+reasons = " | ".join(r["reason"] for r in s["restarts"])
+assert "died" in reasons, reasons            # the SIGKILLs, named
+assert "dead or wedged" in reasons, reasons  # the wedge, named by shard
+for r in s["restarts"]:
+    assert r["mttr_s"] < 90, r  # bounded detection, never a hang
+print(f"chaos self-healing smoke OK: 2 kills + 1 wedge recovered in "
+      f"{s['attempts']} attempts (mttr "
+      f"{[r['mttr_s'] for r in s['restarts']]}s), bytes == clean run")
+EOF
+rm -rf /tmp/ci-chaos-fleet
+env SHADOW_TPU_FLEET_CHAOS_WEDGE_SEEDS=131 SHADOW_TPU_FLEET_STALL_S=8 \
+    python -m shadow_tpu.fleet sweep examples/gossip_churn.yaml \
+    --seeds 2 --seed-base 130 --jobs 2 --sweep-dir /tmp/ci-chaos-fleet \
+    --set general.stop_time=10s --no-device-service --quiet --json \
+    > /tmp/ci-chaos-fleet.json
+python - <<'EOF'
+import json
+
+d = json.load(open("/tmp/ci-chaos-fleet.json"))
+assert d["completed"] == [130, 131], d["failed"]
+assert d["failed"] == {}, d["failed"]
+assert d["respawns"] >= 1, d  # the wedged member WAS killed + respawned
+print("chaos fleet smoke OK: wedged member detected, killed, retried to ok")
+EOF
 
 echo "== fleet smoke (3-seed gossip_churn sweep at jobs=2: per-seed identity vs standalone + CIs in sweep_summary) =="
 rm -rf /tmp/ci-fleet /tmp/ci-fleet-solo-*
