@@ -1885,6 +1885,103 @@ def draw_plane_throughput(n: int = 1_000_000) -> dict:
     return out
 
 
+def fork_amortization(n_branches: int = 10) -> dict:
+    """The scenario-multiverse row (shadow_tpu/forks.py): how much wall
+    does restoring ONE trunk checkpoint into N what-if branches re-buy
+    over N cold-start runs of the same (config, commands, seed) tuples?
+
+    web_cdn at stop 20s forked from its 15s checkpoint: every branch is
+    restore-mode (divergence by injected command only), so each re-buys
+    the 15s trunk prefix and simulates only the 5s suffix — the ideal
+    amortization is ~4x, and anything under 2x means the fork machinery
+    (prefix stream copy, pickle restore, per-branch worker dispatch) is
+    eating the prefix it saved. Both arms run serially (jobs=1 vs an
+    in-process loop, which if anything flatters the cold arm — no
+    worker IPC), and the row spot-checks the honesty gate: branch 0's
+    output tree and streams byte-equal its cold twin's."""
+    from shadow_tpu import fleet as _fleet
+    from shadow_tpu import forks as _forks
+    from shadow_tpu.config import load_config
+    from shadow_tpu.core.controller import Controller
+
+    base = str(ROOT / "examples" / "web_cdn.yaml")
+    common = {"general.stop_time": "20s",
+              "general.checkpoint_every": "15s",
+              "general.state_digest_every": 200}
+    trunk = _fresh_dir("/tmp/shadow-bench-fork-trunk")
+    t0 = time.perf_counter()
+    Controller(load_config(base, {**common,
+                                  "general.data_directory": trunk}),
+               mirror_log=False).run()
+    trunk_wall = time.perf_counter() - t0
+    cks = sorted(Path(trunk).glob("checkpoints/ckpt_*.ckpt"))
+    assert cks, f"trunk wrote no checkpoints under {trunk}"
+    # N restore-mode branches: each injects one degrade window after the
+    # fork point with a different severity (a realistic what-if sweep)
+    branches = [{"name": f"w{i}", "commands": [
+        {"t": "16s", "cmd": "link_degrade", "src_nodes": [0, 1],
+         "dst_nodes": [6, 7], "latency_factor": 1.25 + 0.25 * i,
+         "loss_add": 0.004 * i, "bandwidth_scale": 1.0,
+         "duration": "3s"}]} for i in range(n_branches)]
+    fork_dir = Path(_fresh_dir("/tmp/shadow-bench-fork"))
+    plan = _forks.plan_fork(base, cks[0], branches, fork_dir,
+                            overrides=dict(common))
+    t0 = time.perf_counter()
+    summary = _fleet.FleetRunner(base, plan["order"], jobs=1,
+                                 sweep_dir=fork_dir,
+                                 overrides=dict(common), fork=plan,
+                                 quiet=True).run()
+    fork_wall = time.perf_counter() - t0
+    assert not summary["failed"], summary["failed"]
+    log(f"fork_amortization: {n_branches}-branch fork {fork_wall:.1f}s "
+        f"(trunk {trunk_wall:.1f}s); running the cold arm")
+    cold_wall = 0.0
+    cold0 = None
+    for i in range(n_branches):
+        d = _fresh_dir(f"/tmp/shadow-bench-fork-cold-{i}")
+        replay = _forks.branch_dir(fork_dir, f"w{i}") / _forks.REPLAY_FILE
+        t0 = time.perf_counter()
+        Controller(load_config(base, {
+            **common, "general.data_directory": d,
+            "general.replay_commands": str(replay)}),
+            mirror_log=False).run()
+        cold_wall += time.perf_counter() - t0
+        if i == 0:
+            cold0 = d
+    # the honesty spot check: forked == cold-started, byte for byte
+    man0 = json.loads((_forks.branch_dir(fork_dir, "w0")
+                       / _forks.FORK_MANIFEST).read_text())
+    assert man0["tree_sha256"] == _fleet.output_tree_digest(cold0), \
+        "branch w0 tree != its cold twin — amortization measured a lie"
+    assert all(man0["streams_sha256"][k] == v for k, v in
+               _fleet._stream_digests(cold0).items()), "w0 streams diverged"
+    speedup = cold_wall / fork_wall
+    row = {
+        "workload": f"web_cdn.yaml, {n_branches} what-if branches forked "
+                    f"from the 15s checkpoint of a 20s trunk",
+        "n_branches": n_branches,
+        "trunk_wall_seconds": round(trunk_wall, 2),
+        "fork_wall_seconds": round(fork_wall, 2),
+        "cold_wall_seconds": round(cold_wall, 2),
+        "per_branch_wall_seconds": summary["per_branch_wall_seconds"],
+        "speedup_fork_vs_cold": round(speedup, 2),
+        "speedup_incl_trunk": round(cold_wall / (fork_wall + trunk_wall),
+                                    2),
+        "identity_spot_check": "w0 tree+streams == cold twin",
+    }
+    if speedup < 2.0:
+        row.setdefault("warnings", []).append(
+            f"fork amortization {speedup:.2f}x < 2x — the restore path "
+            f"(prefix stream copy + pickle load + worker dispatch) is "
+            f"eating the trunk prefix it was supposed to re-buy")
+        log(f"fork_amortization WARNING: {speedup:.2f}x < 2x — restore "
+            f"overhead is swallowing the amortization win")
+    log(f"fork_amortization: {n_branches} branches forked in "
+        f"{fork_wall:.1f}s vs {cold_wall:.1f}s cold ({speedup:.2f}x; "
+        f"{row['speedup_incl_trunk']}x counting the trunk run)")
+    return row
+
+
 def ensure_native() -> None:
     """Build the native pieces (shim + colcore) the benchmarks rely on;
     the C engine degrades to the Python twin if absent, which would turn
@@ -1919,7 +2016,28 @@ def main() -> None:
                          "tor_400 sweep vs standalone singles, "
                          "interleaved, with shared-attach and jobs "
                          "ablations) and merge it into BENCH_DETAIL.json")
+    ap.add_argument("--fork", action="store_true",
+                    help="measure ONLY the fork-amortization row "
+                         "(10-branch web_cdn what-if fork vs 10 "
+                         "cold-start runs) and merge it into "
+                         "BENCH_DETAIL.json")
     args = ap.parse_args()
+
+    if args.fork:
+        detail_path = ROOT / "BENCH_DETAIL.json"
+        detail = json.loads(detail_path.read_text())
+        row = fork_amortization()
+        detail["fork_amortization"] = row
+        detail_path.write_text(json.dumps(detail, indent=2))
+        log("wrote BENCH_DETAIL.json (fork_amortization)")
+        print(json.dumps({
+            "metric": "fork_amortization_speedup_vs_cold",
+            "value": row["speedup_fork_vs_cold"],
+            "n_branches": row["n_branches"],
+            "speedup_incl_trunk": row["speedup_incl_trunk"],
+            "warnings": row.get("warnings", []),
+        }), flush=True)
+        return
 
     if args.fleet:
         detail_path = ROOT / "BENCH_DETAIL.json"

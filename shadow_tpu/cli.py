@@ -155,6 +155,16 @@ def overrides_from_args(args: argparse.Namespace) -> dict:
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "fork":
+        # first-class fork verb: `python -m shadow_tpu fork cfg.yaml
+        # --from CKPT --branches branches.yaml` — checkpoint-forked
+        # what-if trees (shadow_tpu/forks.py; also reachable as
+        # `python -m shadow_tpu.fleet sweep --fork-from`)
+        from shadow_tpu.forks import main as _fork_main
+
+        return _fork_main(argv[1:])
     args = build_parser().parse_args(argv)
     from shadow_tpu.config import load_config
     from shadow_tpu.core.controller import Controller

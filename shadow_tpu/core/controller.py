@@ -675,6 +675,10 @@ class Controller:
             if ck_every:
                 path = _ckpt.save_checkpoint(self, now)
                 self.log.info(f"final checkpoint written: {path}")
+                if self.live is not None:
+                    self.live.publish({"type": "checkpoint",
+                                       "path": str(path), "t": now,
+                                       "round": self.rounds})
         if gc_was_enabled:
             _gc.enable()
         _gc.collect()
@@ -747,6 +751,14 @@ class Controller:
                 self.log.info(
                     f"checkpoint written: {path} "
                     f"(sim {format_time(now)}, round {self.rounds})")
+                if self.live is not None:
+                    # the checkpoint_now ack precedes application (it
+                    # confirms receipt, not effect) — this post-save
+                    # record is how a live client learns the PATH, e.g.
+                    # to fork it (shadow_tpu/forks.py)
+                    self.live.publish({"type": "checkpoint",
+                                       "path": str(path), "t": now,
+                                       "round": self.rounds})
                 if ck_every:
                     next_ckpt = ((now // ck_every) + 1) * ck_every
                 # snapshot wall is attributed like any other phase: it is

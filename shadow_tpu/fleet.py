@@ -332,7 +332,12 @@ class FleetDrawClient:
 
 # -- per-seed execution (worker side) -----------------------------------------
 
-def seed_dir(sweep_dir, seed: int) -> Path:
+def seed_dir(sweep_dir, seed) -> Path:
+    """Per-task directory. A task is a seed (int — a sweep member) or a
+    fork branch name (str — shadow_tpu/forks.py); the fleet's dispatch,
+    watchdog, and retry machinery treat both as opaque keys."""
+    if isinstance(seed, str):
+        return Path(sweep_dir) / f"branch_{seed}"
     return Path(sweep_dir) / f"seed_{int(seed)}"
 
 
@@ -530,8 +535,13 @@ def _run_one_seed(config_path: str, overrides: dict, sweep_dir,
     return man
 
 
-def _write_failed_manifest(sweep_dir, seed: int, error: str,
+def _write_failed_manifest(sweep_dir, seed, error: str,
                            tb: str = "") -> dict:
+    if isinstance(seed, str):  # a fork branch failed, not a sweep seed
+        from shadow_tpu import forks as _forks
+
+        return _forks.write_failed_branch_manifest(sweep_dir, seed,
+                                                   error, tb)
     d = seed_dir(sweep_dir, seed)
     d.mkdir(parents=True, exist_ok=True)
     man = {
@@ -547,7 +557,7 @@ def _write_failed_manifest(sweep_dir, seed: int, error: str,
 
 def _fleet_worker_main(conn, config_path: str, overrides: dict,
                        sweep_dir: str, worker_idx: int,
-                       service_addr, pin: bool) -> None:
+                       service_addr, pin: bool, fork: dict = None) -> None:
     """Worker process entry: run seeds sequentially as they arrive. One
     interpreter for many seeds is the amortization lever (module doc)."""
     import gc as _gc
@@ -574,9 +584,15 @@ def _fleet_worker_main(conn, config_path: str, overrides: dict,
             break
         if msg[0] == "exit":
             break
-        seed = int(msg[1])
+        seed = str(msg[1]) if fork is not None else int(msg[1])
         try:
-            man = _run_one_seed(config_path, overrides, sweep_dir, seed)
+            if fork is not None:
+                from shadow_tpu import forks as _forks
+
+                man = _forks.run_branch(fork, seed)
+            else:
+                man = _run_one_seed(config_path, overrides, sweep_dir,
+                                    seed)
             conn.send(("done", seed, man))
         except BaseException as exc:
             tb = traceback.format_exc()
@@ -638,9 +654,21 @@ class FleetRunner:
                  max_rss_mb: int = None, pin_cores: bool = True,
                  device_service: bool = True, quiet: bool = False,
                  live_endpoint: str = None, retries: int = 1,
-                 member_max_rss_mb: int = 0) -> None:
+                 member_max_rss_mb: int = 0, fork: dict = None) -> None:
         self.config_path = str(config_path)
-        self.seeds = [int(s) for s in seeds]
+        #: a validated fork plan (shadow_tpu.forks.plan_fork) turns the
+        #: fleet into a fork orchestrator: ``seeds`` become branch names
+        #: and every worker runs branches of ONE trunk checkpoint
+        self.fork = fork
+        if fork is not None:
+            self.seeds = [str(s) for s in seeds]
+            if resume:
+                raise ValueError(
+                    "a fork cannot --resume: branches are planned from "
+                    "the trunk checkpoint each time — just re-run the "
+                    "fork (completed branch directories are rebuilt)")
+        else:
+            self.seeds = [int(s) for s in seeds]
         if not self.seeds:
             raise ValueError("a sweep needs at least one seed")
         if len(set(self.seeds)) != len(self.seeds):
@@ -687,8 +715,16 @@ class FleetRunner:
                     f"to a single run's live_endpoint instead"))
 
     def _publish(self, rec: dict) -> None:
-        if self.live is not None:
-            self.live.publish(rec)
+        if self.live is None:
+            return
+        if self.fork is not None and isinstance(rec.get("seed"), str):
+            # forked sweeps stream per-BRANCH progress: same lifecycle
+            # records, branch-keyed (branch_dispatched/branch_done/...)
+            rec = dict(rec)
+            rec["branch"] = rec.pop("seed")
+            if isinstance(rec.get("type"), str):
+                rec["type"] = rec["type"].replace("seed_", "branch_")
+        self.live.publish(rec)
 
     def _log(self, msg: str) -> None:
         if not self.quiet:
@@ -739,7 +775,7 @@ class FleetRunner:
             target=_fleet_worker_main,
             args=(child_conn, self.config_path, self.overrides,
                   str(self.sweep_dir), idx, self._service_addr,
-                  self.pin_cores),
+                  self.pin_cores, self.fork),
             name=f"shadow-fleet-{idx}", daemon=True)
         p.start()
         child_conn.close()
@@ -757,9 +793,11 @@ class FleetRunner:
         t_sweep = _walltime.perf_counter()
         self.sweep_dir.mkdir(parents=True, exist_ok=True)
         # validate the config up front: a typo should fail the sweep in
-        # milliseconds, not once per worker
-        _member_config(self.config_path, self.overrides, self.sweep_dir,
-                       self.seeds[0])
+        # milliseconds, not once per worker (a fork plan was already
+        # validated end to end by forks.plan_fork)
+        if self.fork is None:
+            _member_config(self.config_path, self.overrides,
+                           self.sweep_dir, self.seeds[0])
         failed: dict = {}
         skipped: list = []
         pending = list(self.seeds)
@@ -820,11 +858,19 @@ class FleetRunner:
                         except (AttributeError, OSError):
                             pass
                         try:
+                            from shadow_tpu.config import load_config
                             from shadow_tpu.ops.propagate import DrawServer
 
-                            cfg0 = _member_config(
-                                self.config_path, self.overrides,
-                                self.sweep_dir, self.seeds[0])
+                            if self.fork is not None:
+                                # branches share the trunk's plane shape
+                                cfg0 = load_config(
+                                    self.config_path,
+                                    dict(self.fork["overrides"]),
+                                    cache_doc=True)
+                            else:
+                                cfg0 = _member_config(
+                                    self.config_path, self.overrides,
+                                    self.sweep_dir, self.seeds[0])
                             self._server = DrawServer(
                                 cfg0.general.seed,
                                 cfg0.experimental.tpu_max_batch,
@@ -878,6 +924,39 @@ class FleetRunner:
             if self._server is not None:
                 self._server.close()
         wall = _walltime.perf_counter() - t_sweep
+        service_doc = ({"draw_service": {
+            "served_batches": self._server.served_batches,
+            "served_units": self._server.served_units,
+            "attach_wall_seconds": round(self._server.attach_wall, 3),
+        }} if self._server is not None else {})
+        if self.fork is not None:
+            from shadow_tpu import forks as _forks
+
+            fork_doc = {
+                "config": self.config_path,
+                "jobs": self.jobs,
+                "branches_planned": self.seeds,
+                "trunk_checkpoint": self.fork["ckpt"],
+                "trunk_dir": self.fork["trunk_dir"],
+                "failed": {str(s): failed[s] for s in sorted(failed)},
+                "fork_wall_seconds": round(wall, 3),
+                "exit_reason": ("interrupted" if self._interrupted
+                                else "completed"),
+                "retries": self.retries,
+                "respawns": self._respawns,
+                **service_doc,
+            }
+            summary = _forks.reduce_fork(self.sweep_dir, extra=fork_doc)
+            n_ok = len(summary["completed"])
+            self._log(f"fork done: {n_ok}/{len(self.seeds)} branch(es) "
+                      f"ok, {len(failed)} failed, wall {wall:.1f}s -> "
+                      f"{self.sweep_dir / _forks.FORK_SUMMARY}")
+            if self.live is not None:
+                self._publish({"type": "end", "ok": n_ok,
+                               "failed": len(failed),
+                               "wall_seconds": round(wall, 1)})
+                self.live.close()
+            return summary
         sweep_doc = {
             "config": self.config_path,
             "jobs": self.jobs,
@@ -889,11 +968,7 @@ class FleetRunner:
                             else "completed"),
             "retries": self.retries,
             "respawns": self._respawns,
-            **({"draw_service": {
-                "served_batches": self._server.served_batches,
-                "served_units": self._server.served_units,
-                "attach_wall_seconds": round(self._server.attach_wall, 3),
-            }} if self._server is not None else {}),
+            **service_doc,
         }
         summary = reduce_sweep(self.sweep_dir, extra=sweep_doc)
         n_ok = len(summary["completed"])
@@ -1067,7 +1142,8 @@ class FleetRunner:
                     try:
                         _sup.write_crash_report(
                             d, "member_rss_ceiling",
-                            extra={"seed": int(seed),
+                            extra={"seed": seed if isinstance(seed, str)
+                                   else int(seed),
                                    "rss_mb": round(rss, 1),
                                    "ceiling_mb": self.member_max_rss_mb})
                     except OSError:
@@ -1362,15 +1438,31 @@ def build_parser() -> argparse.ArgumentParser:
     ps.add_argument("--json", action="store_true",
                     help="print the sweep summary as one JSON line on "
                     "stdout instead of the report")
+    ps.add_argument("--fork-from", metavar="CKPT", default=None,
+                    help="fork mode (shadow_tpu/forks.py): restore this "
+                    "trunk checkpoint into every worker and run the "
+                    "--branches divergence specs instead of seeds")
+    ps.add_argument("--branches", metavar="FILE", default=None,
+                    help="branches.yaml for --fork-from: the per-branch "
+                    "divergence specs")
+    ps.add_argument("--trunk-dir", metavar="DIR", default=None,
+                    help="the trunk run directory for --fork-from "
+                    "(default: derived from the checkpoint path's "
+                    "<trunk>/checkpoints/ layout)")
     pr = sub.add_parser("report",
-                        help="re-reduce + render a sweep directory")
+                        help="re-reduce + render a sweep (or fork) "
+                        "directory")
     pr.add_argument("sweep_dir")
     pr.add_argument("--json", action="store_true",
                     help="print the summary JSON instead of the report")
+    pr.add_argument("--compare", action="store_true",
+                    help="fork directories: render only the comparative "
+                    "table (per-group percentile deltas vs the trunk "
+                    "with CI95)")
     return p
 
 
-def _sweep_overrides(args) -> dict:
+def _sweep_overrides(args, fork: bool = False) -> dict:
     import yaml as _yaml
 
     over: dict = {}
@@ -1383,6 +1475,12 @@ def _sweep_overrides(args) -> dict:
             raise SystemExit(2)
         k, v = item.split("=", 1)
         over[k] = _yaml.safe_load(v)
+    if fork:
+        # a fork inherits the trunk's telemetry settings verbatim —
+        # auto-enabling here would re-cadence streams the trunk already
+        # started (forks.plan_fork refuses explicit telemetry overrides
+        # with the full story)
+        return over
     if not args.no_telemetry and not any(
             k.startswith("telemetry") for k in over):
         # the whole point of a sweep is cross-seed percentiles: enable
@@ -1397,31 +1495,76 @@ def _sweep_overrides(args) -> dict:
     return over
 
 
+def _is_fork_dir(d) -> bool:
+    from shadow_tpu import forks as _forks
+
+    d = Path(d)
+    return ((d / _forks.FORK_SUMMARY).is_file()
+            or any(sorted(d.glob("branch_*/" + _forks.FORK_MANIFEST))))
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.cmd == "report":
+        if _is_fork_dir(args.sweep_dir):
+            from shadow_tpu import forks as _forks
+
+            summary = _forks.reduce_fork(args.sweep_dir)
+            print(json.dumps(summary) if args.json
+                  else _forks.render_compare(summary) if args.compare
+                  else _forks.render_fork_report(summary))
+            return 0 if not summary["failed"] else 1
+        if args.compare:
+            print(f"fleet: {args.sweep_dir} is a seed sweep, not a fork "
+                  f"— --compare diffs fork branches against their trunk",
+                  file=sys.stderr)
+            return 2
         summary = reduce_sweep(args.sweep_dir)
         print(json.dumps(summary) if args.json
               else render_report(summary))
         return 0 if not summary["failed"] else 1
     try:
-        over = _sweep_overrides(args)
-        if args.seed_base is not None:
-            base = int(args.seed_base)
-        else:
-            from shadow_tpu.config.schema import load_yaml_doc
+        fork_plan = None
+        if args.fork_from or args.branches:
+            if not (args.fork_from and args.branches):
+                print("fleet: --fork-from and --branches go together "
+                      "(a fork needs both the trunk checkpoint and the "
+                      "divergence specs)", file=sys.stderr)
+                return 2
+            if args.resume:
+                print("fleet: a fork cannot --resume — just re-run it",
+                      file=sys.stderr)
+                return 2
+        over = _sweep_overrides(args, fork=bool(args.fork_from))
+        if args.fork_from:
+            from shadow_tpu import forks as _forks
 
-            doc = load_yaml_doc(args.config, cache=True)
-            base = int(((doc or {}).get("general") or {}).get("seed", 1))
-        seeds = [base + i for i in range(int(args.seeds))]
-        sweep_dir = args.sweep_dir or (Path(args.config).stem + ".sweep")
+            sweep_dir = (args.sweep_dir
+                         or (Path(args.config).stem + ".fork"))
+            branches = _forks.load_branches(args.branches)
+            fork_plan = _forks.plan_fork(
+                args.config, args.fork_from, branches, sweep_dir,
+                overrides=over, trunk_dir=args.trunk_dir)
+            seeds = fork_plan["order"]
+        else:
+            if args.seed_base is not None:
+                base = int(args.seed_base)
+            else:
+                from shadow_tpu.config.schema import load_yaml_doc
+
+                doc = load_yaml_doc(args.config, cache=True)
+                base = int(((doc or {}).get("general") or {})
+                           .get("seed", 1))
+            seeds = [base + i for i in range(int(args.seeds))]
+            sweep_dir = (args.sweep_dir
+                         or (Path(args.config).stem + ".sweep"))
         runner = FleetRunner(
             args.config, seeds, args.jobs, sweep_dir, overrides=over,
             resume=args.resume, max_rss_mb=args.max_rss_mb,
             pin_cores=not args.no_pin,
             device_service=not args.no_device_service, quiet=args.quiet,
             live_endpoint=args.live_endpoint, retries=args.retries,
-            member_max_rss_mb=args.member_max_rss_mb)
+            member_max_rss_mb=args.member_max_rss_mb, fork=fork_plan)
         summary = runner.run()
     except FileNotFoundError as exc:
         print(f"fleet: config file not found: "
@@ -1430,7 +1573,14 @@ def main(argv=None) -> int:
     except (ValueError, OSError) as exc:
         print(f"fleet: {exc}", file=sys.stderr)
         return 2
-    print(json.dumps(summary) if args.json else render_report(summary))
+    if fork_plan is not None:
+        from shadow_tpu import forks as _forks
+
+        print(json.dumps(summary) if args.json
+              else _forks.render_fork_report(summary))
+    else:
+        print(json.dumps(summary) if args.json
+              else render_report(summary))
     if summary.get("exit_reason") == "interrupted":
         return 130  # conventional SIGINT status; the summary above is a
         # valid partial artifact and --resume finishes the sweep

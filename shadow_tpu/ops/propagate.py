@@ -246,7 +246,7 @@ class DeviceDrawPlane:
 
     @classmethod
     def attach_cached(cls, seed: int, max_batch: int, n_shards: int,
-                      max_pkts: int):
+                      max_pkts: int, should_abort=None):
         """Process-wide attach cache: (plane, dev_s, np_per_unit) for this
         parameter tuple, building + calibrating on first use. A simulation
         binary runs many short Controllers (benchmarks, tests, resumed
@@ -255,12 +255,21 @@ class DeviceDrawPlane:
         online BEFORE the round loop ends on fast configs — round 5's
         device_x < 1.0 was largely a device that published after the loop
         finished. Pure wall-clock policy: the plane is stateless, so
-        sharing it across runs cannot change results."""
+        sharing it across runs cannot change results.
+
+        ``should_abort`` (callable -> bool) is polled between the attach
+        phases; when it fires, the partial attach is discarded (nothing
+        cached) and None is returned. This bounds how long a teardown
+        must wait on an in-flight attach to a single XLA compile."""
         key = (int(seed), int(max_batch), int(n_shards), int(max_pkts))
         hit = cls._cache.get(key)
         if hit is None:
+            if should_abort is not None and should_abort():
+                return None
             plane = cls(seed, max_batch, n_shards=n_shards,
                         max_pkts=max_pkts)
+            if should_abort is not None and should_abort():
+                return None
             dev_s, np_per_unit = plane.calibrate()
             # warm EVERY program shape this plane can ever dispatch
             # (VERDICT r5 item #7): calibrate() compiles only its probe
@@ -270,25 +279,32 @@ class DeviceDrawPlane:
             # made the first tpu rep ~2.1x slow in interleaved raws.
             # ~log2(max_batch) shapes, on the attach thread, amortized by
             # the persistent compile cache across processes.
-            plane.warm_shapes()
+            plane.warm_shapes(should_abort=should_abort)
+            if should_abort is not None and should_abort():
+                return None  # partially warmed: do not cache
             if len(cls._cache) >= 4:  # a handful of configs per process
                 cls._cache.pop(next(iter(cls._cache)))
             hit = cls._cache[key] = (plane, dev_s, np_per_unit)
         return hit
 
-    def warm_shapes(self) -> None:
+    def warm_shapes(self, should_abort=None) -> None:
         """Compile every padded bucket shape of the draw kernel plus the
         pinned speculative min-draw shape, so no dispatch ever compiles
         inside a simulation round loop (static shapes bound the set to
         ~log2(max_batch) programs — the module-doc design point). Pure
-        wall-clock work: flags are never read for results here."""
+        wall-clock work: flags are never read for results here.
+        ``should_abort`` is polled between shapes (attach teardown)."""
         b = MIN_BUCKET
         while True:
+            if should_abort is not None and should_abort():
+                return
             z = np.zeros(b, dtype=np.uint32)
             self.dispatch(z, z, z, z).read()
             if b >= self.max_batch:
                 break
             b <<= 1
+        if should_abort is not None and should_abort():
+            return
         k = self.SPEC_BUCKET
         z = np.zeros(k, dtype=np.uint32)
         self.dispatch_min(z, z, z, min_bucket=k).read()
@@ -395,17 +411,21 @@ class DrawServer:
             pass
         t0 = _walltime.perf_counter()
         try:
-            self.plane, self.dev_s, self.np_per_unit = \
-                DeviceDrawPlane.attach_cached(*self._attach_args)
+            hit = DeviceDrawPlane.attach_cached(
+                *self._attach_args, should_abort=lambda: self._closing)
         except Exception:
-            # no usable device: close the listener so member proxies get
-            # a clean connection error and fall back to local routing
+            hit = None  # no usable device
+        if hit is None:
+            # no usable device, or close() raced the attach: close the
+            # listener so member proxies get a clean connection error and
+            # fall back to local routing
             self._closing = True
             try:
                 self._listener.close()
             except OSError:
                 pass
             return
+        self.plane, self.dev_s, self.np_per_unit = hit
         self.attach_wall = _walltime.perf_counter() - t0
         self._ready.set()
 
@@ -505,4 +525,10 @@ class DrawServer:
         except OSError:
             pass
         self._accept_thread.join(timeout=2)
+        # join the attach thread before returning: a daemon thread left
+        # inside an XLA compile at interpreter exit dies by C++
+        # std::terminate (the fleet-smoke SIGABRT). attach_cached polls
+        # _closing between phases, so the residual wait is bounded by a
+        # single compile; the timeout is a backstop for a wedged backend.
+        self._attach_thread.join(timeout=120)
         shutil.rmtree(os.path.dirname(self.address), ignore_errors=True)

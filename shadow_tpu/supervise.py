@@ -391,13 +391,15 @@ def _filter_jsonl(path: Path, keep) -> None:
     os.replace(tmp, path)
 
 
-def rollback_streams(cfg, ckpt_rounds: int, t0_ns: int) -> None:
-    """Trim the append-mode output streams back to the checkpoint
-    boundary (round ``ckpt_rounds``, sim time ``t0_ns``) so the resumed
-    run's appends continue them byte-identically.
+def stream_prefix_keep(ckpt_rounds: int, t0_ns: int) -> dict:
+    """Per-stream keep predicates for truncating output streams at a
+    checkpoint boundary (round ``ckpt_rounds``, sim time ``t0_ns``) so a
+    resumed run's appends continue them byte-identically. Shared by the
+    supervisor's in-place rollback and the fork runner's prefix copy
+    (shadow_tpu/forks.py) — one set of rules, two consumers.
 
-    The keep/drop rules mirror the round-boundary order (commands ->
-    checkpoint -> fault transitions -> round -> digest/telemetry):
+    The rules mirror the round-boundary order (commands -> checkpoint ->
+    fault transitions -> round -> digest/telemetry):
 
     - digests + flow records: ``round <= ckpt_rounds`` (emitted before
       the boundary's checkpoint; later rounds re-emit on resume)
@@ -407,20 +409,7 @@ def rollback_streams(cfg, ckpt_rounds: int, t0_ns: int) -> None:
       sampler cursor restores past them); fault records keep ``t < t0``
       (transitions at the boundary apply AFTER the snapshot and re-emit)
     """
-    data_dir = Path(cfg.general.data_directory)
-    tel = cfg.telemetry
-    mdir = (Path(tel.metrics_dir) if tel is not None and tel.metrics_dir
-            else data_dir)
-
     by_round = lambda rec: int(rec.get("round", 0)) <= ckpt_rounds
-    _filter_jsonl(data_dir / "state_digests.jsonl", by_round)
-    for p in sorted(data_dir.glob("state_digests.shard*.jsonl")):
-        _filter_jsonl(p, by_round)
-    _filter_jsonl(mdir / "flows.jsonl", by_round)
-    for p in sorted(mdir.glob("flows.shard*.jsonl")):
-        _filter_jsonl(p, by_round)
-    _filter_jsonl(data_dir / "commands.jsonl",
-                  lambda rec: int(rec.get("t", 0)) <= t0_ns)
 
     def keep_metric(rec):
         kind = rec.get("kind")
@@ -432,7 +421,33 @@ def rollback_streams(cfg, ckpt_rounds: int, t0_ns: int) -> None:
             return int(rec["t"]) <= t0_ns
         return True
 
-    _filter_jsonl(mdir / "metrics.jsonl", keep_metric)
+    return {
+        "state_digests.jsonl": by_round,
+        "flows.jsonl": by_round,
+        "commands.jsonl": lambda rec: int(rec.get("t", 0)) <= t0_ns,
+        "metrics.jsonl": keep_metric,
+    }
+
+
+def rollback_streams(cfg, ckpt_rounds: int, t0_ns: int) -> None:
+    """Trim the append-mode output streams back to the checkpoint
+    boundary in place (keep rules: ``stream_prefix_keep``) so the
+    resumed run's appends continue them byte-identically."""
+    data_dir = Path(cfg.general.data_directory)
+    tel = cfg.telemetry
+    mdir = (Path(tel.metrics_dir) if tel is not None and tel.metrics_dir
+            else data_dir)
+
+    keeps = stream_prefix_keep(ckpt_rounds, t0_ns)
+    _filter_jsonl(data_dir / "state_digests.jsonl",
+                  keeps["state_digests.jsonl"])
+    for p in sorted(data_dir.glob("state_digests.shard*.jsonl")):
+        _filter_jsonl(p, keeps["state_digests.jsonl"])
+    _filter_jsonl(mdir / "flows.jsonl", keeps["flows.jsonl"])
+    for p in sorted(mdir.glob("flows.shard*.jsonl")):
+        _filter_jsonl(p, keeps["flows.jsonl"])
+    _filter_jsonl(data_dir / "commands.jsonl", keeps["commands.jsonl"])
+    _filter_jsonl(mdir / "metrics.jsonl", keeps["metrics.jsonl"])
 
 
 # -- crash reports -------------------------------------------------------------

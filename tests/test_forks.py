@@ -1,0 +1,346 @@
+"""Scenario multiverse (shadow_tpu/forks.py): checkpoint-forked what-if
+trees + the comparative reducer.
+
+THE acceptance gates of the fork PR:
+
+- a 10-branch forked sweep over examples/web_cdn.yaml — seed, fault,
+  congestion-control, and injected-command divergence legs — produces,
+  for EVERY branch, an output tree and streams byte-identical to a
+  cold-start run of the same (config, commands, seed) tuple: the
+  honesty gate that makes forked results citable;
+- restore-mode branches resume the shared trunk checkpoint (amortized)
+  while divergence axes that are part of the checkpoint's config
+  identity run cold, with the reason NAMED in the branch manifest;
+- the reducer diffs per-group flow percentiles against the trunk with
+  t-based CI95 across branches, and ``bisect_divergence.py --a/--b``
+  names the first divergent round of any branch vs the trunk;
+- dishonest forks are refused by name: non-volatile overlays,
+  mismatched config digests, pre-v5 checkpoints, commands injected at
+  or before the fork point.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+import yaml
+
+from shadow_tpu import fleet, forks
+from shadow_tpu.config.schema import parse_config
+from shadow_tpu.core.controller import Controller
+
+ROOT = Path(__file__).resolve().parent.parent
+CDN_YAML = ROOT / "examples" / "web_cdn.yaml"
+
+#: truncated run shape shared by the trunk, every branch, and every
+#: cold-start twin (web_cdn.yaml carries its own telemetry section, so
+#: flows/metrics streams exist without extra flags)
+COMMON = {
+    "general.stop_time": "12s",
+    "general.checkpoint_every": "6s",
+    "general.state_digest_every": 50,
+}
+FORK_T = 6_000_000_000  # the 6s checkpoint the fork restores
+
+#: a replacement fault timeline for the fault-divergence leg: the
+#: partition fires 1 s later and shorter (section-replacing override)
+QUIET_FAULTS = {"events": [
+    {"time": "9s", "kind": "link_down", "src_nodes": [0, 1, 2, 3, 4, 5],
+     "dst_nodes": [6, 7, 8, 9, 10, 11], "duration": "2s"},
+]}
+
+BRANCHES = [
+    {"name": "base_a", "group": "base"},
+    {"name": "base_b", "group": "base"},
+    {"name": "cmd_degrade", "group": "cmd", "commands": [
+        {"t": "8.5s", "cmd": "link_degrade", "src_nodes": [0, 1],
+         "dst_nodes": [6, 7], "latency_factor": 2.0, "loss_add": 0.05,
+         "bandwidth_scale": 0.5, "duration": "2s"}]},
+    {"name": "cmd_script", "group": "cmdscript"},  # command_script added
+    {"name": "seed101", "group": "seed", "seed": 101},
+    {"name": "seed102", "group": "seed", "seed": 102},
+    {"name": "seed103", "group": "seed", "seed": 103},
+    {"name": "fault_quiet", "group": "fault", "faults": QUIET_FAULTS},
+    {"name": "fault_down", "group": "fault", "faults": {"events": [
+        {"time": "9s", "kind": "host_down", "hosts": ["edge3"],
+         "duration": "2s"}]}},
+    {"name": "cc_cubic", "group": "cc", "congestion_control": "cubic"},
+]
+
+
+def _run_standalone(d, overrides: dict) -> None:
+    shutil.rmtree(d, ignore_errors=True)
+    doc = yaml.safe_load(CDN_YAML.read_text())
+    cfg = parse_config(doc, {**COMMON, **overrides,
+                             "general.data_directory": str(d)})
+    Controller(cfg, mirror_log=False).run()
+
+
+def _digests(d) -> tuple:
+    return fleet.output_tree_digest(d), fleet._stream_digests(d)
+
+
+@pytest.fixture(scope="module")
+def forked(tmp_path_factory):
+    """One trunk run + the 10-branch fork everything below inspects."""
+    base = tmp_path_factory.mktemp("forks")
+    trunk = base / "trunk"
+    _run_standalone(trunk, {})
+    ckpt = trunk / "checkpoints" / f"ckpt_t{FORK_T:020d}.ckpt"
+    assert ckpt.is_file(), "trunk wrote no 6s checkpoint"
+    script = base / "inject.jsonl"
+    script.write_text(json.dumps(
+        {"cmd": {"cmd": "host_down", "hosts": ["edge3"],
+                 "duration": "1500000000 ns"},
+         "round": 0, "seq": 1, "t": 9_000_000_000}) + "\n")
+    branches = [dict(b) for b in BRANCHES]
+    for b in branches:
+        if b["name"] == "cmd_script":
+            b["command_script"] = str(script)
+    fork_dir = base / "fork"
+    plan = forks.plan_fork(str(CDN_YAML), ckpt, branches, fork_dir,
+                           overrides=dict(COMMON))
+    summary = fleet.FleetRunner(
+        str(CDN_YAML), plan["order"], jobs=4, sweep_dir=fork_dir,
+        overrides=dict(COMMON), fork=plan, quiet=True).run()
+    return {"base": base, "trunk": trunk, "ckpt": ckpt,
+            "fork_dir": fork_dir, "plan": plan, "summary": summary}
+
+
+def _manifest(forked, name: str) -> dict:
+    return json.loads((forks.branch_dir(forked["fork_dir"], name)
+                       / forks.FORK_MANIFEST).read_text())
+
+
+def test_fork_completes_all_branches(forked):
+    summary = forked["summary"]
+    assert sorted(summary["completed"]) == sorted(b["name"]
+                                                  for b in BRANCHES)
+    assert summary["failed"] == {}
+    assert summary["format"] == forks.FORK_SUMMARY_FORMAT
+    # restore vs cold is decided by config identity, reasons named
+    ckpt_sha = forked["plan"]["ckpt_sha256"]
+    for b in BRANCHES:
+        man = _manifest(forked, b["name"])
+        assert man["status"] == "ok"
+        assert man["trunk_checkpoint_sha256"] == ckpt_sha
+        assert man["fork_t"] == FORK_T
+        cold = any(k in b for k in ("seed", "faults",
+                                    "congestion_control"))
+        assert man["mode"] == ("cold" if cold else "restore"), b["name"]
+        if cold:
+            assert man["cold_reason"], b["name"]
+        else:
+            assert man["cold_reason"] is None
+
+
+def test_restore_branch_identical_to_trunk(forked):
+    """The no-divergence restore branches ARE the trunk run: prefix
+    copy + checkpoint resume reproduces it byte-for-byte (and two
+    branches of the same tuple reproduce each other)."""
+    tree, streams = _digests(forked["trunk"])
+    a = _manifest(forked, "base_a")
+    assert a["tree_sha256"] == tree
+    assert a["streams_sha256"] == streams
+    b = _manifest(forked, "base_b")
+    assert b["tree_sha256"] == tree and b["streams_sha256"] == streams
+
+
+def test_command_branch_identical_to_cold_replay(forked):
+    """An injected-command branch equals a cold-start run replaying the
+    SAME merged command log — the (config, commands, seed) tuple the
+    manifest claims."""
+    for name in ("cmd_degrade", "cmd_script"):
+        man = _manifest(forked, name)
+        bdir = forks.branch_dir(forked["fork_dir"], name)
+        replay = bdir / forks.REPLAY_FILE
+        assert replay.is_file(), name
+        twin = forked["base"] / f"twin_{name}"
+        _run_standalone(twin, {"general.replay_commands": str(replay)})
+        tree, streams = _digests(twin)
+        assert man["tree_sha256"] == tree, name
+        # the branch re-logs the injected suffix exactly as a cold
+        # replay does — commands.jsonl included in the identity
+        assert {k: v for k, v in man["streams_sha256"].items()
+                if k != "commands.jsonl"} == streams, name
+        assert man["streams_sha256"]["commands.jsonl"] == hashlib.sha256(
+            (twin / "commands.jsonl").read_bytes()).hexdigest(), name
+
+
+def test_cold_branches_identical_to_cold_start(forked):
+    """Each cold divergence axis (seed / fault timeline / congestion
+    control) equals a from-scratch run with the same override — one
+    representative per axis."""
+    for name, overrides in (
+            ("seed101", {"general.seed": 101}),
+            ("fault_quiet", {"faults": QUIET_FAULTS}),
+            ("cc_cubic", {"experimental.congestion_control": "cubic"})):
+        man = _manifest(forked, name)
+        twin = forked["base"] / f"twin_{name}"
+        _run_standalone(twin, overrides)
+        tree, streams = _digests(twin)
+        assert man["tree_sha256"] == tree, name
+        assert man["streams_sha256"] == streams, name
+    # the seed axis actually diverges across branches
+    trees = {_manifest(forked, n)["tree_sha256"]
+             for n in ("seed101", "seed102", "seed103")}
+    assert len(trees) == 3
+
+
+def test_reducer_groups_and_ci(forked):
+    summary = forked["summary"]
+    assert summary["trunk_flows"], "trunk telemetry missing"
+    groups = summary["groups"]
+    assert set(groups) == {"base", "cmd", "cmdscript", "seed", "fault",
+                           "cc"}
+    assert groups["seed"]["branches"] == ["seed101", "seed102",
+                                          "seed103"]
+    # per-group percentile deltas vs the trunk, CI95 across branches
+    kind = sorted(summary["trunk_flows"])[0]
+    seed_row = groups["seed"]["flows"][kind]
+    dvt = seed_row["delta_vs_trunk"]["p50_ms"]
+    assert dvt["n"] == 3
+    assert len(dvt["deltas"]) == 3
+    assert dvt["lo"] <= dvt["mean"] <= dvt["hi"]
+    assert isinstance(dvt["significant"], bool)
+    # a single-branch group carries the delta without a CI claim
+    base_dvt = groups["base"]["flows"][kind]["delta_vs_trunk"]["p50_ms"]
+    assert base_dvt["n"] == 2  # base_a + base_b
+    assert base_dvt["mean"] == 0.0  # identical to the trunk
+    assert base_dvt["significant"] is False
+    # renderers name the convention; reduction is idempotent
+    text = forks.render_compare(summary)
+    assert "CI95" in text and "[cold]" in text
+    again = forks.reduce_fork(forked["fork_dir"])
+    assert again["groups"] == groups
+    assert again["trunk_dir"] == str(forked["trunk"])
+
+
+def test_fleet_report_and_compare_cli(forked, capsys):
+    """`fleet report` auto-detects fork directories; --json emits the
+    fork summary; --compare renders the diff table; tools/compare.py
+    and bisect --a/--b ride the same artifacts."""
+    rc = fleet.main(["report", str(forked["fork_dir"]), "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["format"] == forks.FORK_SUMMARY_FORMAT
+    assert sorted(doc["completed"]) == sorted(b["name"] for b in BRANCHES)
+    rc = fleet.main(["report", str(forked["fork_dir"]), "--compare"])
+    assert rc == 0
+    assert "Δp50" in capsys.readouterr().out
+    # --compare on a non-fork directory is a usage error
+    rc = fleet.main(["report", str(forked["trunk"]), "--compare"])
+    assert rc == 2
+    capsys.readouterr()
+    out = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "compare.py"),
+         str(forked["fork_dir"])],
+        capture_output=True, text=True, cwd=str(ROOT))
+    assert out.returncode == 0, out.stderr
+    assert "trunk" in out.stdout and "CI95" in out.stdout
+    # bisect --a/--b: trunk vs a diverged branch names the first
+    # divergent round, strictly after the fork boundary
+    bis = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "bisect_divergence.py"),
+         "--json", "--a", str(forked["trunk"]),
+         "--b", str(forks.branch_dir(forked["fork_dir"], "cmd_degrade"))],
+        capture_output=True, text=True, cwd=str(ROOT))
+    assert bis.returncode == 1, bis.stderr
+    rec = json.loads(bis.stdout)
+    assert rec["kind"] == "digest"
+    assert rec["round"] > forked["plan"]["ckpt_rounds"]
+    # ... and vs an identical branch, agreement
+    bis = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "bisect_divergence.py"),
+         "--a", str(forked["trunk"]),
+         "--b", str(forks.branch_dir(forked["fork_dir"], "base_a"))],
+        capture_output=True, text=True, cwd=str(ROOT))
+    assert bis.returncode == 0, bis.stdout + bis.stderr
+
+
+# -- refusals (each by name, before any worker spawns) ------------------------
+
+def _plan(forked, branches, **kw):
+    return forks.plan_fork(str(CDN_YAML), forked["ckpt"], branches,
+                           forked["base"] / "refused",
+                           overrides=dict(COMMON), **kw)
+
+
+def test_refuses_nonvolatile_overlay(forked):
+    with pytest.raises(forks.ForkError, match="not volatile"):
+        _plan(forked, [{"name": "b",
+                        "overlay": {"general.parallelism": 4}}])
+    with pytest.raises(forks.ForkError, match="managed by the fork"):
+        _plan(forked, [{"name": "b",
+                        "overlay": {"general.data_directory": "/x"}}])
+    with pytest.raises(forks.ForkError, match="re-cadence"):
+        _plan(forked, [{"name": "b",
+                        "overlay": {"telemetry.sample_every": "1s"}}])
+    with pytest.raises(forks.ForkError, match="re-cadence"):
+        _plan(forked, [{"name": "b",
+                        "overlay": {"general.state_digest_every": 1}}])
+    # ...while genuinely volatile run-shape keys pass validation
+    plan = _plan(forked, [{"name": "ok",
+                           "overlay": {"general.log_level": "warning"}}])
+    assert plan["branches"]["ok"]["mode"] == "restore"
+
+
+def test_refuses_config_digest_mismatch(forked):
+    with pytest.raises(forks.ForkError, match="config mismatch"):
+        forks.plan_fork(str(CDN_YAML), forked["ckpt"],
+                        [{"name": "b"}], forked["base"] / "refused",
+                        overrides={**COMMON,
+                                   "general.stop_time": "13s"})
+
+
+def test_refuses_pre_v5_checkpoint(forked, tmp_path):
+    old = tmp_path / "old.ckpt"
+    hdr = {"format": "shadow_tpu-checkpoint", "version": 4,
+           "config_digest": "0" * 64, "sim_time_ns": 0, "rounds": 0}
+    old.write_bytes((json.dumps(hdr) + "\n").encode())
+    with pytest.raises(forks.ForkError, match="version-4"):
+        _plan({"ckpt": old, "base": tmp_path}, [{"name": "b"}])
+    hdr["managed"] = True
+    old.write_bytes((json.dumps(hdr) + "\n").encode())
+    with pytest.raises(forks.ForkError, match="managed guests require"):
+        _plan({"ckpt": old, "base": tmp_path}, [{"name": "b"}])
+
+
+def test_refuses_command_at_or_before_fork_point(forked):
+    with pytest.raises(forks.ForkError,
+                       match="at or before the fork point"):
+        _plan(forked, [{"name": "b", "commands": [
+            {"t": "6s", "cmd": "checkpoint_now"}]}])
+
+
+def test_refuses_bad_branch_specs(forked, tmp_path):
+    with pytest.raises(forks.ForkError, match="duplicate branch name"):
+        forks.load_branches(_branches_yaml(tmp_path, [
+            {"name": "x"}, {"name": "x"}]))
+    with pytest.raises(forks.ForkError, match="filesystem-safe"):
+        forks.load_branches(_branches_yaml(tmp_path, [
+            {"name": "../evil"}]))
+    with pytest.raises(forks.ForkError, match="unknown keys"):
+        forks.load_branches(_branches_yaml(tmp_path, [
+            {"name": "x", "sed": 3}]))
+    with pytest.raises(forks.ForkError, match="branches"):
+        forks.load_branches(_branches_yaml(tmp_path, []))
+
+
+def _branches_yaml(tmp_path, branches) -> Path:
+    p = tmp_path / "branches.yaml"
+    p.write_text(yaml.safe_dump({"branches": branches}))
+    return p
+
+
+def test_fork_refuses_resume(forked):
+    with pytest.raises(ValueError, match="cannot --resume"):
+        fleet.FleetRunner(str(CDN_YAML), ["b"], jobs=1,
+                          sweep_dir=forked["base"] / "r",
+                          fork=forked["plan"], resume=True)

@@ -17,9 +17,19 @@ target instead of a haystack.
 
 Usage:
     python tools/bisect_divergence.py A/state_digests.jsonl B/state_digests.jsonl
+    python tools/bisect_divergence.py --a RUN_DIR_A --b RUN_DIR_B
     python tools/bisect_divergence.py --window-rounds K A.jsonl B.jsonl
     python tools/bisect_divergence.py --shard K A_datadir B_datadir
     python tools/bisect_divergence.py --json A.jsonl B.jsonl
+
+``--a DIR --b DIR`` names two run directories instead of two stream
+files: each resolves to its ``state_digests.jsonl`` (or its
+``state_digests.shard<K>.jsonl`` sidecar under ``--shard K``). This is
+the fork-comparison spelling (shadow_tpu/forks.py): point --a at the
+trunk run directory and --b at a ``branch_<name>`` directory — the
+first divergent round is where the branch's what-if departed from the
+trunk; rounds at or before the fork boundary agreeing is the fork's
+honesty gate in action.
 
 ``--json`` prints ONE machine-readable JSON line instead of the report:
 ``{"kind": "digest", "round": R, "t": NS, "hosts": [...], "shard": K,
@@ -134,11 +144,26 @@ def _shard_path(path: str, shard: int) -> str:
     return path
 
 
+def _dir_stream(path: str, shard) -> str:
+    """Resolve an --a/--b run directory to its digest stream (the shard
+    sidecar under --shard)."""
+    import os
+
+    if not os.path.isdir(path):
+        _die(f"--a/--b expect run directories, and {path!r} is not one "
+             f"(pass stream files positionally instead)")
+    name = ("state_digests.jsonl" if shard is None
+            else f"state_digests.shard{shard}.jsonl")
+    return os.path.join(path, name)
+
+
 def main(argv) -> int:
     window_rounds = 0
     shard = None
     as_json = False
-    while argv and argv[0] in ("--window-rounds", "--shard", "--json"):
+    dir_a = dir_b = None
+    while argv and argv[0] in ("--window-rounds", "--shard", "--json",
+                               "--a", "--b"):
         flag = argv[0]
         if flag == "--json":
             as_json = True
@@ -147,6 +172,13 @@ def main(argv) -> int:
         if len(argv) < 2:
             print(__doc__, file=sys.stderr)
             return 2
+        if flag in ("--a", "--b"):
+            if flag == "--a":
+                dir_a = argv[1]
+            else:
+                dir_b = argv[1]
+            argv = argv[2:]
+            continue
         try:
             val = int(argv[1])
         except ValueError:
@@ -161,11 +193,19 @@ def main(argv) -> int:
                 _die("--shard must be >= 0")
             shard = val
         argv = argv[2:]
-    if len(argv) != 2:
+    if (dir_a is None) != (dir_b is None):
+        _die("--a and --b go together (two run directories to diff)")
+    if dir_a is not None:
+        if argv:
+            _die("--a/--b replace the positional stream arguments")
+        argv = [_dir_stream(dir_a, shard), _dir_stream(dir_b, shard)]
+    elif len(argv) == 2:
+        if shard is not None:
+            argv = [_shard_path(argv[0], shard),
+                    _shard_path(argv[1], shard)]
+    else:
         print(__doc__, file=sys.stderr)
         return 2
-    if shard is not None:
-        argv = [_shard_path(argv[0], shard), _shard_path(argv[1], shard)]
     recs_a, recs_b = load_stream(argv[0]), load_stream(argv[1])
     d = compare(recs_a, recs_b)
     # the shard a divergence localizes to (sidecar streams carry it)

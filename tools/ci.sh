@@ -275,6 +275,85 @@ print(f"fleet smoke OK: 3 seeds byte-identical to standalone, "
       f"sweep_summary.json")
 EOF
 
+echo "== fork smoke (gossip_churn: 3-branch what-if fork off a mid-run checkpoint, reducer diff + bisect first-divergence) =="
+rm -rf /tmp/ci-fork-trunk /tmp/ci-fork
+# the trunk: one checkpointing run; the 10s snapshot is the fork point.
+# The workload may legitimately exit nonzero on process_errors at this
+# truncated stop time — the branch assertions below are the gate.
+python -m shadow_tpu examples/gossip_churn.yaml --quiet \
+    --data-directory /tmp/ci-fork-trunk \
+    --set general.stop_time=25s --checkpoint-every 10s \
+    --state-digest-every 100 --sample-every 5s || true
+ck=$(ls /tmp/ci-fork-trunk/checkpoints/ckpt_*.ckpt | head -1)
+echo "forking from $ck"
+# one branch diverges via an injected live-command script (replayed
+# through the commands.jsonl machinery), one changes the seed (an
+# honest cold re-run: the seed is part of the config identity)
+cat > /tmp/ci-fork-cmds.jsonl <<'EOF'
+{"cmd": {"cmd": "link_degrade", "src_nodes": [0], "dst_nodes": [1], "latency_factor": 3.0, "loss_add": 0.05, "bandwidth_scale": 0.5, "duration": "3000000000 ns"}, "round": 0, "seq": 1, "t": 15000000000}
+EOF
+cat > /tmp/ci-fork-branches.yaml <<'EOF'
+branches:
+  - name: baseline
+  - name: lossy
+    command_script: /tmp/ci-fork-cmds.jsonl
+  - name: seed9
+    seed: 9
+EOF
+python -m shadow_tpu fork examples/gossip_churn.yaml \
+    --from "$ck" --branches /tmp/ci-fork-branches.yaml \
+    --fork-dir /tmp/ci-fork --jobs 3 --quiet \
+    --set general.stop_time=25s --set general.checkpoint_every=10s \
+    --set general.state_digest_every=100 --set telemetry.sample_every=5s \
+    > /tmp/ci-fork-report.txt
+python tools/compare.py /tmp/ci-fork --json > /tmp/ci-fork-summary.json
+python - <<'EOF'
+import json
+from shadow_tpu import fleet, forks
+
+s = json.load(open("/tmp/ci-fork-summary.json"))
+assert s["completed"] == ["baseline", "lossy", "seed9"], s["failed"]
+b = s["branches"]
+assert b["baseline"]["mode"] == "restore" and b["lossy"]["mode"] == "restore"
+assert b["seed9"]["mode"] == "cold" and "seed" in (
+    json.loads((forks.branch_dir("/tmp/ci-fork", "seed9")
+                / forks.FORK_MANIFEST).read_text())["cold_reason"])
+# the honesty gate, spot-checked in CI: the no-divergence restore
+# branch IS the trunk run, byte for byte (tree + streams)
+assert (fleet.output_tree_digest(forks.branch_dir("/tmp/ci-fork", "baseline"))
+        == fleet.output_tree_digest("/tmp/ci-fork-trunk")), \
+    "baseline branch tree != trunk tree"
+assert (fleet._stream_digests(forks.branch_dir("/tmp/ci-fork", "baseline"))
+        == fleet._stream_digests("/tmp/ci-fork-trunk")), \
+    "baseline branch streams != trunk streams"
+assert s["trunk_flows"], "reducer found no trunk flow telemetry"
+report = open("/tmp/ci-fork-report.txt").read()
+assert "Δp50" in report and "CI95" in report, report
+EOF
+# bisect localizes the what-if: the undiverged branch agrees with the
+# trunk (exit 0); the command-injected branch names its first divergent
+# round, strictly after the fork boundary
+python tools/bisect_divergence.py \
+    --a /tmp/ci-fork-trunk --b /tmp/ci-fork/branch_baseline
+rc=0
+python tools/bisect_divergence.py --json \
+    --a /tmp/ci-fork-trunk --b /tmp/ci-fork/branch_lossy \
+    > /tmp/ci-fork-bisect.json || rc=$?
+test "$rc" -eq 1
+python - "$ck" <<'EOF'
+import json, sys
+from shadow_tpu import checkpoint as ckpt
+
+d = json.load(open("/tmp/ci-fork-bisect.json"))
+fork_rounds = ckpt.read_header(sys.argv[1])["rounds"]
+assert d["kind"] == "digest", d
+assert d["round"] > fork_rounds, (d, fork_rounds)
+assert d["t"] >= 15_000_000_000, d  # not before the injected command
+print(f"fork smoke OK: baseline byte-identical to the trunk, lossy "
+      f"branch first diverges at round {d['round']} "
+      f"(t={d['t']} ns, fork point round {fork_rounds})")
+EOF
+
 echo "== fast+robust smoke (gossip_churn: faults + checkpoints + digests with the C engine ON vs the Python plane) =="
 frrun() {
     rm -rf "/tmp/ci-fr-$1"

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Compare the branches of a checkpoint fork against their trunk.
+
+The comparative reducer's CLI face (shadow_tpu/forks.py): point it at a
+fork directory (``python -m shadow_tpu fork`` / ``python -m
+shadow_tpu.fleet sweep --fork-from``) and it k-way merges every branch's
+``LogHistogram`` flow states, groups branches (``group:`` in
+branches.yaml), and renders per-group flow percentiles diffed against
+the trunk run — the mean per-branch (branch − trunk) percentile delta
+with its t-based CI95 across the group, starred when the CI excludes
+zero ("Once is Never Enough": the per-branch statistic first, the
+inference across branches). Cold-run groups (seed / fault / congestion
+-control divergence) are tagged ``[cold]``.
+
+Usage:
+    python tools/compare.py FORK_DIR            # comparison table
+    python tools/compare.py FORK_DIR --full     # branch report + table
+    python tools/compare.py FORK_DIR --json     # the summary JSON line
+
+The reduction is idempotent — a pure function of the on-disk branch
+manifests and telemetry states — so re-running it after adding branches
+(or against a partially failed fork) is always safe. Also reachable as
+``python -m shadow_tpu.fleet report FORK_DIR --compare``. To localize
+WHERE a branch departed (the first divergent round, not just the
+percentile delta), follow up with ``python tools/bisect_divergence.py
+--a TRUNK_DIR --b FORK_DIR/branch_<name>``.
+
+Exit status: 0 = all branches ok, 1 = some branch failed, 2 = usage /
+not a fork directory.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from shadow_tpu import forks as _forks  # noqa: E402
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    full = "--full" in argv
+    argv = [a for a in argv if a not in ("--json", "--full")]
+    if len(argv) != 1:
+        print(__doc__, file=sys.stderr)
+        return 2
+    fork_dir = Path(argv[0])
+    if (not (fork_dir / _forks.FORK_SUMMARY).is_file()
+            and not any(fork_dir.glob("branch_*/" + _forks.FORK_MANIFEST))):
+        print(f"compare: {fork_dir} is not a fork directory (no "
+              f"{_forks.FORK_SUMMARY} and no branch_*/"
+              f"{_forks.FORK_MANIFEST}) — run a fork first: "
+              f"python -m shadow_tpu fork cfg.yaml --from CKPT "
+              f"--branches branches.yaml", file=sys.stderr)
+        return 2
+    try:
+        summary = _forks.reduce_fork(fork_dir)
+    except OSError as exc:
+        print(f"compare: {exc}", file=sys.stderr)
+        return 2
+    if as_json:
+        print(json.dumps(summary))
+    elif full:
+        print(_forks.render_fork_report(summary))
+    else:
+        print(_forks.render_compare(summary))
+    return 0 if not summary["failed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
